@@ -1,0 +1,40 @@
+from .dtypes import DataType, BF16, F32
+from .tensor import (
+    TensorSpec,
+    DimSharding,
+    ShardedTensorSpec,
+    sharded,
+    replicated_spec,
+)
+from .mesh import (
+    MachineSpec,
+    AXIS_ORDER,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    MODEL_AXIS,
+)
+from .graph import Graph, OpNode, TensorRef, freeze_attrs
+
+__all__ = [
+    "DataType",
+    "BF16",
+    "F32",
+    "TensorSpec",
+    "DimSharding",
+    "ShardedTensorSpec",
+    "sharded",
+    "replicated_spec",
+    "MachineSpec",
+    "AXIS_ORDER",
+    "DATA_AXIS",
+    "EXPERT_AXIS",
+    "PIPE_AXIS",
+    "SEQ_AXIS",
+    "MODEL_AXIS",
+    "Graph",
+    "OpNode",
+    "TensorRef",
+    "freeze_attrs",
+]

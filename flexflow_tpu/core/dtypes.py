@@ -1,0 +1,78 @@
+"""Data types for flexflow-tpu.
+
+Mirrors the reference's ``DataType`` enum (reference
+``include/flexflow/ffconst.h``) mapped onto JAX dtypes. On TPU the MXU
+natively computes in bfloat16 with float32 accumulation, so BF16 is the
+default compute dtype; INT4/INT8 exist for weight-only quantization
+(reference ``src/ops/kernels/decompress_kernels.cu``).
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    BOOL = "bool"
+    INT4 = "int4"
+    INT8 = "int8"
+    INT32 = "int32"
+    INT64 = "int64"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    FLOAT = "float32"
+    DOUBLE = "float64"
+
+    @property
+    def jnp_dtype(self):
+        return _TO_JNP[self]
+
+    @property
+    def itemsize_bits(self) -> int:
+        return _BITS[self]
+
+    @classmethod
+    def from_any(cls, dt) -> "DataType":
+        """Coerce a DataType, jnp dtype, np dtype, or string to DataType."""
+        if isinstance(dt, DataType):
+            return dt
+        name = jnp.dtype(dt).name if not isinstance(dt, str) else dt
+        for member in cls:
+            if member.value == name:
+                return member
+        raise ValueError(f"unsupported dtype: {dt!r}")
+
+
+_TO_JNP = {
+    DataType.BOOL: jnp.bool_,
+    DataType.INT4: jnp.int4,
+    DataType.INT8: jnp.int8,
+    DataType.INT32: jnp.int32,
+    DataType.INT64: jnp.int64,
+    DataType.HALF: jnp.float16,
+    DataType.BFLOAT16: jnp.bfloat16,
+    DataType.FLOAT: jnp.float32,
+    DataType.DOUBLE: jnp.float64,
+}
+
+_BITS = {
+    DataType.BOOL: 8,
+    DataType.INT4: 4,
+    DataType.INT8: 8,
+    DataType.INT32: 32,
+    DataType.INT64: 64,
+    DataType.HALF: 16,
+    DataType.BFLOAT16: 16,
+    DataType.FLOAT: 32,
+    DataType.DOUBLE: 64,
+}
+
+# Convenient aliases used across the codebase.
+BF16 = jnp.bfloat16
+F32 = jnp.float32
+
+
+def is_floating(dt) -> bool:
+    return np.issubdtype(jnp.dtype(DataType.from_any(dt).jnp_dtype), np.floating)

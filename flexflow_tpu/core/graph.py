@@ -1,0 +1,137 @@
+"""Operator graph IR — the TPU-native Parallel Computation Graph (PCG).
+
+The reference builds a ``Layer`` graph that ``FFModel::compile`` lowers to
+``PCG::Graph`` whose nodes are hash-consed on per-op ``Params`` structs
+(reference ``include/flexflow/graph.h:293``, ``model.h:935-964``). We keep
+the same two-level idea in pure Python:
+
+  * :class:`OpNode` — one operator instance: op type, frozen attrs,
+    input tensor refs, output specs.
+  * :class:`Graph`  — append-only DAG with topological node ids; the Unity
+    search and the compile pipeline both walk it.
+
+Node attrs are canonicalised to hashable tuples so structurally identical
+ops hash equal — the property the reference's ``get_or_create_node<T>``
+relies on for search-state dedup.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .tensor import TensorSpec
+
+
+def freeze_attrs(attrs: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
+    """Canonicalise an attr dict into a sorted hashable tuple."""
+
+    def conv(v):
+        if isinstance(v, dict):
+            return tuple(sorted((k, conv(x)) for k, x in v.items()))
+        if isinstance(v, (list, tuple)):
+            return tuple(conv(x) for x in v)
+        if isinstance(v, set):
+            return tuple(sorted(conv(x) for x in v))
+        return v
+
+    return tuple(sorted((k, conv(v)) for k, v in attrs.items()))
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorRef:
+    """Reference to output ``out_idx`` of node ``node_id`` — the PCG edge."""
+
+    node_id: int
+    out_idx: int = 0
+
+
+@dataclasses.dataclass
+class OpNode:
+    id: int
+    op_type: str
+    attrs: Tuple[Tuple[str, Any], ...]
+    inputs: Tuple[TensorRef, ...]
+    out_specs: Tuple[TensorSpec, ...]
+    name: str = ""
+
+    @property
+    def attrs_dict(self) -> Dict[str, Any]:
+        return dict(self.attrs)
+
+    def signature(self) -> Tuple:
+        """Hash-consing key: structural identity ignoring node id/name."""
+        return (self.op_type, self.attrs, self.inputs)
+
+
+class Graph:
+    """Append-only operator DAG in topological order."""
+
+    def __init__(self):
+        self.nodes: List[OpNode] = []
+        self._sig_index: Dict[Tuple, int] = {}
+        self._used_names: Dict[str, int] = {}
+
+    def add_node(
+        self,
+        op_type: str,
+        attrs: Dict[str, Any],
+        inputs: Sequence[TensorRef],
+        out_specs: Sequence[TensorSpec],
+        name: str = "",
+        dedup: bool = False,
+    ) -> OpNode:
+        frozen = freeze_attrs(attrs)
+        sig = (op_type, frozen, tuple(inputs))
+        if dedup and sig in self._sig_index:
+            return self.nodes[self._sig_index[sig]]
+        base = name or f"{op_type}_{len(self.nodes)}"
+        # Uniquify deterministically: params are keyed by node name, so two
+        # layers sharing a user-given name must not silently alias weights.
+        count = self._used_names.get(base, 0)
+        self._used_names[base] = count + 1
+        unique = base if count == 0 else f"{base}_{count}"
+        node = OpNode(
+            id=len(self.nodes),
+            op_type=op_type,
+            attrs=frozen,
+            inputs=tuple(inputs),
+            out_specs=tuple(out_specs),
+            name=unique,
+        )
+        self.nodes.append(node)
+        self._sig_index[sig] = node.id
+        return node
+
+    def node(self, node_id: int) -> OpNode:
+        return self.nodes[node_id]
+
+    def out_spec(self, ref: TensorRef) -> TensorSpec:
+        return self.nodes[ref.node_id].out_specs[ref.out_idx]
+
+    def consumers(self, node_id: int) -> List[OpNode]:
+        return [
+            n
+            for n in self.nodes
+            if any(r.node_id == node_id for r in n.inputs)
+        ]
+
+    def topo_order(self) -> List[OpNode]:
+        return list(self.nodes)  # insertion order is topological
+
+    def __len__(self):
+        return len(self.nodes)
+
+    def __iter__(self):
+        return iter(self.nodes)
+
+    def to_dot(self) -> str:
+        """Graphviz export, mirroring the reference's ``--export-strategy``
+        dot dumps (reference ``src/runtime/graph.cc`` dot output)."""
+        lines = ["digraph pcg {"]
+        for n in self.nodes:
+            shapes = ",".join(str(list(s.shape)) for s in n.out_specs)
+            lines.append(f'  n{n.id} [label="{n.name}\\n{n.op_type} {shapes}"];')
+            for r in n.inputs:
+                lines.append(f"  n{r.node_id} -> n{n.id};")
+        lines.append("}")
+        return "\n".join(lines)

@@ -1,0 +1,139 @@
+"""Device mesh layer — the TPU-native replacement for FlexFlow's MachineView.
+
+The reference places operators on devices with ``MachineView{ndims,
+start_device_id, dim[], stride[]}`` (reference ``include/flexflow/
+machine_view.h:18-39``) resolved by a Legion mapper. On TPU the idiomatic
+equivalent is a single logical ``jax.sharding.Mesh`` whose named axes carry
+the parallelism meaning; GSPMD compiles sharding annotations into ICI/DCN
+collectives, so placement is declarative instead of a task mapper.
+
+Axis convention (outermost → innermost):
+
+    data  — data parallel (batch sharding; gradients all-reduced)
+    expert— expert parallel (MoE expert ranges)
+    pipe  — pipeline parallel (layer stages; ppermute between neighbours)
+    seq   — sequence/context parallel (ring attention / Ulysses)
+    model — tensor parallel (Megatron head/FFN sharding)
+
+``model`` is the innermost axis so TP collectives ride the fastest ICI
+links between physically adjacent chips; ``data`` is outermost so DP
+gradient all-reduces may cross DCN on multi-slice topologies.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis order; see module docstring.
+AXIS_ORDER = ("data", "expert", "pipe", "seq", "model")
+
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineSpec:
+    """Logical machine description — the TPU analog of FlexFlow's
+    ``MachineResource`` (reference ``machine_view.h:55``).
+
+    Degrees multiply to the total device count. Any degree may be 1.
+    """
+
+    data: int = 1
+    expert: int = 1
+    pipe: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.expert * self.pipe * self.seq * self.model
+
+    def axis_sizes(self) -> dict:
+        return {
+            "data": self.data,
+            "expert": self.expert,
+            "pipe": self.pipe,
+            "seq": self.seq,
+            "model": self.model,
+        }
+
+    def make_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
+        """Build a Mesh over ``devices`` (default: all local devices)."""
+        if devices is None:
+            devices = jax.devices()
+        n = self.num_devices
+        if len(devices) < n:
+            raise ValueError(
+                f"MachineSpec needs {n} devices, only {len(devices)} available"
+            )
+        shape = tuple(self.axis_sizes()[a] for a in AXIS_ORDER)
+        dev_array = np.asarray(devices[:n]).reshape(shape)
+        return Mesh(dev_array, AXIS_ORDER)
+
+    @classmethod
+    def from_degrees(
+        cls,
+        num_devices: int,
+        *,
+        tensor: int = 1,
+        pipeline: int = 1,
+        expert: int = 1,
+        sequence: int = 1,
+        data: Optional[int] = None,
+    ) -> "MachineSpec":
+        """Mirror of the reference CLI degrees (``-data/tensor/pipeline-
+        parallelism-degree``, reference ``src/runtime/model.cc:4183``):
+        whatever is not claimed by tensor/pipeline/expert/sequence becomes
+        data parallelism.
+        """
+        denom = tensor * pipeline * expert * sequence
+        if num_devices % denom:
+            raise ValueError(
+                f"{num_devices} devices not divisible by tp*pp*ep*sp={denom}"
+            )
+        if data is None:
+            data = num_devices // denom
+        if data * denom != num_devices:
+            raise ValueError(
+                f"degrees {data}*{denom} != device count {num_devices}"
+            )
+        return cls(data=data, expert=expert, pipe=pipeline, seq=sequence, model=tensor)
+
+
+def single_device_spec() -> MachineSpec:
+    return MachineSpec()
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def used_axes(mesh: Mesh) -> tuple:
+    """Mesh axes with size > 1 (the only ones worth annotating)."""
+    return tuple(a for a in mesh.axis_names if mesh.shape[a] > 1)
+
+
+def host_local_mesh(spec: MachineSpec) -> Mesh:
+    """Mesh over this process's addressable devices only (used by tests and
+    the single-host serving path)."""
+    return spec.make_mesh(jax.local_devices())
+
+
+def validate_spec_for_devices(spec: MachineSpec, n_devices: int) -> None:
+    if spec.num_devices != n_devices:
+        raise ValueError(
+            f"MachineSpec covers {spec.num_devices} devices, have {n_devices}"
+        )

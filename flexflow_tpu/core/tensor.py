@@ -1,0 +1,163 @@
+"""Tensor shape metadata — the TPU-native ParallelTensor.
+
+The reference models distribution with ``ParallelDim{size, degree,
+parallel_idx, is_replica_dim}`` and ``ParallelTensorShape`` (reference
+``include/flexflow/parallel_tensor.h:36-120``), binding each tensor to a
+Legion region/partition. Here a tensor's *logical* shape lives in
+:class:`TensorSpec`, and its *distribution* is a mapping of named mesh axes
+per dimension (:class:`DimSharding`) that lowers directly to a
+``jax.sharding.PartitionSpec``. Replica dims — the reference's trick for
+representing weight replication and pending partial sums — become either
+replication (axis unused in the spec) or "unreduced" partial sums, which
+XLA tracks for us after GSPMD propagation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .dtypes import DataType
+
+MAX_TENSOR_DIM = 5  # reference FF_MAX_DIM (CMakeLists.txt:100) default 5
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """Logical (unpartitioned) tensor: shape + dtype + optional name."""
+
+    shape: Tuple[int, ...]
+    dtype: DataType = DataType.FLOAT
+    name: Optional[str] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "shape", tuple(int(d) for d in self.shape))
+        object.__setattr__(self, "dtype", DataType.from_any(self.dtype))
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def size_bytes(self) -> int:
+        return (self.num_elements * self.dtype.itemsize_bits) // 8
+
+    @property
+    def jnp_dtype(self):
+        return self.dtype.jnp_dtype
+
+    def with_shape(self, shape: Sequence[int]) -> "TensorSpec":
+        return dataclasses.replace(self, shape=tuple(shape))
+
+    def with_dtype(self, dtype) -> "TensorSpec":
+        return dataclasses.replace(self, dtype=DataType.from_any(dtype))
+
+    def zeros(self):
+        return jnp.zeros(self.shape, self.jnp_dtype)
+
+    def __repr__(self):
+        return f"TensorSpec({list(self.shape)}, {self.dtype.value}" + (
+            f", {self.name!r})" if self.name else ")"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class DimSharding:
+    """Sharding of one logical dim: tuple of mesh axis names (possibly
+    empty = replicated along that dim). Multiple axes on one dim mirror the
+    reference's multi-degree ParallelDim."""
+
+    axes: Tuple[str, ...] = ()
+
+    def degree(self, mesh: Mesh) -> int:
+        d = 1
+        for a in self.axes:
+            d *= mesh.shape[a]
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedTensorSpec:
+    """TensorSpec + per-dim mesh-axis assignment — the ParallelTensorShape
+    equivalent (reference ``parallel_tensor.h:76-120``)."""
+
+    spec: TensorSpec
+    dim_shardings: Tuple[DimSharding, ...] = ()
+    # Axes over which this tensor holds *unreduced partial sums* — the
+    # reference's replica dim on an output awaiting a Reduction parallel op.
+    unreduced_axes: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        ds = self.dim_shardings
+        if len(ds) < self.spec.ndim:
+            ds = tuple(ds) + tuple(
+                DimSharding() for _ in range(self.spec.ndim - len(ds))
+            )
+        object.__setattr__(self, "dim_shardings", tuple(ds))
+
+    def partition_spec(self) -> PartitionSpec:
+        entries = []
+        for d in self.dim_shardings:
+            if not d.axes:
+                entries.append(None)
+            elif len(d.axes) == 1:
+                entries.append(d.axes[0])
+            else:
+                entries.append(tuple(d.axes))
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def named_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.partition_spec())
+
+    def shard_shape(self, mesh: Mesh) -> Tuple[int, ...]:
+        """Per-device block shape, like the reference's Legion partition
+        subregions."""
+        out = []
+        for size, d in zip(self.spec.shape, self.dim_shardings):
+            deg = d.degree(mesh)
+            if size % deg:
+                raise ValueError(
+                    f"dim of size {size} not divisible by degree {deg}"
+                )
+            out.append(size // deg)
+        return tuple(out)
+
+    def check_valid(self, mesh: Mesh) -> None:
+        seen = set()
+        for d in self.dim_shardings:
+            for a in d.axes:
+                if a in seen:
+                    raise ValueError(f"mesh axis {a!r} used on two dims")
+                if a not in mesh.axis_names:
+                    raise ValueError(f"unknown mesh axis {a!r}")
+                seen.add(a)
+        self.shard_shape(mesh)
+
+
+def sharded(spec: TensorSpec, *axes_per_dim) -> ShardedTensorSpec:
+    """Helper: ``sharded(ts, 'data', None, 'model')`` shards dim0 on data,
+    dim2 on model."""
+    ds = []
+    for a in axes_per_dim:
+        if a is None:
+            ds.append(DimSharding())
+        elif isinstance(a, str):
+            ds.append(DimSharding((a,)))
+        else:
+            ds.append(DimSharding(tuple(a)))
+    return ShardedTensorSpec(spec, tuple(ds))
+
+
+def replicated_spec(spec: TensorSpec) -> ShardedTensorSpec:
+    return ShardedTensorSpec(spec)

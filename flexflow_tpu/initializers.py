@@ -1,0 +1,98 @@
+"""Parameter initializers.
+
+TPU-native equivalents of the reference's Initializer hierarchy
+(reference ``include/flexflow/initializer.h:1-122``, ``src/runtime/
+initializer.cc`` — Glorot-uniform, Zero, Constant, Uniform, Normal GPU
+tasks). Here each initializer is a pure function ``(key, shape, dtype) ->
+array``; they run inside the jitted init program so large weights
+materialise directly on-device, sharded, with no host round-trip.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key, shape: Tuple[int, ...], dtype=jnp.float32):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class GlorotUniform(Initializer):
+    """fan_in/fan_out computed like the reference's GlorotUniform task:
+    last dim = fan_out, second-to-last = fan_in, conv receptive field
+    multiplies both."""
+
+    scale: float = 1.0
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if len(shape) == 2:  # dense (in, out)
+            fan_in, fan_out = shape
+        elif len(shape) >= 3:  # conv OIHW: (out, in, *spatial)
+            receptive = 1
+            for d in shape[2:]:
+                receptive *= d
+            fan_in = shape[1] * receptive
+            fan_out = shape[0] * receptive
+        else:
+            fan_in = fan_out = shape[0] if shape else 1
+        limit = self.scale * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(
+            key, shape, dtype=jnp.float32, minval=-limit, maxval=limit
+        ).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero(Initializer):
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Constant(Initializer):
+    value: float = 0.0
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Uniform(Initializer):
+    min_val: float = -0.05
+    max_val: float = 0.05
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(
+            key, shape, dtype=jnp.float32, minval=self.min_val, maxval=self.max_val
+        ).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Normal(Initializer):
+    mean: float = 0.0
+    stddev: float = 1.0
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return (
+            self.mean + self.stddev * jax.random.normal(key, shape, dtype=jnp.float32)
+        ).astype(dtype)
+
+
+def resolve(init: Optional[object], default: Initializer) -> Initializer:
+    if init is None:
+        return default
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        return {
+            "glorot_uniform": GlorotUniform(),
+            "zeros": Zero(),
+            "zero": Zero(),
+            "normal": Normal(stddev=0.02),
+            "uniform": Uniform(),
+        }[init]
+    raise TypeError(f"bad initializer {init!r}")

@@ -1,0 +1,73 @@
+"""Loss functions.
+
+TPU-native equivalents of the reference loss ops (reference
+``src/loss_functions/loss_functions.cc:121-200`` — categorical/sparse
+cross-entropy, MSE, identity, each with a hand-written backward kernel).
+Here each loss is a pure scalar function; backward comes from autodiff.
+The reference scales gradients by 1/batch (and by replica count under
+parameter-server sync); with jnp.mean + GSPMD gradient psum we get the
+same normalisation for free.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+MEAN_SQUARED_ERROR = "mean_squared_error"
+IDENTITY = "identity"
+
+
+def sparse_categorical_crossentropy(preds, labels, from_logits=True):
+    """labels: int class ids; preds: (..., C) logits, or probabilities when
+    the graph ends in an explicit softmax op (the reference asserts a
+    softmax feeds this loss and differentiates through probs)."""
+    labels = labels.reshape(preds.shape[:-1]).astype(jnp.int32)
+    x = preds.astype(jnp.float32)
+    if from_logits:
+        logp = jax.nn.log_softmax(x, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(x, 1e-12, 1.0))
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def categorical_crossentropy(probs_or_logits, labels, from_logits=False):
+    """labels: one-hot/prob targets with same shape as predictions."""
+    x = probs_or_logits.astype(jnp.float32)
+    if from_logits:
+        logp = jax.nn.log_softmax(x, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(x, 1e-12, 1.0))
+    return -(labels.astype(jnp.float32) * logp).sum(axis=-1).mean()
+
+
+def mean_squared_error(preds, labels):
+    d = preds.astype(jnp.float32) - labels.astype(jnp.float32)
+    return (d * d).mean()
+
+
+def identity(preds, labels):
+    """Pass-through loss: mean of predictions (reference IDENTITY loss used
+    when the graph computes its own loss)."""
+    del labels
+    return preds.astype(jnp.float32).mean()
+
+
+_LOSSES = {
+    SPARSE_CATEGORICAL_CROSSENTROPY: sparse_categorical_crossentropy,
+    CATEGORICAL_CROSSENTROPY: categorical_crossentropy,
+    MEAN_SQUARED_ERROR: mean_squared_error,
+    "mse": mean_squared_error,
+    IDENTITY: identity,
+}
+
+
+def get_loss(name: str, from_logits: bool = True):
+    fn = _LOSSES[name]
+    if "crossentropy" in name:
+        import functools
+
+        return functools.partial(fn, from_logits=from_logits)
+    return fn

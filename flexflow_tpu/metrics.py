@@ -1,0 +1,90 @@
+"""Training metrics.
+
+TPU-native equivalent of the reference Metrics op (reference
+``src/metrics_functions/metrics_functions.cc``, ``include/flexflow/
+metrics_functions.h:44-88``): per-shard metrics computed on device and
+folded into a ``PerfMetrics`` running aggregate. Here metrics are computed
+inside the jitted step (GSPMD reduces across data shards automatically)
+and aggregated on host with :class:`PerfMetrics`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+ACCURACY = "accuracy"
+CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+MEAN_SQUARED_ERROR = "mean_squared_error"
+MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+
+
+def compute_metrics(
+    metric_names: Sequence[str],
+    preds,
+    labels,
+    *,
+    sparse_labels: bool,
+    from_logits: bool = True,
+) -> Dict[str, jnp.ndarray]:
+    """Returns dict of scalar metric values for one batch (device-side)."""
+    out = {}
+    pf = preds.astype(jnp.float32)
+    for m in metric_names:
+        if m == ACCURACY:
+            if sparse_labels:
+                hit = jnp.argmax(pf, axis=-1).astype(jnp.int32) == labels.reshape(
+                    pf.shape[:-1]
+                ).astype(jnp.int32)
+            else:
+                hit = jnp.argmax(pf, axis=-1) == jnp.argmax(labels, axis=-1)
+            out[m] = hit.mean()
+        elif m in (CATEGORICAL_CROSSENTROPY,):
+            lp = jnp.log(jnp.clip(pf, 1e-12, 1.0))
+            out[m] = -(labels.astype(jnp.float32) * lp).sum(-1).mean()
+        elif m == SPARSE_CATEGORICAL_CROSSENTROPY:
+            if from_logits:
+                lp = jax.nn.log_softmax(pf, axis=-1)
+            else:
+                lp = jnp.log(jnp.clip(pf, 1e-12, 1.0))
+            lbl = labels.reshape(pf.shape[:-1]).astype(jnp.int32)
+            out[m] = -jnp.take_along_axis(lp, lbl[..., None], -1).mean()
+        elif m == MEAN_SQUARED_ERROR:
+            d = pf - labels.astype(jnp.float32)
+            out[m] = (d * d).mean()
+        elif m == MEAN_ABSOLUTE_ERROR:
+            out[m] = jnp.abs(pf - labels.astype(jnp.float32)).mean()
+        else:
+            raise ValueError(f"unknown metric {m!r}")
+    return out
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Host-side running aggregate — reference ``PerfMetrics`` future chain
+    (``FFModel::update_metrics_task``, reference ``model.cc:3911``)."""
+
+    iterations: int = 0
+    totals: Dict[str, float] = dataclasses.field(default_factory=dict)
+    loss_total: float = 0.0
+
+    def update(self, loss: float, batch_metrics: Dict[str, float]):
+        self.iterations += 1
+        self.loss_total += float(loss)
+        for k, v in batch_metrics.items():
+            self.totals[k] = self.totals.get(k, 0.0) + float(v)
+
+    def averages(self) -> Dict[str, float]:
+        if self.iterations == 0:
+            return {}
+        out = {k: v / self.iterations for k, v in self.totals.items()}
+        out["loss"] = self.loss_total / self.iterations
+        return out
+
+    def report(self) -> str:
+        avg = self.averages()
+        parts = [f"{k}={v:.6f}" for k, v in sorted(avg.items())]
+        return f"[{self.iterations} iters] " + " ".join(parts)

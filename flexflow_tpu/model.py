@@ -1,0 +1,744 @@
+"""FFModel — the central model-building and training API.
+
+TPU-native equivalent of the reference's ``FFModel`` (reference
+``include/flexflow/model.h:396-1281``, ``src/runtime/model.cc``): ~70
+layer-builder methods append to an operator graph; ``compile()`` lowers the
+graph plus optimizer/loss/metrics into executable form. Where the
+reference lowers to a Legion task graph placed by the Unity search, we
+lower to **one XLA SPMD program**: a jitted train step whose parallelism
+comes from sharding annotations over a named device mesh — compilation
+*is* the reference's ``begin_trace``/``end_trace`` replay (SURVEY.md §7
+design mapping).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .config import FFConfig, get_config
+from .core.dtypes import DataType
+from .core.graph import Graph, OpNode, TensorRef
+from .core.mesh import DATA_AXIS, MODEL_AXIS, MachineSpec
+from .core.tensor import TensorSpec
+from .losses import get_loss
+from .metrics import PerfMetrics, compute_metrics
+from .optimizers import Optimizer, SGDOptimizer
+from .ops.registry import OpContext, get_op
+
+# Computation modes (reference CompMode / InferenceMode enums).
+TRAINING = "training"
+INFERENCE = "inference"
+
+
+class Tensor:
+    """Symbolic tensor handle returned by layer builders (reference
+    ``FFModel`` returns ``Tensor`` layer outputs)."""
+
+    __slots__ = ("model", "ref")
+
+    def __init__(self, model: "FFModel", ref: TensorRef):
+        self.model = model
+        self.ref = ref
+
+    @property
+    def spec(self) -> TensorSpec:
+        return self.model.graph.out_spec(self.ref)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.spec.shape
+
+    @property
+    def dtype(self) -> DataType:
+        return self.spec.dtype
+
+    def __repr__(self):
+        return f"Tensor({self.spec!r} @node{self.ref.node_id}.{self.ref.out_idx})"
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None, seed: int = 0):
+        self.config = config or get_config()
+        self.graph = Graph()
+        self.input_nodes: List[int] = []
+        self.seed = seed or self.config.seed
+        self.optimizer: Optional[Optimizer] = None
+        self.loss_type: Optional[str] = None
+        self.metrics_names: Sequence[str] = ()
+        self.mesh: Optional[Mesh] = None
+        self.params = None
+        self.opt_state = None
+        self.model_state: Dict[int, Any] = {}
+        self._train_step = None
+        self._eval_step = None
+        self._fwd = None
+        self._output_ref: Optional[TensorRef] = None
+        self._step_count = 0
+        # sharding overrides installed by the parallelize pass
+        self._param_pspecs: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------------
+    # graph construction
+
+    def _add(
+        self,
+        op_type: str,
+        attrs: Dict[str, Any],
+        inputs: Sequence[Tensor],
+        name: str = "",
+    ) -> Union[Tensor, Tuple[Tensor, ...]]:
+        in_refs = [t.ref for t in inputs]
+        in_specs = [self.graph.out_spec(r) for r in in_refs]
+        out_specs = get_op(op_type).infer(in_specs, attrs)
+        node = self.graph.add_node(op_type, attrs, in_refs, out_specs, name=name)
+        outs = tuple(Tensor(self, TensorRef(node.id, i)) for i in range(len(out_specs)))
+        return outs if len(outs) > 1 else outs[0]
+
+    def create_tensor(
+        self, shape: Sequence[int], dtype=DataType.FLOAT, name: str = "input"
+    ) -> Tensor:
+        dt = DataType.from_any(dtype)
+        node = self.graph.add_node(
+            "input",
+            {"shape": tuple(shape), "dtype": dt.value},
+            [],
+            [TensorSpec(tuple(shape), dt)],
+            name=name,
+        )
+        self.input_nodes.append(node.id)
+        return Tensor(self, TensorRef(node.id, 0))
+
+    # --- layer builders (reference model.h:407-805 names) --------------
+
+    def dense(
+        self,
+        input: Tensor,
+        out_dim: int,
+        activation: Optional[str] = None,
+        use_bias: bool = True,
+        kernel_initializer=None,
+        bias_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        return self._add(
+            "dense",
+            dict(
+                out_dim=out_dim,
+                activation=activation,
+                use_bias=use_bias,
+                kernel_initializer=kernel_initializer,
+                bias_initializer=bias_initializer,
+            ),
+            [input],
+            name,
+        )
+
+    def embedding(
+        self,
+        input: Tensor,
+        num_entries: int,
+        out_dim: int,
+        aggr: str = "none",
+        dtype=DataType.FLOAT,
+        kernel_initializer=None,
+        name: str = "",
+    ) -> Tensor:
+        return self._add(
+            "embedding",
+            dict(
+                num_entries=num_entries,
+                out_dim=out_dim,
+                aggr=aggr,
+                dtype=DataType.from_any(dtype).value,
+                kernel_initializer=kernel_initializer,
+            ),
+            [input],
+            name,
+        )
+
+    def conv2d(
+        self,
+        input: Tensor,
+        out_channels: int,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int = 1,
+        stride_w: int = 1,
+        padding_h: int = 0,
+        padding_w: int = 0,
+        activation: Optional[str] = None,
+        groups: int = 1,
+        use_bias: bool = True,
+        name: str = "",
+    ) -> Tensor:
+        return self._add(
+            "conv2d",
+            dict(
+                out_channels=out_channels,
+                kernel_h=kernel_h,
+                kernel_w=kernel_w,
+                stride_h=stride_h,
+                stride_w=stride_w,
+                padding_h=padding_h,
+                padding_w=padding_w,
+                activation=activation,
+                groups=groups,
+                use_bias=use_bias,
+            ),
+            [input],
+            name,
+        )
+
+    def pool2d(
+        self,
+        input: Tensor,
+        kernel_h: int,
+        kernel_w: int,
+        stride_h: int = 1,
+        stride_w: int = 1,
+        padding_h: int = 0,
+        padding_w: int = 0,
+        pool_type: str = "max",
+        activation: Optional[str] = None,
+        name: str = "",
+    ) -> Tensor:
+        return self._add(
+            "pool2d",
+            dict(
+                kernel_h=kernel_h,
+                kernel_w=kernel_w,
+                stride_h=stride_h,
+                stride_w=stride_w,
+                padding_h=padding_h,
+                padding_w=padding_w,
+                pool_type=pool_type,
+                activation=activation,
+            ),
+            [input],
+            name,
+        )
+
+    def batch_norm(self, input: Tensor, relu: bool = True, name: str = "") -> Tensor:
+        return self._add("batch_norm", dict(relu=relu), [input], name)
+
+    def layer_norm(
+        self,
+        input: Tensor,
+        axes: Sequence[int] = (-1,),
+        elementwise_affine: bool = True,
+        eps: float = 1e-5,
+        use_bias: bool = True,
+        name: str = "",
+    ) -> Tensor:
+        return self._add(
+            "layer_norm",
+            dict(
+                axes=tuple(axes),
+                elementwise_affine=elementwise_affine,
+                eps=eps,
+                use_bias=use_bias,
+            ),
+            [input],
+            name,
+        )
+
+    def rms_norm(self, input: Tensor, eps: float = 1e-6, dim: int = -1, name: str = "") -> Tensor:
+        return self._add("rms_norm", dict(eps=eps, dim=dim), [input], name)
+
+    def residual_rms_norm(
+        self, input: Tensor, residual: Tensor, eps: float = 1e-6, name: str = ""
+    ):
+        return self._add("residual_rms_norm", dict(eps=eps), [input, residual], name)
+
+    def residual_layer_norm(
+        self,
+        input: Tensor,
+        residual1: Tensor,
+        residual2: Optional[Tensor] = None,
+        eps: float = 1e-5,
+        elementwise_affine: bool = True,
+        use_bias: bool = True,
+        name: str = "",
+    ):
+        inputs = [input, residual1] + ([residual2] if residual2 is not None else [])
+        return self._add(
+            "residual_layer_norm",
+            dict(eps=eps, elementwise_affine=elementwise_affine, use_bias=use_bias),
+            inputs,
+            name,
+        )
+
+    def add_bias_residual_layer_norm(
+        self, input: Tensor, residual: Tensor, eps: float = 1e-5, name: str = ""
+    ):
+        return self._add(
+            "add_bias_residual_layer_norm", dict(eps=eps), [input, residual], name
+        )
+
+    def sigmoid_silu_multi(self, x1: Tensor, x2: Tensor, name: str = "") -> Tensor:
+        return self._add("sigmoid_silu_multi", {}, [x1, x2], name)
+
+    def multihead_attention(
+        self,
+        query: Tensor,
+        key: Tensor,
+        value: Tensor,
+        embed_dim: int,
+        num_heads: int,
+        kdim: int = 0,
+        vdim: int = 0,
+        dropout: float = 0.0,
+        bias: bool = True,
+        causal: bool = False,
+        name: str = "",
+    ) -> Tensor:
+        return self._add(
+            "multihead_attention",
+            dict(
+                embed_dim=embed_dim,
+                num_heads=num_heads,
+                kdim=kdim or None,
+                vdim=vdim or None,
+                dropout=dropout,
+                bias=bias,
+                causal=causal,
+            ),
+            [query, key, value],
+            name,
+        )
+
+    def softmax(self, input: Tensor, axis: int = -1, name: str = "") -> Tensor:
+        return self._add("softmax", dict(axis=axis), [input], name)
+
+    def dropout(self, input: Tensor, rate: float = 0.5, name: str = "") -> Tensor:
+        return self._add("dropout", dict(rate=rate), [input], name)
+
+    def cast(self, input: Tensor, dtype, name: str = "") -> Tensor:
+        return self._add(
+            "cast", dict(dtype=DataType.from_any(dtype).value), [input], name
+        )
+
+    def concat(self, tensors: Sequence[Tensor], axis: int = 0, name: str = "") -> Tensor:
+        return self._add("concat", dict(axis=axis), list(tensors), name)
+
+    def split(self, input: Tensor, sizes: Sequence[int], axis: int = 0, name: str = ""):
+        return self._add("split", dict(sizes=tuple(sizes), axis=axis), [input], name)
+
+    def reshape(self, input: Tensor, shape: Sequence[int], name: str = "") -> Tensor:
+        return self._add("reshape", dict(shape=tuple(shape)), [input], name)
+
+    def transpose(self, input: Tensor, perm: Sequence[int], name: str = "") -> Tensor:
+        return self._add("transpose", dict(perm=tuple(perm)), [input], name)
+
+    def reverse(self, input: Tensor, axis: int = 0, name: str = "") -> Tensor:
+        return self._add("reverse", dict(axis=axis), [input], name)
+
+    def flat(self, input: Tensor, name: str = "") -> Tensor:
+        return self._add("flat", {}, [input], name)
+
+    def reduce_sum(
+        self, input: Tensor, axes: Sequence[int], keepdims: bool = False, name: str = ""
+    ) -> Tensor:
+        return self._add(
+            "reduce", dict(op="sum", axes=tuple(axes), keepdims=keepdims), [input], name
+        )
+
+    def mean(
+        self, input: Tensor, axes: Sequence[int], keepdims: bool = False, name: str = ""
+    ) -> Tensor:
+        return self._add(
+            "reduce", dict(op="mean", axes=tuple(axes), keepdims=keepdims), [input], name
+        )
+
+    def gather(self, input: Tensor, index: Tensor, axis: int = -1, name: str = "") -> Tensor:
+        return self._add("gather", dict(axis=axis), [input, index], name)
+
+    def batch_matmul(self, a: Tensor, b: Tensor, name: str = "") -> Tensor:
+        return self._add("batch_matmul", {}, [a, b], name)
+
+    # elementwise builders
+    def _unary(self, op, input, name="", scalar=None):
+        attrs = {"op": op}
+        if scalar is not None:
+            attrs["scalar"] = scalar
+        return self._add("element_unary", attrs, [input], name)
+
+    def _binary(self, op, a, b, name=""):
+        return self._add("element_binary", dict(op=op), [a, b], name)
+
+    def relu(self, x, name=""):
+        return self._unary("relu", x, name)
+
+    def sigmoid(self, x, name=""):
+        return self._unary("sigmoid", x, name)
+
+    def tanh(self, x, name=""):
+        return self._unary("tanh", x, name)
+
+    def elu(self, x, name=""):
+        return self._unary("elu", x, name)
+
+    def gelu(self, x, name=""):
+        return self._unary("gelu", x, name)
+
+    def identity(self, x, name=""):
+        return self._unary("identity", x, name)
+
+    def exp(self, x, name=""):
+        return self._unary("exp", x, name)
+
+    def sin(self, x, name=""):
+        return self._unary("sin", x, name)
+
+    def cos(self, x, name=""):
+        return self._unary("cos", x, name)
+
+    def pow(self, x, exponent, name=""):
+        return self._unary("pow", x, name, scalar=exponent)
+
+    def scalar_multiply(self, x, scalar, name=""):
+        return self._unary("scalar_multiply", x, name, scalar=scalar)
+
+    def scalar_add(self, x, scalar, name=""):
+        return self._unary("scalar_add", x, name, scalar=scalar)
+
+    def scalar_sub(self, x, scalar, name=""):
+        return self._unary("scalar_sub", x, name, scalar=scalar)
+
+    def scalar_truediv(self, x, scalar, name=""):
+        return self._unary("scalar_truediv", x, name, scalar=scalar)
+
+    def add(self, a, b, name=""):
+        return self._binary("add", a, b, name)
+
+    def subtract(self, a, b, name=""):
+        return self._binary("subtract", a, b, name)
+
+    def multiply(self, a, b, name=""):
+        return self._binary("multiply", a, b, name)
+
+    def divide(self, a, b, name=""):
+        return self._binary("divide", a, b, name)
+
+    def max(self, a, b, name=""):
+        return self._binary("max", a, b, name)
+
+    def min(self, a, b, name=""):
+        return self._binary("min", a, b, name)
+
+    # ------------------------------------------------------------------
+    # execution
+
+    def _node_attrs(self, node: OpNode) -> Dict[str, Any]:
+        d = node.attrs_dict
+        d["_node"] = node.id
+        return d
+
+    def run_graph(
+        self,
+        params,
+        inputs: Dict[str, Any],
+        *,
+        training: bool,
+        rng=None,
+        state=None,
+        upto: Optional[TensorRef] = None,
+        batch_meta=None,
+    ):
+        """Interpret the graph — the analog of the reference's per-op task
+        launch loop (``FFModel::forward``, reference ``model.cc:2782``),
+        except the whole loop is traced into one XLA program under jit."""
+        ctx = OpContext(
+            training=training,
+            rng=rng,
+            mesh=self.mesh,
+            state=state or {},
+            state_updates={} if training else None,
+            batch_meta=batch_meta,
+        )
+        vals: Dict[Tuple[int, int], Any] = {}
+        target = upto.node_id if upto is not None else len(self.graph.nodes) - 1
+        for node in self.graph.nodes:
+            if node.id > target:
+                break
+            if node.op_type == "input":
+                if node.name not in inputs:
+                    raise KeyError(f"missing input {node.name!r}")
+                vals[(node.id, 0)] = inputs[node.name]
+                continue
+            op = get_op(node.op_type)
+            in_vals = [vals[(r.node_id, r.out_idx)] for r in node.inputs]
+            outs = op.forward(
+                params.get(node.name, {}), in_vals, self._node_attrs(node), ctx
+            )
+            for i, o in enumerate(outs):
+                vals[(node.id, i)] = o
+        out_ref = upto if upto is not None else TensorRef(target, 0)
+        return vals[(out_ref.node_id, out_ref.out_idx)], (ctx.state_updates or {})
+
+    def init_params(self, key=None):
+        key = key if key is not None else jax.random.PRNGKey(self.seed)
+        params = {}
+        for node in self.graph.nodes:
+            if node.op_type == "input":
+                continue
+            op = get_op(node.op_type)
+            in_specs = [self.graph.out_spec(r) for r in node.inputs]
+            w = op.init(jax.random.fold_in(key, node.id), in_specs, node.attrs_dict)
+            if w:
+                params[node.name] = w
+        return params
+
+    def init_state(self):
+        state = {}
+        for node in self.graph.nodes:
+            op = get_op(node.op_type)
+            fn = getattr(op, "init_state", None)
+            if fn is None:
+                continue
+            in_specs = [self.graph.out_spec(r) for r in node.inputs]
+            st = fn(in_specs, node.attrs_dict)
+            if st:
+                state[node.id] = st
+        return state
+
+    # ------------------------------------------------------------------
+    # compile
+
+    def _make_mesh(self) -> Mesh:
+        spec = self.config.machine_spec()
+        return spec.make_mesh()
+
+    def _param_shardings(self):
+        """PartitionSpec tree matching params, from per-op TP rules (or the
+        parallelize pass's overrides)."""
+        if self._param_pspecs is not None:
+            return self._param_pspecs
+        pspecs = {}
+        for node in self.graph.nodes:
+            if node.op_type == "input":
+                continue
+            op = get_op(node.op_type)
+            in_specs = [self.graph.out_spec(r) for r in node.inputs]
+            w = jax.eval_shape(
+                lambda: op.init(jax.random.PRNGKey(0), in_specs, node.attrs_dict)
+            )
+            if w:
+                pspecs[node.name] = op.weight_pspecs(
+                    in_specs, node.attrs_dict, MODEL_AXIS
+                )
+        return pspecs
+
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type: str = "sparse_categorical_crossentropy",
+        metrics: Sequence[str] = ("accuracy",),
+        comp_mode: str = TRAINING,
+        output: Optional[Tensor] = None,
+    ):
+        """Lower the graph to jitted step functions (reference
+        ``FFModel::compile``, model.cc:3314). The Unity search is replaced
+        for now by the config's explicit degrees; the search module can
+        override ``_param_pspecs`` with a found strategy."""
+        self.optimizer = optimizer or SGDOptimizer(lr=self.config.learning_rate)
+        self.loss_type = loss_type
+        self.metrics_names = tuple(metrics)
+        self.mesh = self._make_mesh()
+        self._output_ref = output.ref if output is not None else TensorRef(
+            len(self.graph.nodes) - 1, 0
+        )
+
+        # The reference asserts CE losses consume a softmax op's output and
+        # differentiates through probabilities; mirror that by detecting an
+        # explicit softmax sink (loss_functions.cc:121-200).
+        out_node = self.graph.nodes[self._output_ref.node_id]
+        from_logits = out_node.op_type != "softmax"
+        loss_fn = get_loss(loss_type, from_logits=from_logits)
+        sparse = "sparse" in loss_type
+        mesh = self.mesh
+
+        param_pspecs = self._param_shardings()
+
+        def to_sharding(tree_pspecs):
+            return jax.tree.map(
+                lambda p: NamedSharding(mesh, p),
+                tree_pspecs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+        # ---- initialise params/opt-state on device, sharded ----
+        init_key = jax.random.PRNGKey(self.seed)
+        with jax.set_mesh(mesh):
+            params_shardings = to_sharding(param_pspecs)
+            self.params = jax.jit(
+                self.init_params, out_shardings=params_shardings
+            )(init_key)
+            self.model_state = self.init_state()
+            self.opt_state = self.optimizer.init(self.params)
+
+        data_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        repl = NamedSharding(mesh, P())
+        opt = self.optimizer
+
+        def train_step(params, opt_state, state, rng, inputs, labels):
+            def lossf(p):
+                preds, st_up = self.run_graph(
+                    p,
+                    inputs,
+                    training=True,
+                    rng=rng,
+                    state=state,
+                    upto=self._output_ref,
+                )
+                return loss_fn(preds, labels), (preds, st_up)
+
+            (loss, (preds, st_up)), grads = jax.value_and_grad(
+                lossf, has_aux=True
+            )(params)
+            new_params, new_opt = opt.update(grads, opt_state, params)
+            new_state = dict(state)
+            new_state.update(st_up)
+            mvals = compute_metrics(
+                self.metrics_names, preds, labels, sparse_labels=sparse,
+                from_logits=from_logits,
+            )
+            return new_params, new_opt, new_state, loss, mvals
+
+        def eval_step(params, state, inputs, labels):
+            preds, _ = self.run_graph(
+                params, inputs, training=False, state=state, upto=self._output_ref
+            )
+            loss = loss_fn(preds, labels)
+            mvals = compute_metrics(
+                self.metrics_names, preds, labels, sparse_labels=sparse,
+                from_logits=from_logits,
+            )
+            return loss, mvals
+
+        def fwd(params, state, inputs):
+            preds, _ = self.run_graph(
+                params, inputs, training=False, state=state, upto=self._output_ref
+            )
+            return preds
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._eval_step = jax.jit(eval_step)
+        self._fwd = jax.jit(fwd)
+        self._data_sharding = data_sharding
+        return self
+
+    # ------------------------------------------------------------------
+    # data feeding + loops
+
+    def _input_names(self) -> List[str]:
+        return [self.graph.nodes[i].name for i in self.input_nodes]
+
+    def _shard_batch(self, arrays: Dict[str, np.ndarray]):
+        out = {}
+        for k, v in arrays.items():
+            spec = P(DATA_AXIS) if np.ndim(v) >= 1 else P()
+            out[k] = jax.device_put(v, NamedSharding(self.mesh, spec))
+        return out
+
+    def fit(
+        self,
+        x: Union[np.ndarray, Dict[str, np.ndarray]],
+        y: np.ndarray,
+        batch_size: Optional[int] = None,
+        epochs: Optional[int] = None,
+        shuffle: bool = True,
+        verbose: bool = True,
+    ) -> PerfMetrics:
+        """Training loop (reference ``FFModel.fit``, flexflow_cffi.py:3537)."""
+        assert self._train_step is not None, "call compile() first"
+        bs = batch_size or self.config.batch_size
+        epochs = epochs or self.config.epochs
+        names = self._input_names()
+        if not isinstance(x, dict):
+            x = {names[0]: x}
+        n = len(y)
+        steps = n // bs
+        rng = np.random.default_rng(self.seed)
+        perf = PerfMetrics()
+        with jax.set_mesh(self.mesh):
+            for epoch in range(epochs):
+                order = rng.permutation(n) if shuffle else np.arange(n)
+                perf = PerfMetrics()
+                for s in range(steps):
+                    idx = order[s * bs : (s + 1) * bs]
+                    batch = self._shard_batch({k: v[idx] for k, v in x.items()})
+                    yb = self._shard_batch({"y": y[idx]})["y"]
+                    step_rng = jax.random.PRNGKey(
+                        self.seed * 1000003 + self._step_count
+                    )
+                    (
+                        self.params,
+                        self.opt_state,
+                        self.model_state,
+                        loss,
+                        mvals,
+                    ) = self._train_step(
+                        self.params,
+                        self.opt_state,
+                        self.model_state,
+                        step_rng,
+                        batch,
+                        yb,
+                    )
+                    self._step_count += 1
+                    perf.update(jax.device_get(loss), jax.device_get(mvals))
+                if verbose:
+                    print(f"epoch {epoch}: {perf.report()}")
+        return perf
+
+    def evaluate(
+        self,
+        x: Union[np.ndarray, Dict[str, np.ndarray]],
+        y: np.ndarray,
+        batch_size: Optional[int] = None,
+    ) -> Dict[str, float]:
+        assert self._eval_step is not None, "call compile() first"
+        bs = batch_size or self.config.batch_size
+        names = self._input_names()
+        if not isinstance(x, dict):
+            x = {names[0]: x}
+        n = len(y)
+        perf = PerfMetrics()
+        with jax.set_mesh(self.mesh):
+            for s in range(n // bs):
+                sl = slice(s * bs, (s + 1) * bs)
+                batch = self._shard_batch({k: v[sl] for k, v in x.items()})
+                yb = self._shard_batch({"y": y[sl]})["y"]
+                loss, mvals = self._eval_step(
+                    self.params, self.model_state, batch, yb
+                )
+                perf.update(jax.device_get(loss), jax.device_get(mvals))
+        return perf.averages()
+
+    def forward(self, inputs: Union[np.ndarray, Dict[str, Any]]):
+        assert self._fwd is not None, "call compile() first"
+        if not isinstance(inputs, dict):
+            inputs = {self._input_names()[0]: inputs}
+        with jax.set_mesh(self.mesh):
+            return self._fwd(self.params, self.model_state, inputs)
+
+    # ------------------------------------------------------------------
+    # weight access (reference ParallelTensorBase::get_tensor/set_tensor)
+
+    def get_weights(self, layer_name: str):
+        return jax.device_get(self.params[layer_name])
+
+    def set_weights(self, layer_name: str, weights: Dict[str, np.ndarray]):
+        cur = self.params[layer_name]
+        self.params[layer_name] = jax.tree.map(
+            lambda c, w: jax.device_put(jnp.asarray(w, c.dtype), c.sharding),
+            cur,
+            dict(weights),
+        )

@@ -1,0 +1,4 @@
+from .registry import OpDef, OpContext, register, get_op, all_ops
+from . import core  # noqa: F401  (registers core ops)
+
+__all__ = ["OpDef", "OpContext", "register", "get_op", "all_ops"]

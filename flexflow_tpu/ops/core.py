@@ -1,0 +1,900 @@
+"""Core operator library.
+
+TPU-native equivalents of the reference's compute operators
+(reference ``src/ops/`` — 127 files of Legion glue + CUDA/HIP kernels,
+SURVEY.md §2.1). Each reference op's ``forward_kernel`` becomes a pure
+jnp/lax function that XLA fuses and tiles onto the MXU/VPU; backward
+passes come from autodiff instead of hand-written ``backward_kernel``s.
+
+Layout conventions follow the reference's logical shapes (NCHW convs,
+``(batch, seq, hidden)`` transformers) so frontends translate 1:1; XLA's
+TPU layout assignment picks the physical layout.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.dtypes import DataType
+from ..core.tensor import TensorSpec
+from .. import initializers as ffinit
+from .registry import OpDef, OpContext, register
+
+
+def _act(x, activation):
+    """Fused activation epilogue (reference fuses these into cuBLAS/cuDNN
+    calls; XLA fuses them into the matmul epilogue on TPU)."""
+    if activation in (None, "", "identity"):
+        return x
+    if activation == "relu":
+        return jax.nn.relu(x)
+    if activation == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if activation == "tanh":
+        return jnp.tanh(x)
+    if activation == "gelu":
+        return jax.nn.gelu(x)
+    if activation == "elu":
+        return jax.nn.elu(x)
+    if activation == "silu":
+        return jax.nn.silu(x)
+    raise ValueError(f"unknown activation {activation!r}")
+
+
+def _wdt(weights, x):
+    """Cast weights to the activation dtype (bf16 compute path)."""
+    if weights is None:
+        return None
+    return jax.tree.map(lambda w: w.astype(x.dtype) if jnp.issubdtype(w.dtype, jnp.floating) else w, weights)
+
+
+# ---------------------------------------------------------------------------
+# Placeholders
+
+
+@register
+class InputOp(OpDef):
+    """INPUT placeholder — reference NoOp (src/ops/noop.cc)."""
+
+    type = "input"
+
+    def infer(self, in_specs, attrs):
+        return [TensorSpec(tuple(attrs["shape"]), attrs["dtype"])]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        raise RuntimeError("input nodes are fed, not executed")
+
+
+@register
+class WeightOp(OpDef):
+    """WEIGHT placeholder node (standalone trainable tensor)."""
+
+    type = "weight"
+
+    def infer(self, in_specs, attrs):
+        return [TensorSpec(tuple(attrs["shape"]), attrs["dtype"])]
+
+    def init(self, key, in_specs, attrs):
+        init = ffinit.resolve(attrs.get("initializer"), ffinit.GlorotUniform())
+        dt = DataType.from_any(attrs["dtype"]).jnp_dtype
+        return {"w": init(key, tuple(attrs["shape"]), dt)}
+
+    def forward(self, weights, inputs, attrs, ctx):
+        return [weights["w"]]
+
+
+# ---------------------------------------------------------------------------
+# Dense / embedding / matmul
+
+
+@register
+class DenseOp(OpDef):
+    """Linear layer — reference ``src/ops/linear.cc:1-1617`` (cuBLAS GEMM +
+    activation + replica-aware weight sharding). TP sharding is declared
+    via the ``tp_shard`` attr set by the Megatron rewrite pass:
+    'col' shards out_dim, 'row' shards in_dim (output left unreduced for a
+    following all-reduce, like the reference's row-parallel Linear +
+    Reduction pair)."""
+
+    type = "dense"
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        out = x.shape[:-1] + (attrs["out_dim"],)
+        return [TensorSpec(out, x.dtype)]
+
+    def init(self, key, in_specs, attrs):
+        (x,) = in_specs
+        in_dim, out_dim = x.shape[-1], attrs["out_dim"]
+        kinit = ffinit.resolve(attrs.get("kernel_initializer"), ffinit.GlorotUniform())
+        binit = ffinit.resolve(attrs.get("bias_initializer"), ffinit.Zero())
+        kk, kb = jax.random.split(key)
+        dt = x.jnp_dtype
+        w = {"kernel": kinit(kk, (in_dim, out_dim), dt)}
+        if attrs.get("use_bias", True):
+            w["bias"] = binit(kb, (out_dim,), dt)
+        return w
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        w = _wdt(weights, x)
+        y = jnp.matmul(x, w["kernel"], preferred_element_type=jnp.float32)
+        y = y.astype(x.dtype)
+        if "bias" in w:
+            y = y + w["bias"]
+        return [_act(y, attrs.get("activation"))]
+
+    def weight_pspecs(self, in_specs, attrs, model_axis):
+        tp = attrs.get("tp_shard")
+        if tp == "col":
+            specs = {"kernel": P(None, model_axis)}
+            if attrs.get("use_bias", True):
+                specs["bias"] = P(model_axis)
+        elif tp == "row":
+            specs = {"kernel": P(model_axis, None)}
+            if attrs.get("use_bias", True):
+                specs["bias"] = P()
+        else:
+            specs = {"kernel": P()}
+            if attrs.get("use_bias", True):
+                specs["bias"] = P()
+        return specs
+
+    def flops(self, in_specs, attrs):
+        (x,) = in_specs
+        return 2 * x.num_elements * attrs["out_dim"]
+
+
+@register
+class EmbeddingOp(OpDef):
+    """Token embedding — reference ``src/ops/embedding.cc`` with aggr modes
+    none/sum/avg."""
+
+    type = "embedding"
+
+    def infer(self, in_specs, attrs):
+        (idx,) = in_specs
+        aggr = attrs.get("aggr", "none")
+        if aggr == "none":
+            out = idx.shape + (attrs["out_dim"],)
+        else:  # sum/avg pool the bag dimension (last)
+            out = idx.shape[:-1] + (attrs["out_dim"],)
+        return [TensorSpec(out, attrs.get("dtype", DataType.FLOAT))]
+
+    def init(self, key, in_specs, attrs):
+        init = ffinit.resolve(
+            attrs.get("kernel_initializer"), ffinit.Normal(stddev=0.02)
+        )
+        dt = DataType.from_any(attrs.get("dtype", DataType.FLOAT)).jnp_dtype
+        return {"table": init(key, (attrs["num_entries"], attrs["out_dim"]), dt)}
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (idx,) = inputs
+        table = weights["table"]
+        emb = jnp.take(table, idx.astype(jnp.int32), axis=0)
+        aggr = attrs.get("aggr", "none")
+        if aggr == "sum":
+            emb = emb.sum(axis=-2)
+        elif aggr == "avg":
+            emb = emb.mean(axis=-2)
+        return [emb]
+
+    def weight_pspecs(self, in_specs, attrs, model_axis):
+        if attrs.get("tp_shard") == "col":
+            return {"table": P(None, model_axis)}
+        return {"table": P()}
+
+    def flops(self, in_specs, attrs):
+        return in_specs[0].num_elements * attrs["out_dim"]
+
+
+@register
+class BatchMatmulOp(OpDef):
+    """Batched matmul — reference ``src/ops/batch_matmul.cc`` (with
+    ``a_seq_length_dim`` used for variable-length training batches;
+    reference ``model.h:581-585``). Static shapes on TPU: sequence
+    truncation is handled by masking upstream rather than dynamic K."""
+
+    type = "batch_matmul"
+
+    def infer(self, in_specs, attrs):
+        a, b = in_specs
+        out = a.shape[:-1] + (b.shape[-1],)
+        return [TensorSpec(out, a.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        a, b = inputs
+        y = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        return [y.astype(a.dtype)]
+
+    def flops(self, in_specs, attrs):
+        a, b = in_specs
+        return 2 * a.num_elements * b.shape[-1]
+
+
+# ---------------------------------------------------------------------------
+# Convolution stack
+
+
+@register
+class Conv2DOp(OpDef):
+    """2-D convolution (NCHW/OIHW logical layout like the reference's cuDNN
+    path, ``src/ops/conv_2d.cc``); XLA re-lays-out for TPU."""
+
+    type = "conv2d"
+
+    def _geom(self, x_shape, attrs):
+        kh, kw = attrs["kernel_h"], attrs["kernel_w"]
+        sh, sw = attrs.get("stride_h", 1), attrs.get("stride_w", 1)
+        ph, pw = attrs.get("padding_h", 0), attrs.get("padding_w", 0)
+        n, c, h, wdim = x_shape
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (wdim + 2 * pw - kw) // sw + 1
+        return (kh, kw, sh, sw, ph, pw, n, c, oh, ow)
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        kh, kw, sh, sw, ph, pw, n, c, oh, ow = self._geom(x.shape, attrs)
+        return [TensorSpec((n, attrs["out_channels"], oh, ow), x.dtype)]
+
+    def init(self, key, in_specs, attrs):
+        (x,) = in_specs
+        groups = attrs.get("groups", 1)
+        cin = x.shape[1] // groups
+        kinit = ffinit.resolve(attrs.get("kernel_initializer"), ffinit.GlorotUniform())
+        binit = ffinit.resolve(attrs.get("bias_initializer"), ffinit.Zero())
+        kk, kb = jax.random.split(key)
+        dt = x.jnp_dtype
+        w = {
+            "kernel": kinit(
+                kk, (attrs["out_channels"], cin, attrs["kernel_h"], attrs["kernel_w"]), dt
+            )
+        }
+        if attrs.get("use_bias", True):
+            w["bias"] = binit(kb, (attrs["out_channels"],), dt)
+        return w
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        w = _wdt(weights, x)
+        sh, sw = attrs.get("stride_h", 1), attrs.get("stride_w", 1)
+        ph, pw = attrs.get("padding_h", 0), attrs.get("padding_w", 0)
+        y = lax.conv_general_dilated(
+            x,
+            w["kernel"],
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=attrs.get("groups", 1),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        if "bias" in w:
+            y = y + w["bias"][None, :, None, None]
+        return [_act(y, attrs.get("activation"))]
+
+    def flops(self, in_specs, attrs):
+        (x,) = in_specs
+        _, _, _, _, _, _, n, c, oh, ow = self._geom(x.shape, attrs)
+        groups = attrs.get("groups", 1)
+        return (
+            2 * n * attrs["out_channels"] * oh * ow
+            * (c // groups) * attrs["kernel_h"] * attrs["kernel_w"]
+        )
+
+
+@register
+class Pool2DOp(OpDef):
+    """Max/avg pooling — reference ``src/ops/pool_2d.cc``."""
+
+    type = "pool2d"
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        kh, kw = attrs["kernel_h"], attrs["kernel_w"]
+        sh, sw = attrs.get("stride_h", 1), attrs.get("stride_w", 1)
+        ph, pw = attrs.get("padding_h", 0), attrs.get("padding_w", 0)
+        n, c, h, w = x.shape
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        return [TensorSpec((n, c, oh, ow), x.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        kh, kw = attrs["kernel_h"], attrs["kernel_w"]
+        sh, sw = attrs.get("stride_h", 1), attrs.get("stride_w", 1)
+        ph, pw = attrs.get("padding_h", 0), attrs.get("padding_w", 0)
+        dims = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if attrs.get("pool_type", "max") == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+            y = s / (kh * kw)
+        return [_act(y.astype(x.dtype), attrs.get("activation"))]
+
+
+@register
+class FlatOp(OpDef):
+    """(N, C, H, W) → (N, C*H*W) — reference ``src/ops/flat.cc``."""
+
+    type = "flat"
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        n = x.shape[0]
+        rest = 1
+        for d in x.shape[1:]:
+            rest *= d
+        return [TensorSpec((n, rest), x.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        return [x.reshape(x.shape[0], -1)]
+
+
+# ---------------------------------------------------------------------------
+# Normalisation
+
+
+@register
+class BatchNormOp(OpDef):
+    """BatchNorm over NCHW channel dim — reference ``src/ops/batch_norm.cc``.
+    Running stats live in the model's non-trainable state collection and
+    are updated outside the gradient path."""
+
+    type = "batch_norm"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def init(self, key, in_specs, attrs):
+        c = in_specs[0].shape[1]
+        dt = in_specs[0].jnp_dtype
+        return {"scale": jnp.ones((c,), dt), "bias": jnp.zeros((c,), dt)}
+
+    def init_state(self, in_specs, attrs):
+        c = in_specs[0].shape[1]
+        return {
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32),
+        }
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        eps = attrs.get("eps", 1e-5)
+        momentum = attrs.get("momentum", 0.9)
+        st = ctx.state[attrs["_node"]] if ctx.state else self.init_state(
+            [TensorSpec(x.shape, x.dtype)], attrs
+        )
+        axes = (0, 2, 3) if x.ndim == 4 else (0,)
+        if ctx.training:
+            xf = x.astype(jnp.float32)
+            mean = xf.mean(axis=axes)
+            var = xf.var(axis=axes)
+            if ctx.state_updates is not None:
+                ctx.state_updates[attrs["_node"]] = {
+                    "mean": momentum * st["mean"] + (1 - momentum) * lax.stop_gradient(mean),
+                    "var": momentum * st["var"] + (1 - momentum) * lax.stop_gradient(var),
+                }
+        else:
+            mean, var = st["mean"], st["var"]
+        shape = (1, -1, 1, 1) if x.ndim == 4 else (1, -1)
+        inv = lax.rsqrt(var + eps).reshape(shape).astype(x.dtype)
+        mean = mean.reshape(shape).astype(x.dtype)
+        y = (x - mean) * inv * weights["scale"].reshape(shape) + weights[
+            "bias"
+        ].reshape(shape)
+        if attrs.get("relu", True):
+            y = jax.nn.relu(y)
+        return [y]
+
+
+def _layer_norm(x, gamma, beta, eps, axes=(-1,)):
+    axes = tuple(a % x.ndim for a in axes)
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=axes, keepdims=True)
+    var = xf.var(axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    y = y.astype(x.dtype)
+    bshape = tuple(x.shape[i] if i in axes else 1 for i in range(x.ndim))
+    if gamma is not None:
+        y = y * gamma.reshape(bshape)
+    if beta is not None:
+        y = y + beta.reshape(bshape)
+    return y
+
+
+def _rms_norm(x, gamma, eps):
+    xf = x.astype(jnp.float32)
+    rms = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return ((xf * rms).astype(x.dtype)) * gamma
+
+
+@register
+class LayerNormOp(OpDef):
+    """reference ``src/ops/layer_norm.cc`` (last-dim normalisation)."""
+
+    type = "layer_norm"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def _norm_shape(self, spec, attrs):
+        ndim = spec.ndim
+        axes = tuple(a % ndim for a in attrs.get("axes", (-1,)))
+        return tuple(spec.shape[a] for a in sorted(axes))
+
+    def init(self, key, in_specs, attrs):
+        if not attrs.get("elementwise_affine", True):
+            return {}
+        shape = self._norm_shape(in_specs[0], attrs)
+        dt = in_specs[0].jnp_dtype
+        w = {"gamma": jnp.ones(shape, dt)}
+        if attrs.get("use_bias", True):
+            w["beta"] = jnp.zeros(shape, dt)
+        return w
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        w = _wdt(weights, x)
+        return [
+            _layer_norm(
+                x,
+                w.get("gamma"),
+                w.get("beta"),
+                attrs.get("eps", 1e-5),
+                axes=tuple(attrs.get("axes", (-1,))),
+            )
+        ]
+
+
+@register
+class RMSNormOp(OpDef):
+    """reference ``src/ops/rms_norm.cc``."""
+
+    type = "rms_norm"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def init(self, key, in_specs, attrs):
+        d = in_specs[0].shape[-1]
+        return {"gamma": jnp.ones((d,), in_specs[0].jnp_dtype)}
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        w = _wdt(weights, x)
+        return [_rms_norm(x, w["gamma"], attrs.get("eps", 1e-6))]
+
+
+@register
+class ResidualRMSNormOp(OpDef):
+    """Fused residual-add + RMSNorm, two outputs (sum, normed) — reference
+    ``src/ops/residual_rms_norm.cc``."""
+
+    type = "residual_rms_norm"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0], in_specs[0]]
+
+    def init(self, key, in_specs, attrs):
+        d = in_specs[0].shape[-1]
+        return {"gamma": jnp.ones((d,), in_specs[0].jnp_dtype)}
+
+    def forward(self, weights, inputs, attrs, ctx):
+        x, res = inputs
+        w = _wdt(weights, x)
+        s = x + res
+        return [s, _rms_norm(s, w["gamma"], attrs.get("eps", 1e-6))]
+
+
+@register
+class ResidualLayerNormOp(OpDef):
+    """Fused residual-add(s) + LayerNorm — reference
+    ``src/ops/residual_layer_norm.cc``."""
+
+    type = "residual_layer_norm"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0], in_specs[0]]
+
+    def init(self, key, in_specs, attrs):
+        if not attrs.get("elementwise_affine", True):
+            return {}
+        d = in_specs[0].shape[-1]
+        dt = in_specs[0].jnp_dtype
+        w = {"gamma": jnp.ones((d,), dt)}
+        if attrs.get("use_bias", True):
+            w["beta"] = jnp.zeros((d,), dt)
+        return w
+
+    def forward(self, weights, inputs, attrs, ctx):
+        x = inputs[0]
+        s = x
+        for r in inputs[1:]:
+            s = s + r
+        w = _wdt(weights, x)
+        return [s, _layer_norm(s, w.get("gamma"), w.get("beta"), attrs.get("eps", 1e-5))]
+
+
+@register
+class AddBiasResidualLayerNormOp(OpDef):
+    """reference ``src/ops/add_bias_residual_layer_norm.cc``: out = LN(x +
+    attn_out_bias + residual)."""
+
+    type = "add_bias_residual_layer_norm"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0], in_specs[0]]
+
+    def init(self, key, in_specs, attrs):
+        d = in_specs[0].shape[-1]
+        dt = in_specs[0].jnp_dtype
+        w = {"attn_bias": jnp.zeros((d,), dt)}
+        if attrs.get("elementwise_affine", True):
+            w["gamma"] = jnp.ones((d,), dt)
+            if attrs.get("use_bias", True):
+                w["beta"] = jnp.zeros((d,), dt)
+        return w
+
+    def forward(self, weights, inputs, attrs, ctx):
+        x, res = inputs
+        w = _wdt(weights, x)
+        s = x + w["attn_bias"] + res
+        return [s, _layer_norm(s, w.get("gamma"), w.get("beta"), attrs.get("eps", 1e-5))]
+
+
+@register
+class SigmoidSiluMultiOp(OpDef):
+    """SwiGLU glue: silu(x1) * x2 — reference ``src/ops/sigmoid_silu_multi.cc``."""
+
+    type = "sigmoid_silu_multi"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        x1, x2 = inputs
+        return [jax.nn.silu(x1) * x2]
+
+
+# ---------------------------------------------------------------------------
+# Elementwise / shape ops
+
+
+_UNARY = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "identity": lambda x: x,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt,
+    "negative": jnp.negative,
+}
+
+_UNARY_SCALAR = {
+    "scalar_multiply": lambda x, s: x * s,
+    "scalar_add": lambda x, s: x + s,
+    "scalar_sub": lambda x, s: x - s,
+    "scalar_truediv": lambda x, s: x / s,
+    "pow": lambda x, s: jnp.power(x, s),
+}
+
+
+@register
+class ElementUnaryOp(OpDef):
+    """reference ``src/ops/element_unary.cc``."""
+
+    type = "element_unary"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        op = attrs["op"]
+        if op in _UNARY:
+            return [_UNARY[op](x)]
+        return [_UNARY_SCALAR[op](x, attrs["scalar"])]
+
+
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+}
+
+
+@register
+class ElementBinaryOp(OpDef):
+    """reference ``src/ops/element_binary.cc`` (broadcasting ew ops)."""
+
+    type = "element_binary"
+
+    def infer(self, in_specs, attrs):
+        a, b = in_specs
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        return [TensorSpec(shape, a.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        a, b = inputs
+        return [_BINARY[attrs["op"]](a, b)]
+
+
+@register
+class SoftmaxOp(OpDef):
+    """reference ``src/ops/softmax.cc``."""
+
+    type = "softmax"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        return [jax.nn.softmax(x, axis=attrs.get("axis", -1))]
+
+
+@register
+class DropoutOp(OpDef):
+    """reference ``src/ops/dropout.cc`` (cuDNN dropout); here a jax.random
+    mask keyed per-node from the step rng."""
+
+    type = "dropout"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        rate = attrs.get("rate", 0.5)
+        if not ctx.training or rate <= 0.0:
+            return [x]
+        rng = ctx.fold_rng(attrs["_node"])
+        keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+        return [jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)]
+
+
+@register
+class CastOp(OpDef):
+    type = "cast"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0].with_dtype(attrs["dtype"])]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        dt = DataType.from_any(attrs["dtype"]).jnp_dtype
+        return [inputs[0].astype(dt)]
+
+
+@register
+class ConcatOp(OpDef):
+    type = "concat"
+
+    def infer(self, in_specs, attrs):
+        ax = attrs.get("axis", 0)
+        shape = list(in_specs[0].shape)
+        shape[ax] = sum(s.shape[ax] for s in in_specs)
+        return [TensorSpec(tuple(shape), in_specs[0].dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        return [jnp.concatenate(inputs, axis=attrs.get("axis", 0))]
+
+
+@register
+class SplitOp(OpDef):
+    type = "split"
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        ax = attrs.get("axis", 0)
+        out = []
+        for sz in attrs["sizes"]:
+            shape = list(x.shape)
+            shape[ax] = sz
+            out.append(TensorSpec(tuple(shape), x.dtype))
+        return out
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        ax = attrs.get("axis", 0)
+        splits = []
+        ofs = 0
+        for sz in attrs["sizes"]:
+            splits.append(lax.slice_in_dim(x, ofs, ofs + sz, axis=ax))
+            ofs += sz
+        return splits
+
+
+@register
+class ReshapeOp(OpDef):
+    type = "reshape"
+
+    def infer(self, in_specs, attrs):
+        return [TensorSpec(tuple(attrs["shape"]), in_specs[0].dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        return [inputs[0].reshape(tuple(attrs["shape"]))]
+
+
+@register
+class TransposeOp(OpDef):
+    type = "transpose"
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        perm = attrs["perm"]
+        return [TensorSpec(tuple(x.shape[p] for p in perm), x.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        return [jnp.transpose(inputs[0], attrs["perm"])]
+
+
+@register
+class ReverseOp(OpDef):
+    type = "reverse"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        return [jnp.flip(inputs[0], axis=attrs.get("axis", 0))]
+
+
+@register
+class ReduceOp(OpDef):
+    """reduce_sum / reduce_mean / reduce_max — reference ``src/ops/reduce.cc``,
+    ``mean.cc``."""
+
+    type = "reduce"
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        axes = tuple(a % x.ndim for a in attrs["axes"])
+        keep = attrs.get("keepdims", False)
+        shape = []
+        for i, d in enumerate(x.shape):
+            if i in axes:
+                if keep:
+                    shape.append(1)
+            else:
+                shape.append(d)
+        return [TensorSpec(tuple(shape), x.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        fn = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}[
+            attrs.get("op", "sum")
+        ]
+        axes = tuple(a % x.ndim for a in attrs["axes"])
+        return [fn(x, axis=axes, keepdims=attrs.get("keepdims", False))]
+
+
+@register
+class GatherOp(OpDef):
+    """take_along_axis — reference ``src/ops/gather.cc``."""
+
+    type = "gather"
+
+    def infer(self, in_specs, attrs):
+        data, idx = in_specs
+        return [TensorSpec(idx.shape, data.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        data, idx = inputs
+        return [jnp.take_along_axis(data, idx.astype(jnp.int32), axis=attrs.get("axis", -1))]
+
+
+# ---------------------------------------------------------------------------
+# Attention (training path)
+
+
+@register
+class MultiHeadAttentionOp(OpDef):
+    """Classic training MHA — reference ``src/ops/attention.cc`` (cuDNN
+    MHA). Inputs (query, key, value) shaped (B, L, D); optional causal
+    mask for decoder training (a capability the reference routes through
+    its serving ops instead)."""
+
+    type = "multihead_attention"
+
+    def infer(self, in_specs, attrs):
+        q = in_specs[0]
+        return [TensorSpec(q.shape[:-1] + (attrs["embed_dim"],), q.dtype)]
+
+    def init(self, key, in_specs, attrs):
+        d = in_specs[0].shape[-1]
+        h = attrs["num_heads"]
+        dk = attrs.get("kdim") or attrs["embed_dim"] // h
+        dv = attrs.get("vdim") or attrs["embed_dim"] // h
+        e = attrs["embed_dim"]
+        ks = jax.random.split(key, 4)
+        gi = ffinit.GlorotUniform()
+        dt = in_specs[0].jnp_dtype
+        w = {
+            "wq": gi(ks[0], (d, h * dk), dt),
+            "wk": gi(ks[1], (in_specs[1].shape[-1], h * dk), dt),
+            "wv": gi(ks[2], (in_specs[2].shape[-1], h * dv), dt),
+            "wo": gi(ks[3], (h * dv, e), dt),
+        }
+        if attrs.get("bias", True):
+            w["bq"] = jnp.zeros((h * dk,), dt)
+            w["bk"] = jnp.zeros((h * dk,), dt)
+            w["bv"] = jnp.zeros((h * dv,), dt)
+            w["bo"] = jnp.zeros((e,), dt)
+        return w
+
+    def forward(self, weights, inputs, attrs, ctx):
+        q_in, k_in, v_in = inputs
+        w = _wdt(weights, q_in)
+        h = attrs["num_heads"]
+        dk = attrs.get("kdim") or attrs["embed_dim"] // h
+        dv = attrs.get("vdim") or attrs["embed_dim"] // h
+        B, Lq, _ = q_in.shape
+        Lk = k_in.shape[1]
+
+        def proj(x, wname, bname, dd):
+            y = jnp.matmul(x, w[wname], preferred_element_type=jnp.float32).astype(x.dtype)
+            if bname in w:
+                y = y + w[bname]
+            return y.reshape(x.shape[0], x.shape[1], h, dd)
+
+        q = proj(q_in, "wq", "bq", dk)
+        k = proj(k_in, "wk", "bk", dk)
+        v = proj(v_in, "wv", "bv", dv)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) / math.sqrt(dk)
+        if attrs.get("causal", False):
+            mask = jnp.tril(jnp.ones((Lq, Lk), bool))
+            scores = jnp.where(mask[None, None], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q_in.dtype)
+        rate = attrs.get("dropout", 0.0)
+        if ctx.training and rate > 0.0:
+            rng = ctx.fold_rng(attrs["_node"])
+            keep = jax.random.bernoulli(rng, 1.0 - rate, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - rate), 0).astype(probs.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, Lq, h * dv)
+        y = jnp.matmul(o, w["wo"], preferred_element_type=jnp.float32).astype(q_in.dtype)
+        if "bo" in w:
+            y = y + w["bo"]
+        return [y]
+
+    def weight_pspecs(self, in_specs, attrs, model_axis):
+        # Head-parallel: shard the head dim (columns of wq/wk/wv, rows of wo)
+        if attrs.get("tp_shard") == "heads":
+            specs = {
+                "wq": P(None, model_axis),
+                "wk": P(None, model_axis),
+                "wv": P(None, model_axis),
+                "wo": P(model_axis, None),
+            }
+            if attrs.get("bias", True):
+                specs.update(
+                    bq=P(model_axis), bk=P(model_axis), bv=P(model_axis), bo=P()
+                )
+            return specs
+        return super().weight_pspecs(in_specs, attrs, model_axis)
+
+    def flops(self, in_specs, attrs):
+        q = in_specs[0]
+        B, Lq, D = q.shape
+        Lk = in_specs[1].shape[1]
+        e = attrs["embed_dim"]
+        return 2 * B * (Lq * D * e * 3 + Lq * Lk * e * 2 + Lq * e * e)

@@ -1,0 +1,112 @@
+"""Operator registry — the TPU-native analog of the reference's ``Op``
+base class + per-op ``Params`` structs (reference
+``include/flexflow/operator.h:75-335``, ``include/flexflow/ops/*_params.h``).
+
+Each operator is an :class:`OpDef` subclass registered by type name. An op
+contributes:
+
+  * ``infer``   — output TensorSpecs from input specs + attrs (the
+                  reference's shape inference in each op's constructor).
+  * ``init``    — weight pytree initialisation (reference per-op
+                  ``init`` Legion tasks + Initializer kernels).
+  * ``forward`` — pure function on jnp arrays; XLA fuses and lowers it to
+                  MXU/VPU code, replacing the reference's hand-written CUDA
+                  kernels under ``src/ops/kernels/``.
+  * ``weight_pspecs`` — tensor-parallel PartitionSpecs for its weights
+                  (the declarative version of the reference's
+                  ``ParallelDimMappingRecord`` registry, ``operator.h:42-73``).
+  * ``flops``   — analytic cost for the Unity-style search simulator.
+
+Ops are stateless; all state (weights, rng, KV caches) flows through
+arguments, which is what makes the whole graph jit-able as one XLA program.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from ..core.tensor import TensorSpec
+
+
+@dataclasses.dataclass
+class OpContext:
+    """Per-call execution context (training flag, dropout rng, mesh)."""
+
+    training: bool = False
+    rng: Optional[jax.Array] = None
+    mesh: Optional[Mesh] = None
+    compute_dtype: Any = jnp.float32
+    # Serving-only: BatchConfig-derived device metadata (set by the
+    # InferenceManager; None during training).
+    batch_meta: Optional[Any] = None
+    # Non-trainable state (batch-norm running stats): node_id -> pytree,
+    # read via ``state`` and written via ``state_updates`` — the functional
+    # replacement for the reference's in-place running-stat kernels.
+    state: Optional[Dict[int, Any]] = None
+    state_updates: Optional[Dict[int, Any]] = None
+
+    def fold_rng(self, node_id: int) -> Optional[jax.Array]:
+        if self.rng is None:
+            return None
+        return jax.random.fold_in(self.rng, node_id)
+
+
+class OpDef:
+    type: str = "abstract"
+
+    def infer(self, in_specs: List[TensorSpec], attrs: Dict) -> List[TensorSpec]:
+        raise NotImplementedError
+
+    def init(self, key, in_specs: List[TensorSpec], attrs: Dict) -> Dict:
+        return {}
+
+    def forward(self, weights: Dict, inputs: List, attrs: Dict, ctx: OpContext):
+        raise NotImplementedError
+
+    def weight_pspecs(
+        self, in_specs: List[TensorSpec], attrs: Dict, model_axis: str
+    ) -> Dict:
+        """PartitionSpec per weight leaf for Megatron-style TP. Default:
+        fully replicated."""
+        w = jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0), in_specs, attrs))
+        return jax.tree.map(lambda _: PartitionSpec(), w)
+
+    def flops(self, in_specs: List[TensorSpec], attrs: Dict) -> int:
+        """Forward FLOPs estimate for the search cost model."""
+        return sum(s.num_elements for s in in_specs)
+
+    # Ops that must observe/force a resharding can override this to return
+    # activation PartitionSpecs for their outputs (used by the TP pass).
+    def output_pspecs(
+        self, in_specs: List[TensorSpec], attrs: Dict, model_axis: str
+    ) -> Optional[List[PartitionSpec]]:
+        return None
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(op_cls):
+    """Class decorator: ``@register`` on an OpDef subclass."""
+    inst = op_cls()
+    if inst.type in _REGISTRY:
+        raise ValueError(f"duplicate op type {inst.type!r}")
+    _REGISTRY[inst.type] = inst
+    return op_cls
+
+
+def get_op(op_type: str) -> OpDef:
+    try:
+        return _REGISTRY[op_type]
+    except KeyError:
+        raise KeyError(
+            f"unknown op type {op_type!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def all_ops() -> Dict[str, OpDef]:
+    return dict(_REGISTRY)

@@ -1,0 +1,112 @@
+"""Optimizers: SGD (momentum/nesterov) and Adam.
+
+TPU-native equivalent of the reference Optimizer hierarchy (reference
+``include/flexflow/optimizer.h:36-110``, ``src/runtime/optimizer.cc``,
+``optimizer_kernel.cu``). The reference has two gradient-sync paths —
+parameter-server accumulation in zero-copy memory vs ``ncclAllReduce``
+then local update. Under GSPMD both collapse into one: gradients of
+replicated params are automatically all-reduced over the ``data`` mesh
+axis by XLA during the backward pass, so the optimizer here is a pure
+per-shard update rule (state and params share the same sharding).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer:
+    def init(self, params) -> Any:
+        raise NotImplementedError
+
+    def update(self, grads, opt_state, params) -> Tuple[Any, Any]:
+        """Returns (new_params, new_opt_state)."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class SGDOptimizer(Optimizer):
+    """reference ``SGDOptimizer`` (optimizer.h:36): lr, momentum, nesterov,
+    weight decay."""
+
+    lr: float = 0.01
+    momentum: float = 0.0
+    nesterov: bool = False
+    weight_decay: float = 0.0
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return {}
+        return {"v": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params):
+        wd = self.weight_decay
+
+        if self.momentum == 0.0:
+            def upd(p, g):
+                g = g + wd * p if wd else g
+                return (p - self.lr * g).astype(p.dtype)
+
+            return jax.tree.map(upd, params, grads), opt_state
+
+        def upd(p, g, v):
+            g = g + wd * p if wd else g
+            v_new = self.momentum * v + g
+            step = g + self.momentum * v_new if self.nesterov else v_new
+            return (p - self.lr * step).astype(p.dtype), v_new
+
+        flat = jax.tree.map(upd, params, grads, opt_state["v"])
+        new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, {"v": new_v}
+
+
+@dataclasses.dataclass
+class AdamOptimizer(Optimizer):
+    """reference ``AdamOptimizer`` (optimizer.h:77): bias-corrected Adam
+    with the reference's alpha_t running product formulation."""
+
+    lr: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    weight_decay: float = 0.0
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {
+            "m": jax.tree.map(zeros, params),
+            "v": jax.tree.map(zeros, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, grads, opt_state, params):
+        step = opt_state["step"] + 1
+        b1, b2 = self.beta1, self.beta2
+        # Bias-corrected step size (reference optimizer.cc next_* updates).
+        alpha_t = (
+            self.lr
+            * jnp.sqrt(1.0 - jnp.power(b2, step.astype(jnp.float32)))
+            / (1.0 - jnp.power(b1, step.astype(jnp.float32)))
+        )
+        wd = self.weight_decay
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            if wd:
+                g = g + wd * p.astype(jnp.float32)
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * g * g
+            p_new = p.astype(jnp.float32) - alpha_t * m_new / (
+                jnp.sqrt(v_new) + self.epsilon
+            )
+            return p_new.astype(p.dtype), m_new, v_new
+
+        out = jax.tree.map(upd, params, grads, opt_state["m"], opt_state["v"])
+        pick = lambda i: jax.tree.map(
+            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple)
+        )
+        return pick(0), {"m": pick(1), "v": pick(2), "step": step}

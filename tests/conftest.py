@@ -1,0 +1,21 @@
+"""Test config: force CPU with 8 virtual devices so multi-chip sharding
+paths (DP/TP/PP/SP meshes) compile and run without TPU hardware — the
+analog of the reference's single-box multinode emulation
+(reference ``tests/multinode_helpers/mpi_wrapper2.sh`` slices
+CUDA_VISIBLE_DEVICES per MPI rank)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize registers the axon TPU plugin and sets
+# jax_platforms programmatically; force CPU back for the test suite
+# (backends are not initialised yet at conftest import time).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,73 @@
+"""Unit tests for the core IR — mirrors the reference's gtest suite
+(reference ``tests/unit/``: machine views, parallel configs, hashing)."""
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu.core import (
+    DataType,
+    Graph,
+    MachineSpec,
+    TensorRef,
+    TensorSpec,
+)
+from flexflow_tpu.core.tensor import sharded
+
+
+def test_dtype_roundtrip():
+    import jax.numpy as jnp
+
+    assert DataType.from_any("float32") is DataType.FLOAT
+    assert DataType.from_any(jnp.bfloat16) is DataType.BFLOAT16
+    assert DataType.BFLOAT16.jnp_dtype == jnp.bfloat16
+    assert DataType.INT4.itemsize_bits == 4
+
+
+def test_tensor_spec():
+    ts = TensorSpec((4, 8, 16), DataType.BFLOAT16)
+    assert ts.num_elements == 512
+    assert ts.size_bytes == 1024
+    assert ts.with_shape((2, 2)).shape == (2, 2)
+
+
+def test_machine_spec_mesh():
+    spec = MachineSpec.from_degrees(8, tensor=2, pipeline=2)
+    assert spec.data == 2 and spec.model == 2 and spec.pipe == 2
+    mesh = spec.make_mesh()
+    assert mesh.shape["model"] == 2
+    assert mesh.shape["data"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_machine_spec_invalid():
+    with pytest.raises(ValueError):
+        MachineSpec.from_degrees(8, tensor=3)
+
+
+def test_sharded_spec_partition():
+    mesh = MachineSpec.from_degrees(8, tensor=2, pipeline=2).make_mesh()
+    ts = sharded(TensorSpec((16, 32)), "data", "model")
+    assert ts.partition_spec() == P("data", "model")
+    assert ts.shard_shape(mesh) == (8, 16)
+    ts.check_valid(mesh)
+
+
+def test_graph_hash_consing():
+    g = Graph()
+    a = g.add_node("input", {"shape": (2,), "dtype": "float32"}, [], [TensorSpec((2,))])
+    n1 = g.add_node(
+        "dense", {"out_dim": 4}, [TensorRef(a.id, 0)], [TensorSpec((4,))], dedup=True
+    )
+    n2 = g.add_node(
+        "dense", {"out_dim": 4}, [TensorRef(a.id, 0)], [TensorSpec((4,))], dedup=True
+    )
+    assert n1.id == n2.id
+    n3 = g.add_node(
+        "dense", {"out_dim": 8}, [TensorRef(a.id, 0)], [TensorSpec((8,))], dedup=True
+    )
+    assert n3.id != n1.id
+    assert "digraph" in g.to_dot()
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8

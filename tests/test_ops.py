@@ -1,0 +1,207 @@
+"""Op numerics tests vs independent references — mirrors the reference's
+FF↔PyTorch alignment suite (reference ``tests/align/align_test.py``):
+run each op standalone, compare against numpy/torch formulas."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.ops import get_op
+from flexflow_tpu.ops.registry import OpContext
+
+RNG = np.random.default_rng(0)
+
+
+def run_op(op_type, attrs, inputs, weights=None, training=False, state=None):
+    op = get_op(op_type)
+    specs = [TensorSpec(x.shape, str(x.dtype)) for x in inputs]
+    if weights is None:
+        weights = op.init(jax.random.PRNGKey(0), specs, attrs)
+    ctx = OpContext(
+        training=training,
+        rng=jax.random.PRNGKey(1),
+        state=state or {},
+        state_updates={} if training else None,
+    )
+    attrs = dict(attrs)
+    attrs.setdefault("_node", 0)
+    outs = op.forward(weights, [jnp.asarray(x) for x in inputs], attrs, ctx)
+    inferred = op.infer(specs, attrs)
+    for o, spec in zip(outs, inferred):
+        assert tuple(o.shape) == spec.shape, f"{op_type}: {o.shape} vs {spec.shape}"
+    return [np.asarray(o) for o in outs], weights
+
+
+def test_dense_matches_numpy():
+    x = RNG.standard_normal((4, 8)).astype(np.float32)
+    (y,), w = run_op("dense", {"out_dim": 16, "activation": "relu"}, [x])
+    expect = np.maximum(x @ np.asarray(w["kernel"]) + np.asarray(w["bias"]), 0)
+    np.testing.assert_allclose(y, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_embedding_modes():
+    idx = RNG.integers(0, 50, (3, 7))
+    (y,), w = run_op(
+        "embedding", {"num_entries": 50, "out_dim": 12, "aggr": "none"}, [idx]
+    )
+    assert y.shape == (3, 7, 12)
+    np.testing.assert_allclose(y, np.asarray(w["table"])[idx], rtol=1e-6)
+    (ys,), _ = run_op(
+        "embedding", {"num_entries": 50, "out_dim": 12, "aggr": "sum"}, [idx], weights=w
+    )
+    np.testing.assert_allclose(ys, np.asarray(w["table"])[idx].sum(1), rtol=1e-5)
+
+
+def test_conv2d_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = RNG.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    attrs = dict(
+        out_channels=5, kernel_h=3, kernel_w=3, stride_h=1, stride_w=1,
+        padding_h=1, padding_w=1,
+    )
+    (y,), w = run_op("conv2d", attrs, [x])
+    with torch.no_grad():
+        yt = torch.nn.functional.conv2d(
+            torch.tensor(x),
+            torch.tensor(np.asarray(w["kernel"])),
+            torch.tensor(np.asarray(w["bias"])),
+            stride=1,
+            padding=1,
+        ).numpy()
+    np.testing.assert_allclose(y, yt, rtol=1e-4, atol=1e-4)
+
+
+def test_pool2d_max_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = RNG.standard_normal((2, 4, 8, 8)).astype(np.float32)
+    attrs = dict(kernel_h=2, kernel_w=2, stride_h=2, stride_w=2)
+    (y,), _ = run_op("pool2d", attrs, [x])
+    with torch.no_grad():
+        yt = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(y, yt, rtol=1e-5)
+
+
+def test_layer_norm_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = RNG.standard_normal((4, 6, 32)).astype(np.float32)
+    (y,), w = run_op("layer_norm", {}, [x])
+    with torch.no_grad():
+        yt = torch.nn.functional.layer_norm(
+            torch.tensor(x), (32,),
+            torch.tensor(np.asarray(w["gamma"])),
+            torch.tensor(np.asarray(w["beta"])),
+        ).numpy()
+    np.testing.assert_allclose(y, yt, rtol=1e-4, atol=1e-4)
+
+
+def test_rms_norm_formula():
+    x = RNG.standard_normal((4, 16)).astype(np.float32)
+    (y,), w = run_op("rms_norm", {"eps": 1e-6}, [x])
+    rms = 1.0 / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, x * rms * np.asarray(w["gamma"]), rtol=1e-5)
+
+
+def test_residual_rms_norm_outputs():
+    x = RNG.standard_normal((2, 8)).astype(np.float32)
+    r = RNG.standard_normal((2, 8)).astype(np.float32)
+    (s, y), w = run_op("residual_rms_norm", {"eps": 1e-6}, [x, r])
+    np.testing.assert_allclose(s, x + r, rtol=1e-6)
+    rms = 1.0 / np.sqrt(((x + r) ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, (x + r) * rms * np.asarray(w["gamma"]), rtol=1e-5)
+
+
+def test_sigmoid_silu_multi():
+    x1 = RNG.standard_normal((3, 5)).astype(np.float32)
+    x2 = RNG.standard_normal((3, 5)).astype(np.float32)
+    (y,), _ = run_op("sigmoid_silu_multi", {}, [x1, x2])
+    silu = x1 / (1 + np.exp(-x1)) * x2
+    np.testing.assert_allclose(y, silu, rtol=1e-5)
+
+
+def test_elementwise():
+    a = RNG.standard_normal((3, 4)).astype(np.float32)
+    b = RNG.standard_normal((3, 4)).astype(np.float32)
+    (y,), _ = run_op("element_binary", {"op": "add"}, [a, b])
+    np.testing.assert_allclose(y, a + b, rtol=1e-6)
+    (y,), _ = run_op("element_unary", {"op": "relu"}, [a])
+    np.testing.assert_allclose(y, np.maximum(a, 0), rtol=1e-6)
+    (y,), _ = run_op("element_unary", {"op": "scalar_multiply", "scalar": 2.5}, [a])
+    np.testing.assert_allclose(y, a * 2.5, rtol=1e-6)
+
+
+def test_shape_ops():
+    x = RNG.standard_normal((2, 3, 4)).astype(np.float32)
+    (y,), _ = run_op("reshape", {"shape": (6, 4)}, [x])
+    assert y.shape == (6, 4)
+    (y,), _ = run_op("transpose", {"perm": (2, 0, 1)}, [x])
+    assert y.shape == (4, 2, 3)
+    outs, _ = run_op("split", {"sizes": (1, 3), "axis": 2}, [x])
+    assert outs[0].shape == (2, 3, 1) and outs[1].shape == (2, 3, 3)
+    (y,), _ = run_op("concat", {"axis": 1}, [x, x])
+    assert y.shape == (2, 6, 4)
+    (y,), _ = run_op("flat", {}, [x])
+    assert y.shape == (2, 12)
+
+
+def test_softmax_and_reduce():
+    x = RNG.standard_normal((5, 9)).astype(np.float32)
+    (y,), _ = run_op("softmax", {"axis": -1}, [x])
+    np.testing.assert_allclose(y.sum(-1), np.ones(5), rtol=1e-5)
+    (y,), _ = run_op("reduce", {"op": "mean", "axes": (1,)}, [x])
+    np.testing.assert_allclose(y, x.mean(1), rtol=1e-5)
+
+
+def test_batch_matmul():
+    a = RNG.standard_normal((2, 3, 4)).astype(np.float32)
+    b = RNG.standard_normal((2, 4, 5)).astype(np.float32)
+    (y,), _ = run_op("batch_matmul", {}, [a, b])
+    np.testing.assert_allclose(y, a @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_multihead_attention_vs_torch():
+    torch = pytest.importorskip("torch")
+    B, L, D, H = 2, 6, 16, 4
+    x = RNG.standard_normal((B, L, D)).astype(np.float32)
+    attrs = {"embed_dim": D, "num_heads": H, "bias": False}
+    (y,), w = run_op("multihead_attention", attrs, [x, x, x])
+
+    mha = torch.nn.MultiheadAttention(D, H, bias=False, batch_first=True)
+    with torch.no_grad():
+        wq, wk, wv = [np.asarray(w[k]).T for k in ("wq", "wk", "wv")]
+        mha.in_proj_weight.copy_(torch.tensor(np.concatenate([wq, wk, wv], 0)))
+        mha.out_proj.weight.copy_(torch.tensor(np.asarray(w["wo"]).T))
+        yt, _ = mha(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+    np.testing.assert_allclose(y, yt.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_causal_attention_masks_future():
+    B, L, D, H = 1, 5, 8, 2
+    x = RNG.standard_normal((B, L, D)).astype(np.float32)
+    attrs = {"embed_dim": D, "num_heads": H, "bias": False, "causal": True}
+    (y1,), w = run_op("multihead_attention", attrs, [x, x, x])
+    # Perturb the last position; earlier outputs must not change.
+    x2 = x.copy()
+    x2[:, -1] += 10.0
+    (y2,), _ = run_op("multihead_attention", attrs, [x2, x2, x2], weights=w)
+    np.testing.assert_allclose(y1[:, :-1], y2[:, :-1], rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_and_eval():
+    x = RNG.standard_normal((8, 4, 2, 2)).astype(np.float32) * 3 + 1
+    state = {0: get_op("batch_norm").init_state([TensorSpec(x.shape)], {})}
+    op_attrs = {"relu": False, "_node": 0}
+    outs, w = run_op("batch_norm", op_attrs, [x], training=True, state=state)
+    y = outs[0]
+    np.testing.assert_allclose(y.mean((0, 2, 3)), np.zeros(4), atol=1e-4)
+    np.testing.assert_allclose(y.std((0, 2, 3)), np.ones(4), atol=1e-2)
+
+
+def test_dropout_train_vs_eval():
+    x = np.ones((100, 100), np.float32)
+    (y_eval,), _ = run_op("dropout", {"rate": 0.5}, [x], training=False)
+    np.testing.assert_allclose(y_eval, x)
+    (y_tr,), _ = run_op("dropout", {"rate": 0.5}, [x], training=True)
+    frac = (y_tr == 0).mean()
+    assert 0.4 < frac < 0.6
+    np.testing.assert_allclose(y_tr[y_tr != 0], 2.0, rtol=1e-6)

@@ -1,0 +1,136 @@
+"""End-to-end training tests — the analog of the reference's training
+smoke suite (reference ``tests/training_tests.sh`` runs MNIST MLP etc.).
+Runs on the virtual 8-device CPU mesh from conftest."""
+import jax
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+
+
+def make_blobs(n=512, dim=16, classes=4, seed=0):
+    """Linearly separable synthetic data (fast stand-in for MNIST)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((classes, dim)) * 4.0
+    y = rng.integers(0, classes, n)
+    x = centers[y] + rng.standard_normal((n, dim)).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def build_mlp(config, dim=16, classes=4, batch=64):
+    model = ff.FFModel(config)
+    x = model.create_tensor((batch, dim), name="x")
+    t = model.dense(x, 64, activation="relu")
+    t = model.dense(t, 64, activation="relu")
+    t = model.dense(t, classes)
+    t = model.softmax(t)
+    return model
+
+
+def test_mlp_trains_single_device():
+    cfg = ff.FFConfig(batch_size=64, epochs=8, learning_rate=0.05, num_devices=1)
+    model = build_mlp(cfg)
+    x, y = make_blobs()
+    model.compile(
+        optimizer=ff.SGDOptimizer(lr=0.05),
+        loss_type="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    perf = model.fit(x, y, verbose=False)
+    acc = perf.averages()["accuracy"]
+    assert acc > 0.9, f"MLP failed to learn: acc={acc}"
+
+
+def test_mlp_trains_data_parallel():
+    cfg = ff.FFConfig(batch_size=64, epochs=8, learning_rate=0.05, num_devices=8)
+    model = build_mlp(cfg)
+    x, y = make_blobs()
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.05))
+    perf = model.fit(x, y, verbose=False)
+    acc = perf.averages()["accuracy"]
+    assert acc > 0.9, f"DP MLP failed to learn: acc={acc}"
+
+
+def test_dp_matches_single_device_exactly():
+    """Same seed + same data order must give identical loss trajectory on
+    1 device and 8-way DP — the layout-equivalence property the reference
+    tests across TP×PP splits (tests/inference/python_inference_tests.sh)."""
+    x, y = make_blobs(n=256)
+
+    def run(num_devices):
+        cfg = ff.FFConfig(
+            batch_size=64, epochs=2, learning_rate=0.05, num_devices=num_devices
+        )
+        model = build_mlp(cfg)
+        model.compile(optimizer=ff.SGDOptimizer(lr=0.05))
+        perf = model.fit(x, y, shuffle=False, verbose=False)
+        return perf.averages()["loss"]
+
+    l1, l8 = run(1), run(8)
+    np.testing.assert_allclose(l1, l8, rtol=1e-4)
+
+
+def test_adam_trains():
+    cfg = ff.FFConfig(batch_size=64, epochs=5, num_devices=1)
+    model = build_mlp(cfg)
+    x, y = make_blobs()
+    model.compile(
+        optimizer=ff.AdamOptimizer(lr=0.01),
+        loss_type="sparse_categorical_crossentropy",
+    )
+    perf = model.fit(x, y, verbose=False)
+    assert perf.averages()["accuracy"] > 0.9
+
+
+def test_evaluate_and_forward():
+    cfg = ff.FFConfig(batch_size=64, epochs=4, num_devices=1)
+    model = build_mlp(cfg)
+    x, y = make_blobs()
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.05))
+    model.fit(x, y, verbose=False)
+    ev = model.evaluate(x, y)
+    assert ev["accuracy"] > 0.85
+    preds = model.forward(x[:64])
+    assert preds.shape == (64, 4)
+    np.testing.assert_allclose(np.asarray(preds).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_cnn_trains():
+    """Mini conv net — the AlexNet/LeNet smoke-path analog."""
+    rng = np.random.default_rng(0)
+    n, classes = 256, 3
+    y = rng.integers(0, classes, n).astype(np.int32)
+    x = rng.standard_normal((n, 1, 8, 8)).astype(np.float32)
+    # Paint a class-dependent stripe so the task is learnable.
+    for i in range(n):
+        x[i, 0, y[i] * 2, :] += 4.0
+    cfg = ff.FFConfig(batch_size=32, epochs=6, num_devices=1)
+    model = ff.FFModel(cfg)
+    t_in = model.create_tensor((32, 1, 8, 8), name="x")
+    t = model.conv2d(t_in, 8, 3, 3, padding_h=1, padding_w=1, activation="relu")
+    t = model.pool2d(t, 2, 2, stride_h=2, stride_w=2)
+    t = model.flat(t)
+    t = model.dense(t, 32, activation="relu")
+    t = model.dense(t, classes)
+    t = model.softmax(t)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.05))
+    perf = model.fit(x, y, verbose=False)
+    assert perf.averages()["accuracy"] > 0.8
+
+
+def test_mse_regression():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((256, 8)).astype(np.float32)
+    w_true = rng.standard_normal((8, 1)).astype(np.float32)
+    y = x @ w_true
+    cfg = ff.FFConfig(batch_size=64, epochs=30, num_devices=1)
+    model = ff.FFModel(cfg)
+    t_in = model.create_tensor((64, 8), name="x")
+    model.dense(t_in, 1, use_bias=False)
+    model.compile(
+        optimizer=ff.SGDOptimizer(lr=0.1),
+        loss_type="mean_squared_error",
+        metrics=["mean_squared_error"],
+    )
+    perf = model.fit(x, y, verbose=False)
+    assert perf.averages()["loss"] < 1e-3
