@@ -1,0 +1,82 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Current metric (round 1): flagship LLaMA training-step MFU on the real
+chip, against the BASELINE.md north star of 40% MFU for Unity-searched
+training. Will switch to SpecInfer tokens/sec once the serving stack
+lands (BASELINE.json headline).
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.optimizers import AdamOptimizer
+    from flexflow_tpu.core.mesh import MachineSpec
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    # Model sized to exercise the MXU seriously on one v5e chip.
+    cfg = llama.LLaMAConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        intermediate_size=5504,
+        num_hidden_layers=16,
+        num_attention_heads=16,
+        num_key_value_heads=16,
+        max_position_embeddings=1024,
+        dtype=jnp.bfloat16,
+    ) if on_tpu else llama.LLaMAConfig.tiny(dtype=jnp.float32)
+
+    batch, seq = (8, 1024) if on_tpu else (2, 32)
+    mesh = MachineSpec().make_mesh(jax.devices()[:1])
+    with jax.set_mesh(mesh):
+        init_fn, step, ds = llama.make_train_step(
+            cfg, mesh, AdamOptimizer(lr=1e-4), remat=True,
+            shard_activations=False,
+        )
+        key = jax.random.PRNGKey(0)
+        params, opt_state = init_fn(key)
+        tokens = jax.device_put(
+            jax.random.randint(key, (batch, seq), 0, cfg.vocab_size, dtype=jnp.int32),
+            ds,
+        )
+        # warmup / compile. NOTE: sync via host fetch — on the tunnelled
+        # TPU backend block_until_ready returns before execution finishes.
+        params, opt_state, loss = step(params, opt_state, tokens)
+        _ = float(loss)
+        iters = 10 if on_tpu else 2
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        _ = float(loss)  # steps chain through donated params
+        dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_step = batch * (seq - 1)
+    # fwd+bwd ≈ 3x forward FLOPs
+    flops = 3 * llama.flops_per_token(cfg, seq) * tokens_per_step
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak FLOP/s (394 is int8)
+    mfu = flops / dt / peak
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_mfu",
+                "value": round(mfu, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(mfu / 0.40, 4),
+                "detail": {
+                    "tokens_per_sec": round(tokens_per_step / dt, 1),
+                    "step_ms": round(dt * 1e3, 2),
+                    "model_params_m": round(llama.num_params(cfg) / 1e6, 1),
+                    "platform": dev.platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

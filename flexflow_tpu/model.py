@@ -551,6 +551,10 @@ class FFModel:
         self.loss_type = loss_type
         self.metrics_names = tuple(metrics)
         self.mesh = self._make_mesh()
+        if self.config.tensor_parallelism_degree > 1:
+            from .parallel.tp import apply_tensor_parallel
+
+            apply_tensor_parallel(self.graph, self.config.tensor_parallelism_degree)
         self._output_ref = output.ref if output is not None else TensorRef(
             len(self.graph.nodes) - 1, 0
         )

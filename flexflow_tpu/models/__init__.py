@@ -1,0 +1,3 @@
+from . import llama
+
+__all__ = ["llama"]

@@ -1,0 +1,435 @@
+"""LLaMA model family — the flagship architecture.
+
+TPU-native equivalent of the reference's LLaMA builder (reference
+``inference/models/llama.cc:23-280`` and ``python/flexflow/serve/models/
+llama.py``): embedding → N × [rms_norm → attention(QKV+RoPE+GQA) →
+residual_rms_norm → SwiGLU FFN] → rms_norm → lm_head → decode head.
+
+Design differences from the reference, chosen for TPU:
+  * **Stacked layers + ``lax.scan``**: all N layers' weights live in one
+    pytree with a leading layer dim. One compiled block serves every
+    layer (fast compile), the layer dim shards over the ``pipe`` axis for
+    pipeline parallelism, and ``jax.checkpoint`` remats per block.
+  * **bf16 compute / f32 accumulate** on the MXU via
+    ``preferred_element_type``.
+  * Both training (full causal) and serving (KV-cache prefill/decode)
+    run through the same block code; serving batch layout comes from
+    the BatchConfig module (flexflow_tpu/serve).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class LLaMAConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 2048
+    dtype: Any = jnp.bfloat16
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def llama_7b(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def llama_160m(cls, **kw):
+        """The reference's standard SSM speculator (JackFram/llama-160m)."""
+        d = dict(
+            hidden_size=768,
+            intermediate_size=3072,
+            num_hidden_layers=12,
+            num_attention_heads=12,
+            num_key_value_heads=12,
+        )
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=128,
+        )
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def from_hf(cls, hf: Dict[str, Any], **kw) -> "LLaMAConfig":
+        d = dict(
+            vocab_size=hf.get("vocab_size", 32000),
+            hidden_size=hf.get("hidden_size", 4096),
+            intermediate_size=hf.get("intermediate_size", 11008),
+            num_hidden_layers=hf.get("num_hidden_layers", 32),
+            num_attention_heads=hf.get("num_attention_heads", 32),
+            num_key_value_heads=hf.get(
+                "num_key_value_heads", hf.get("num_attention_heads", 32)
+            ),
+            rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            max_position_embeddings=hf.get("max_position_embeddings", 2048),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        )
+        d.update(kw)
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# RoPE (HF rotate-half convention; reference supports native + HF variants,
+# inc_multihead_self_attention.cu:487)
+
+
+def rope_freqs(cfg: LLaMAConfig, positions: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """positions (...,) int32 → cos/sin (..., head_dim)."""
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., half)
+    angles = jnp.concatenate([angles, angles], axis=-1)  # (..., head_dim)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: (..., heads, head_dim); cos/sin broadcast over the head axis."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x * cos[..., None, :] + rotated * sin[..., None, :]).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_params(key, cfg: LLaMAConfig) -> Dict[str, Any]:
+    L, D, F = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+    H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    dt = cfg.dtype
+    ks = jax.random.split(key, 8)
+
+    def norm_init(std, k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(dt)
+
+    std = 0.02
+    params = {
+        "embed": norm_init(std, ks[0], (cfg.vocab_size, D)),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dt),
+            "wq": norm_init(std, ks[1], (L, D, H * dk)),
+            "wk": norm_init(std, ks[2], (L, D, KV * dk)),
+            "wv": norm_init(std, ks[3], (L, D, KV * dk)),
+            "wo": norm_init(std / math.sqrt(2 * L), ks[4], (L, H * dk, D)),
+            "ffn_norm": jnp.ones((L, D), dt),
+            "w1": norm_init(std, ks[5], (L, D, F)),
+            "w2": norm_init(std / math.sqrt(2 * L), ks[6], (L, F, D)),
+            "w3": norm_init(std, ks[7], (L, D, F)),
+        },
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm_init(std, jax.random.fold_in(key, 99), (D, cfg.vocab_size))
+    return params
+
+
+def param_pspecs(cfg: LLaMAConfig, *, pipeline: bool = False) -> Dict[str, Any]:
+    """Megatron TP shardings (reference's hardcoded TP rewrite,
+    model.cc:3239-3312): QKV/up column-parallel, O/down row-parallel on
+    the ``model`` axis. With ``pipeline`` the stacked layer dim shards
+    over ``pipe``."""
+    pp = PIPE_AXIS if pipeline else None
+    specs = {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(pp, None),
+            "wq": P(pp, None, MODEL_AXIS),
+            "wk": P(pp, None, MODEL_AXIS),
+            "wv": P(pp, None, MODEL_AXIS),
+            "wo": P(pp, MODEL_AXIS, None),
+            "ffn_norm": P(pp, None),
+            "w1": P(pp, None, MODEL_AXIS),
+            "w2": P(pp, MODEL_AXIS, None),
+            "w3": P(pp, None, MODEL_AXIS),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, MODEL_AXIS)
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+
+def _rms(x, gamma, eps):
+    xf = x.astype(jnp.float32)
+    r = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return ((xf * r).astype(x.dtype)) * gamma
+
+
+def _mm(x, w):
+    return jnp.matmul(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def attention(
+    cfg: LLaMAConfig,
+    q: jnp.ndarray,  # (B, S, H, dk) — rope applied
+    k: jnp.ndarray,  # (B, T, KV, dk)
+    v: jnp.ndarray,  # (B, T, KV, dk)
+    mask: Optional[jnp.ndarray],  # (B, S, T) or (S, T) bool, True = attend
+) -> jnp.ndarray:
+    H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+    if KV != H:  # GQA: repeat KV heads
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(cfg.head_dim)
+    if mask is not None:
+        m = mask if mask.ndim == 3 else mask[None]
+        scores = jnp.where(m[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def block(
+    cfg: LLaMAConfig,
+    p: Dict[str, jnp.ndarray],  # one layer's params (no L dim)
+    x: jnp.ndarray,  # (B, S, D)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    cache_slot: Optional[jnp.ndarray] = None,
+):
+    """One transformer block. If ``kv`` (cached k/v for the full window)
+    is given, new k/v are scattered into it at ``cache_slot`` positions
+    (serving path); otherwise attention is over the local sequence
+    (training path). Returns (x_out, (k_cache, v_cache) or None)."""
+    B, S, D = x.shape
+    H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+    h = _rms(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = _mm(h, p["wq"]).reshape(B, S, H, dk)
+    k = _mm(h, p["wk"]).reshape(B, S, KV, dk)
+    v = _mm(h, p["wv"]).reshape(B, S, KV, dk)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_kv = None
+    if kv is not None:
+        k_cache, v_cache = kv  # (B, T, KV, dk)
+        # scatter current tokens into the cache at their positions
+        bidx = jnp.arange(B)[:, None]
+        k_cache = k_cache.at[bidx, cache_slot].set(k)
+        v_cache = v_cache.at[bidx, cache_slot].set(v)
+        new_kv = (k_cache, v_cache)
+        attn = attention(cfg, q, k_cache, v_cache, mask)
+    else:
+        attn = attention(cfg, q, k, v, mask)
+
+    x = x + _mm(attn.reshape(B, S, H * dk), p["wo"])
+    h2 = _rms(x, p["ffn_norm"], cfg.rms_norm_eps)
+    ffn = _mm(jax.nn.silu(_mm(h2, p["w1"])) * _mm(h2, p["w3"]), p["w2"])
+    return x + ffn, new_kv
+
+
+def causal_mask(S: int) -> jnp.ndarray:
+    return jnp.tril(jnp.ones((S, S), bool))
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,  # (B, S) int32
+    cfg: LLaMAConfig,
+    *,
+    positions: Optional[jnp.ndarray] = None,
+    remat: bool = False,
+    shard_activations: bool = False,
+) -> jnp.ndarray:
+    """Training/eval forward: full causal attention, returns logits
+    (B, S, V)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+    cos, sin = rope_freqs(cfg, positions)
+    mask = causal_mask(S)
+
+    def constrain(t):
+        if shard_activations:
+            return lax.with_sharding_constraint(
+                t, P(DATA_AXIS, SEQ_AXIS, None)
+            )
+        return t
+
+    x = constrain(x)
+
+    blk = functools.partial(block, cfg)
+    if remat:
+        blk = jax.checkpoint(blk)
+
+    def scan_body(carry, p_l):
+        y, _ = blk(p_l, carry, cos, sin, mask)
+        return constrain(y), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = _rms(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.matmul(x, head, preferred_element_type=jnp.float32)
+
+
+def next_token_loss(params, tokens, cfg, **kw) -> jnp.ndarray:
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
+    logits = forward(params, tokens[:, :-1], cfg, **kw)
+    targets = tokens[:, 1:].astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(
+    cfg: LLaMAConfig,
+    mesh,
+    optimizer,
+    *,
+    num_microbatches: int = 1,
+    remat: bool = True,
+    shard_activations: bool = True,
+):
+    """Build (init_fn, step_fn) jitted over ``mesh`` with the full
+    dp/tp/pp/sp sharding stack.
+
+    * dp: batch dim sharded on ``data`` (GSPMD all-reduces grads).
+    * tp: Megatron weight shardings from :func:`param_pspecs` (GSPMD
+      inserts the QKV/FFN all-reduces over ICI).
+    * sp: activation sequence dim constrained to the ``seq`` axis.
+    * pp (when mesh has pipe>1): GPipe microbatching via
+      ``parallel.pipeline`` — the stacked layer dim is sharded over
+      ``pipe`` and only that axis runs manually under shard_map.
+    """
+    from jax.sharding import NamedSharding
+
+    pipeline = mesh.shape[PIPE_AXIS] > 1
+    pspecs = param_pspecs(cfg, pipeline=pipeline)
+    shardings = jax.tree.map(
+        lambda p: NamedSharding(mesh, p), pspecs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+    def init_fn(key):
+        params = jax.jit(
+            functools.partial(init_params, cfg=cfg), out_shardings=shardings
+        )(key)
+        opt_state = optimizer.init(params)
+        return params, opt_state
+
+    if not pipeline:
+
+        def loss_fn(params, tokens):
+            return next_token_loss(
+                params,
+                tokens,
+                cfg,
+                remat=remat,
+                shard_activations=shard_activations and mesh.shape[SEQ_AXIS] > 1,
+            )
+
+    else:
+        from ..parallel.pipeline import make_pipelined_apply
+
+        blk = functools.partial(block, cfg)
+        if remat:
+            blk = jax.checkpoint(blk)
+
+        def loss_fn(params, tokens):
+            B, S = tokens.shape
+            Sm = S - 1
+            inp, targets = tokens[:, :-1], tokens[:, 1:].astype(jnp.int32)
+            x = jnp.take(params["embed"], inp.astype(jnp.int32), axis=0)
+            if shard_activations and mesh.shape[SEQ_AXIS] > 1:
+                x = lax.with_sharding_constraint(x, P(DATA_AXIS, SEQ_AXIS, None))
+            cos, sin = rope_freqs(cfg, jnp.arange(Sm, dtype=jnp.int32))
+            mask = causal_mask(Sm)
+
+            def block_stack(stage_layers, x_mb):
+                def body(carry, p_l):
+                    y, _ = blk(p_l, carry, cos, sin, mask)
+                    return y, None
+
+                y, _ = lax.scan(body, x_mb, stage_layers)
+                return y
+
+            mb = B // num_microbatches
+            x_mb = x.reshape(num_microbatches, mb, Sm, cfg.hidden_size)
+            piped = make_pipelined_apply(
+                mesh,
+                block_stack,
+                num_microbatches=num_microbatches,
+                params_spec=jax.tree.map(
+                    lambda _: P(PIPE_AXIS), params["layers"]
+                ),
+            )
+            y = piped(params["layers"], x_mb).reshape(B, Sm, cfg.hidden_size)
+            y = _rms(y, params["final_norm"], cfg.rms_norm_eps)
+            head = (
+                params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+            )
+            logits = jnp.matmul(y, head, preferred_element_type=jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            return nll.mean()
+
+    def step_fn(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        params, opt_state = optimizer.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    data_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+    return init_fn, step, data_sharding
+
+
+def num_params(cfg: LLaMAConfig) -> int:
+    L, D, F, V = (
+        cfg.num_hidden_layers,
+        cfg.hidden_size,
+        cfg.intermediate_size,
+        cfg.vocab_size,
+    )
+    H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    per_layer = D * (H * dk) + 2 * D * (KV * dk) + (H * dk) * D + 3 * D * F + 2 * D
+    head = 0 if cfg.tie_word_embeddings else D * V
+    return V * D + L * per_layer + D + head
+
+
+def flops_per_token(cfg: LLaMAConfig, seq_len: int) -> int:
+    """Forward FLOPs/token ≈ 2*n_params + attention quadratic term."""
+    return 2 * num_params(cfg) + 4 * cfg.num_hidden_layers * cfg.hidden_size * seq_len

@@ -1,0 +1,4 @@
+from .tp import apply_tensor_parallel
+from .pipeline import pipeline_forward, make_pipelined_apply
+
+__all__ = ["apply_tensor_parallel", "pipeline_forward", "make_pipelined_apply"]

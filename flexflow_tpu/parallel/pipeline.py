@@ -1,0 +1,136 @@
+"""Pipeline parallelism — GPipe-style microbatching over the ``pipe``
+mesh axis.
+
+The reference only pipelines *inference*: layers map to stages via
+``transformer_layer_id / layers_per_stage`` → ``MachineView.start_device_id``
+(reference ``src/runtime/inference_manager.cc:91-133``), overlapped by a
+4-deep in-flight batch-future queue (``request_manager.cc:2310-2325``);
+training pipeline task IDs exist but are unimplemented. Here we go
+further and pipeline **training** too, the TPU-native way: every pipeline
+stage runs the same SPMD program under ``shard_map``; stage-local layer
+parameters arrive pre-sharded on the ``pipe`` axis (leading stacked-layer
+dim), activations flow stage-to-stage with ``lax.ppermute`` over the ICI
+ring, and a ``lax.scan`` over (microbatches + stages - 1) ticks implements
+the GPipe schedule with static shapes throughout.
+
+This module is generic over a "block_fn" (params_slice, x) -> x so the
+flagship transformer and any homogeneous stack can use it.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.mesh import PIPE_AXIS
+
+
+def pipeline_forward(
+    block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    *,
+    num_stages: int,
+    num_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+):
+    """Run ``x`` through ``num_stages`` pipeline stages inside shard_map.
+
+    Must be called from *within* a shard_map region sharded over
+    ``axis_name``. ``stage_params`` are this stage's local layer params
+    (leading dim = layers-per-stage). ``x`` is the full batch of
+    microbatches, shape (num_microbatches, mb, ...); every stage holds a
+    copy (stage 0 consumes it, later stages consume permuted activations).
+
+    Returns the final-stage outputs for all microbatches, valid on the
+    last stage (other stages hold garbage of the same shape — callers
+    typically ppermute the result back or reduce over it).
+    """
+    stage = lax.axis_index(axis_name)
+    mb_shape = x.shape[1:]
+    n_ticks = num_microbatches + num_stages - 1
+
+    # state: per-stage input buffer for the current tick
+    def tick(carry, t):
+        outputs, cur_in = carry
+        # Stage 0 feeds microbatch t (when valid); others use received acts.
+        mb_idx = jnp.clip(t, 0, num_microbatches - 1)
+        stage0_in = lax.dynamic_index_in_dim(x, mb_idx, axis=0, keepdims=False)
+        inp = jnp.where(stage == 0, stage0_in, cur_in)
+        out = block_fn(stage_params, inp)
+        # Shift activations to the next stage over the ICI ring.
+        nxt = lax.ppermute(
+            out,
+            axis_name,
+            perm=[(i, (i + 1) % num_stages) for i in range(num_stages)],
+        )
+        # Last stage banks its finished microbatch (valid when
+        # t - (num_stages-1) in [0, num_microbatches)).
+        done_idx = jnp.clip(t - (num_stages - 1), 0, num_microbatches - 1)
+        is_valid = (t >= num_stages - 1) & (stage == num_stages - 1)
+        banked = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(is_valid, out, lax.dynamic_index_in_dim(outputs, done_idx, 0, keepdims=False)),
+            done_idx,
+            axis=0,
+        )
+        return (banked, nxt), None
+
+    out_shape = jax.eval_shape(block_fn, stage_params, x[0])
+    outputs0 = jnp.zeros((num_microbatches,) + out_shape.shape, out_shape.dtype)
+    (outputs, _), _ = lax.scan(
+        tick, (outputs0, jnp.zeros_like(x[0])), jnp.arange(n_ticks)
+    )
+    return outputs
+
+
+def make_pipelined_apply(
+    mesh: Mesh,
+    block_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    *,
+    num_microbatches: int,
+    params_spec: Any,
+    x_spec: P = P(),
+):
+    """Wrap ``pipeline_forward`` in shard_map over the mesh's pipe axis.
+
+    params_spec: PartitionSpec pytree for the stacked layer params whose
+    leading (layer) dim is sharded over 'pipe'. In partial-manual mode the
+    specs may only name the ``pipe`` axis — data/model sharding of the
+    activations stays under GSPMD (x replicated across stages).
+    """
+    num_stages = mesh.shape[PIPE_AXIS]
+
+    def inner(stage_params, x_mb):
+        out = pipeline_forward(
+            block_fn,
+            stage_params,
+            x_mb,
+            num_stages=num_stages,
+            num_microbatches=num_microbatches,
+        )
+        # Broadcast final-stage result back to all stages so downstream
+        # (loss) code is stage-agnostic: zero non-final copies, psum.
+        if num_stages > 1:
+            is_last = lax.axis_index(PIPE_AXIS) == num_stages - 1
+            out = lax.psum(
+                jnp.where(is_last, out, jnp.zeros_like(out)), PIPE_AXIS
+            )
+        return out
+
+    # Partial-manual mode: only the pipe axis is manual; data/model axes
+    # remain under GSPMD, so DP batch sharding and Megatron TP compose
+    # with the pipeline loop without manual collectives for them.
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(params_spec, x_spec),
+        out_specs=x_spec,
+        axis_names=frozenset({PIPE_AXIS}),
+        check_vma=False,
+    )

@@ -1,0 +1,98 @@
+"""Tensor-parallel rewrite pass.
+
+The reference hardcodes a Megatron-style rewrite in
+``FFModel::create_operators_from_layers`` (reference
+``src/runtime/model.cc:3239-3312``): partition attention heads and the
+FFN hidden dim across the TP group, insert ``AllReduce`` after the
+attention output projection and FFN down-projection, and ``Combine``
+before softmax/argmax heads. On TPU the same strategy is *declarative*:
+this pass pattern-matches the graph and stamps ``tp_shard`` attrs on the
+matched ops; their ``weight_pspecs`` then emit column/row/head-parallel
+PartitionSpecs, and GSPMD compiles the implied all-reduces (partial-sum
+contractions over the ``model`` axis) onto ICI — no explicit parallel
+ops needed.
+
+Patterns (mirroring the reference's matcher at model.cc:3279-3306):
+  * ``multihead_attention``            → head-parallel (col QKV, row O)
+  * up-proj dense (+act / SwiGLU glue) → column-parallel
+  * the dense consuming it             → row-parallel (partial sums
+                                         all-reduced by GSPMD)
+  * ``embedding``                      → hidden-dim (column) parallel
+"""
+from __future__ import annotations
+
+from typing import Dict, Set
+
+from ..core.graph import Graph, OpNode
+
+# Ops through which a column-sharded activation flows unchanged (the
+# elementwise epilogue between up-proj and down-proj).
+_PASSTHROUGH = {
+    "element_unary",
+    "dropout",
+    "sigmoid_silu_multi",
+    "element_binary",
+    "cast",
+}
+
+
+def _set_attr(node: OpNode, key: str, value) -> None:
+    d = dict(node.attrs)
+    d[key] = value
+    node.attrs = tuple(sorted(d.items()))
+
+
+def _consumers_through(graph: Graph, node_id: int, seen: Set[int]):
+    """Yield dense consumers reachable through passthrough ops."""
+    for c in graph.consumers(node_id):
+        if c.id in seen:
+            continue
+        seen.add(c.id)
+        if c.op_type == "dense":
+            yield c
+        elif c.op_type in _PASSTHROUGH:
+            yield from _consumers_through(graph, c.id, seen)
+
+
+def apply_tensor_parallel(graph: Graph, tp_degree: int) -> Dict[str, str]:
+    """Stamp tp_shard attrs; returns {node_name: role} for logging/tests."""
+    if tp_degree <= 1:
+        return {}
+    decisions: Dict[str, str] = {}
+    row_nodes: Set[int] = set()
+
+    for node in graph.nodes:
+        if node.op_type == "multihead_attention":
+            attrs = node.attrs_dict
+            if attrs["num_heads"] % tp_degree == 0:
+                _set_attr(node, "tp_shard", "heads")
+                decisions[node.name] = "heads"
+        # embeddings stay replicated: vocab/hidden sharding of the table is
+        # a serving-time decision (lm_head fusion), not part of this pass.
+
+    for node in graph.nodes:
+        if node.op_type != "dense" or node.id in row_nodes:
+            continue
+        attrs = node.attrs_dict
+        if attrs.get("tp_shard"):
+            continue
+        in_spec = graph.out_spec(node.inputs[0])
+        in_dim, out_dim = in_spec.shape[-1], attrs["out_dim"]
+        if out_dim % tp_degree:
+            continue
+        if out_dim >= in_dim * 2:  # up-projection heuristic (FFN expand)
+            partners = [
+                c
+                for c in _consumers_through(graph, node.id, set())
+                if c.attrs_dict["out_dim"] == in_dim
+                and graph.out_spec(c.inputs[0]).shape[-1] % tp_degree == 0
+            ]
+            if partners:
+                _set_attr(node, "tp_shard", "col")
+                decisions[node.name] = "col"
+                for p in partners:
+                    if not p.attrs_dict.get("tp_shard"):
+                        _set_attr(p, "tp_shard", "row")
+                        decisions[p.name] = "row"
+                        row_nodes.add(p.id)
+    return decisions
