@@ -12,9 +12,9 @@ Design differences from the reference, chosen for TPU:
     pipeline parallelism, and ``jax.checkpoint`` remats per block.
   * **bf16 compute / f32 accumulate** on the MXU via
     ``preferred_element_type``.
-  * Both training (full causal) and serving (KV-cache prefill/decode)
-    run through the same block code; serving batch layout comes from
-    the BatchConfig module (flexflow_tpu/serve).
+  * Training (full causal, :func:`block`) and serving (KV-cache
+    prefill/decode/verify, :func:`serve_block`) share the projection and
+    FFN math; serving batch layout comes from flexflow_tpu/serve.
 """
 from __future__ import annotations
 
@@ -227,13 +227,11 @@ def block(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     mask: Optional[jnp.ndarray],
-    kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
-    cache_slot: Optional[jnp.ndarray] = None,
 ):
-    """One transformer block. If ``kv`` (cached k/v for the full window)
-    is given, new k/v are scattered into it at ``cache_slot`` positions
-    (serving path); otherwise attention is over the local sequence
-    (training path). Returns (x_out, (k_cache, v_cache) or None)."""
+    """One transformer block, training path (full local-sequence
+    attention). The serving path with KV cache is :func:`serve_block`.
+    Returns (x_out, None) — the None slot keeps the scan-body signature
+    stable across train/serve variants."""
     B, S, D = x.shape
     H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
@@ -243,23 +241,12 @@ def block(
     v = _mm(h, p["wv"]).reshape(B, S, KV, dk)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-
-    new_kv = None
-    if kv is not None:
-        k_cache, v_cache = kv  # (B, T, KV, dk)
-        # scatter current tokens into the cache at their positions
-        bidx = jnp.arange(B)[:, None]
-        k_cache = k_cache.at[bidx, cache_slot].set(k)
-        v_cache = v_cache.at[bidx, cache_slot].set(v)
-        new_kv = (k_cache, v_cache)
-        attn = attention(cfg, q, k_cache, v_cache, mask)
-    else:
-        attn = attention(cfg, q, k, v, mask)
+    attn = attention(cfg, q, k, v, mask)
 
     x = x + _mm(attn.reshape(B, S, H * dk), p["wo"])
     h2 = _rms(x, p["ffn_norm"], cfg.rms_norm_eps)
     ffn = _mm(jax.nn.silu(_mm(h2, p["w1"])) * _mm(h2, p["w3"]), p["w2"])
-    return x + ffn, new_kv
+    return x + ffn, None
 
 
 def causal_mask(S: int) -> jnp.ndarray:
@@ -415,6 +402,122 @@ def make_train_step(
     data_sharding = NamedSharding(mesh, P(DATA_AXIS, None))
     step = jax.jit(step_fn, donate_argnums=(0, 1))
     return init_fn, step, data_sharding
+
+
+# ---------------------------------------------------------------------------
+# Serving path (KV cache). One step function serves prefill (chunk C>1),
+# incremental decode (C=1), and SpecInfer tree-verify (explicit mask) —
+# the TPU-native counterpart of the reference's three attention operators
+# (inc/spec/tree_inc_multihead_self_attention, SURVEY.md §2.1): instead of
+# three CUDA kernels there is one compiled XLA program per static
+# (C, all_logits, mask-mode) signature, all sharing the same KV buffers.
+
+
+def init_kv_cache(
+    cfg: LLaMAConfig, num_slots: int, max_len: int, dtype=None
+) -> Dict[str, jnp.ndarray]:
+    """KV cache pytree: (L, slots, max_len+1, KV, dk). The last position is
+    a scratch row — padding tokens scatter there so real cache lines are
+    never corrupted (replaces the reference's per-request contiguous cache
+    with request-slot paging, inc_multihead_self_attention.cu:1338)."""
+    L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
+    dt = dtype or cfg.dtype
+    shape = (L, num_slots, max_len + 1, KV, dk)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def kv_cache_pspecs() -> Dict[str, P]:
+    """Cache shards over TP on the KV-head dim (same axis the attention
+    heads shard on) and over DP on the slot dim."""
+    return {
+        "k": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+        "v": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+    }
+
+
+def serve_attention(cfg: LLaMAConfig, q, k_cache, v_cache, mask):
+    """Grouped-query attention of q (R, C, H, dk) against the full cache
+    (R, S, KV, dk) without materialising the GQA head repeat: q is viewed
+    as (R, C, KV, G, dk) and contracted per KV group."""
+    R, C, H, dk = q.shape
+    KV = cfg.num_key_value_heads
+    G = H // KV
+    qg = q.reshape(R, C, KV, G, dk)
+    scores = jnp.einsum(
+        "rckgd,rskd->rkgcs", qg, k_cache, preferred_element_type=jnp.float32
+    ) / math.sqrt(cfg.head_dim)
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("rkgcs,rskd->rckgd", probs, v_cache)
+    return out.reshape(R, C, H * dk)
+
+
+def serve_block(cfg: LLaMAConfig, p, x, cos, sin, mask, k_cache, v_cache, positions):
+    """One transformer block on a serving step: project, RoPE, scatter new
+    K/V into the cache at ``positions``, attend over the whole cache."""
+    R, C, D = x.shape
+    H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    h = _rms(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = _mm(h, p["wq"]).reshape(R, C, H, dk)
+    k = _mm(h, p["wk"]).reshape(R, C, KV, dk)
+    v = _mm(h, p["wv"]).reshape(R, C, KV, dk)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    bidx = jnp.arange(R)[:, None]
+    k_cache = k_cache.at[bidx, positions].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[bidx, positions].set(v.astype(v_cache.dtype))
+    attn = serve_attention(cfg, q, k_cache, v_cache, mask)
+    x = x + _mm(attn, p["wo"])
+    h2 = _rms(x, p["ffn_norm"], cfg.rms_norm_eps)
+    ffn = _mm(jax.nn.silu(_mm(h2, p["w1"])) * _mm(h2, p["w3"]), p["w2"])
+    return x + ffn, k_cache, v_cache
+
+
+def serve_step(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,     # (R, C) int32; padding points at scratch pos
+    positions: jnp.ndarray,  # (R, C) int32 cache positions (S = scratch)
+    logits_idx: jnp.ndarray, # (R,) int32 chunk index whose logits to return
+    mask: Optional[jnp.ndarray],  # (R, C, S+1) bool, or None => causal
+    *,
+    cfg: LLaMAConfig,
+    all_logits: bool = False,
+):
+    """One serving step over R request slots × C tokens each.
+
+    Returns (logits, new_cache): logits (R, V) at ``logits_idx`` or
+    (R, C, V) when ``all_logits`` (tree verification needs every token's
+    logits, reference tree_inc_multihead_self_attention.cu).
+    """
+    R, C = tokens.shape
+    S1 = cache["k"].shape[2]  # max_len + 1 (scratch row)
+    x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+    cos, sin = rope_freqs(cfg, positions)
+    if mask is None:
+        # Causal-by-position: a token attends to every cache line at
+        # position <= its own. Only positions already written satisfy
+        # this, so stale lines from an evicted request are never read.
+        key_pos = jnp.arange(S1, dtype=jnp.int32)
+        mask = key_pos[None, None, :] <= positions[:, :, None]
+        mask = mask & (key_pos[None, None, :] < S1 - 1)  # never the scratch row
+
+    def scan_body(h, xs):
+        p_l, kc, vc = xs
+        h, kc, vc = serve_block(cfg, p_l, h, cos, sin, mask, kc, vc, positions)
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rms(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    if not all_logits:
+        x = jnp.take_along_axis(x, logits_idx[:, None, None], axis=1)  # (R,1,D)
+        logits = jnp.matmul(x, head, preferred_element_type=jnp.float32)[:, 0]
+    else:
+        logits = jnp.matmul(x, head, preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
 
 
 def num_params(cfg: LLaMAConfig) -> int:

@@ -1,0 +1,23 @@
+"""Serving stack — continuous batching, incremental decoding, SpecInfer.
+
+TPU-native counterpart of the reference serving layer (reference
+``src/runtime/request_manager.cc``, ``inference_manager.cc``,
+``batch_config.cc``, SURVEY.md §2.1 "Serving"). The Legion future pipeline
+becomes an async host loop over donated-buffer jitted step functions; the
+three attention operators become one compiled program per static mode.
+"""
+from .batch_config import BatchConfig, GenerationConfig, GenerationResult
+from .engine import InferenceEngine, ServingConfig
+from .request_manager import Request, RequestManager
+from .sampling import sample_tokens
+
+__all__ = [
+    "BatchConfig",
+    "GenerationConfig",
+    "GenerationResult",
+    "InferenceEngine",
+    "ServingConfig",
+    "Request",
+    "RequestManager",
+    "sample_tokens",
+]

@@ -1,0 +1,100 @@
+"""Batch descriptors — host-side PODs shipped to the device each step.
+
+Mirrors the reference's ``BatchConfig`` family (reference
+``include/flexflow/batch_config.h:39-201``, ``src/runtime/batch_config.cc``):
+fixed-size padded arrays describing which request slot each token belongs
+to and where it lands in the KV cache. The reference ships these to every
+GPU as Legion futures; here they become the (static-shape) arguments of
+the jitted step function, so padding to the compile-time maxima plays the
+same role static shapes play for XLA.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# Reference limits (batch_config.h:58-60,157-161). Ours are configurable
+# via ServingConfig; these are the defaults.
+MAX_NUM_REQUESTS = 16
+MAX_NUM_TOKENS = 1024
+MAX_SPEC_TREE_TOKEN_NUM = 64
+MAX_BEAM_WIDTH = 3
+MAX_BEAM_DEPTH = 8
+
+
+@dataclasses.dataclass
+class BatchConfig:
+    """One step's device inputs, padded to (num_slots, chunk).
+
+    ``positions`` of padding tokens point at the cache's scratch row so
+    their K/V writes are harmless (models/llama.py init_kv_cache).
+    """
+
+    tokens: np.ndarray        # (R, C) int32
+    positions: np.ndarray     # (R, C) int32
+    logits_idx: np.ndarray    # (R,) int32 — which chunk index to sample from
+    active: np.ndarray        # (R,) bool — slots participating this step
+    mask: Optional[np.ndarray] = None  # (R, C, S+1) bool; None => causal
+
+    @property
+    def num_slots(self) -> int:
+        return self.tokens.shape[0]
+
+    @property
+    def chunk(self) -> int:
+        return self.tokens.shape[1]
+
+    @classmethod
+    def empty(cls, num_slots: int, chunk: int, scratch_pos: int) -> "BatchConfig":
+        return cls(
+            tokens=np.zeros((num_slots, chunk), np.int32),
+            positions=np.full((num_slots, chunk), scratch_pos, np.int32),
+            logits_idx=np.zeros((num_slots,), np.int32),
+            active=np.zeros((num_slots,), bool),
+        )
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    """Per-request decode head parameters (reference ``GenerationConfig``
+    in inference/models/* and the sampling/argmax decode ops)."""
+
+    do_sample: bool = False
+    temperature: float = 0.8
+    topp: float = 0.95
+    topk: int = 0  # 0 = disabled
+    max_new_tokens: int = 128
+    stop_token_ids: tuple = ()
+
+
+@dataclasses.dataclass
+class ProfileInfo:
+    """Per-request profiling (reference ``ProfileInfo``,
+    request_manager.h:271-277: llm_decoding_steps + start/finish)."""
+
+    start_time: float = 0.0
+    finish_time: float = 0.0
+    llm_decoding_steps: int = 0
+    ssm_decoding_steps: int = 0
+    speculated_tokens: int = 0
+    accepted_tokens: int = 0
+
+    @property
+    def latency_s(self) -> float:
+        return max(0.0, self.finish_time - self.start_time)
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """reference ``GenerationResult`` (request_manager.h): token ids in +
+    out, detokenized text, profiling."""
+
+    request_id: int
+    prompt: str
+    input_tokens: List[int]
+    output_tokens: List[int]
+    output_text: str
+    profile: ProfileInfo

@@ -1,0 +1,137 @@
+"""InferenceEngine — compiled-step management for serving.
+
+TPU-native counterpart of the reference ``InferenceManager`` (reference
+``src/runtime/inference_manager.cc:81-708``): where the reference compiles
+the op graph per inference mode, assigns MachineViews per pipeline stage
+and allocates/reuses activation buffers, we jit one step function per
+static signature (chunk size × logits mode × mask mode) over a device
+mesh, with the KV cache donated through every call so steady-state
+decoding allocates nothing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.mesh import DATA_AXIS, MachineSpec
+from .batch_config import BatchConfig
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Serving limits (reference batch_config.h:58-60 + RequestManager
+    setters, request_manager.h)."""
+
+    max_requests_per_batch: int = 16
+    max_sequence_length: int = 2048
+    prefill_chunk: int = 128
+    max_spec_tree_tokens: int = 64
+    cache_dtype: Any = jnp.bfloat16
+
+    @property
+    def cache_len(self) -> int:
+        # Committed tokens + in-flight speculative tree slack
+        # (reference BatchConfig::MAX_SPEC_TREE_TOKEN_NUM headroom).
+        return self.max_sequence_length + self.max_spec_tree_tokens
+
+
+class InferenceEngine:
+    """Owns device-resident params + KV cache and the jitted step fns.
+
+    ``model`` is a model-family module exposing the serving protocol:
+    ``init_kv_cache(cfg, slots, max_len, dtype)`` and
+    ``serve_step(params, cache, tokens, positions, logits_idx, mask,
+    *, cfg, all_logits)`` (see models/llama.py).
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        cfg: Any,
+        params: Dict[str, Any],
+        serving: Optional[ServingConfig] = None,
+        mesh: Optional[Mesh] = None,
+    ):
+        self.model = model
+        self.cfg = cfg
+        self.serving = serving or ServingConfig()
+        self.mesh = mesh or MachineSpec().make_mesh(jax.devices()[:1])
+        self.params = params
+        self._steps: Dict[Tuple[int, bool, bool], Callable] = {}
+        self.cache = self._alloc_cache()
+
+    def _alloc_cache(self):
+        """Allocate the KV cache sharded over the mesh (the model's
+        kv_cache_pspecs: slots on the data axis, KV heads on the model
+        axis) — the analog of the reference's per-shard tensor_buffer
+        allocation (inference_manager.cc:143-200)."""
+        sc = self.serving
+        init = functools.partial(
+            self.model.init_kv_cache,
+            self.cfg,
+            sc.max_requests_per_batch,
+            sc.cache_len,
+            sc.cache_dtype,
+        )
+        with jax.set_mesh(self.mesh):
+            if any(n > 1 for n in self.mesh.shape.values()):
+                pspecs = self.model.kv_cache_pspecs()
+                shardings = jax.tree.map(
+                    lambda p: NamedSharding(self.mesh, p),
+                    pspecs,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+                return jax.jit(init, out_shardings=shardings)()
+            return init()
+
+    @property
+    def scratch_pos(self) -> int:
+        return self.serving.cache_len
+
+    @property
+    def num_slots(self) -> int:
+        return self.serving.max_requests_per_batch
+
+    # ------------------------------------------------------------------
+
+    def _get_step(self, chunk: int, all_logits: bool, with_mask: bool):
+        """One compiled program per static signature — the analog of the
+        reference's per-InferenceMode compiled graphs (compile_inference),
+        cached like Legion's replayed traces."""
+        key = (chunk, all_logits, with_mask)
+        if key not in self._steps:
+            fn = functools.partial(
+                self.model.serve_step, cfg=self.cfg, all_logits=all_logits
+            )
+
+            def step(params, cache, tokens, positions, logits_idx, mask):
+                return fn(params, cache, tokens, positions, logits_idx, mask)
+
+            self._steps[key] = jax.jit(step, donate_argnums=(1,))
+        return self._steps[key]
+
+    def run(self, bc: BatchConfig, all_logits: bool = False):
+        """Dispatch one step (reference ``InferenceManager::inference``,
+        inference_manager.cc:334). Returns logits on device; the cache is
+        advanced in place (donated)."""
+        with jax.set_mesh(self.mesh):
+            step = self._get_step(bc.chunk, all_logits, bc.mask is not None)
+            logits, self.cache = step(
+                self.params,
+                self.cache,
+                jnp.asarray(bc.tokens),
+                jnp.asarray(bc.positions),
+                jnp.asarray(bc.logits_idx),
+                jnp.asarray(bc.mask) if bc.mask is not None else None,
+            )
+        return logits
+
+    def reset(self):
+        """Drop all cached sequences (fresh KV cache)."""
+        self.cache = self._alloc_cache()

@@ -1,0 +1,282 @@
+"""RequestManager — request queue + continuous batching + decoding loops.
+
+TPU-native counterpart of the reference ``RequestManager`` (reference
+``src/runtime/request_manager.cc:1-2435``): tokenize + queue incoming
+requests, admit them into free batch slots, build per-step BatchConfigs
+(``prepare_next_batch``, :350), run the incremental-decoding loop
+(``generate_incr_decoding``, :2292), track per-request profiling, and
+free slots on completion. Prompt processing is *chunked prefill*: a
+prompt enters the batch in fixed-size chunks so prefill and decode share
+one program shape per mode and new arrivals join without a full-batch
+retrace (the reference's equivalent is padding to MAX_NUM_TOKENS).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .batch_config import (
+    BatchConfig,
+    GenerationConfig,
+    GenerationResult,
+    ProfileInfo,
+)
+from .engine import InferenceEngine
+from .sampling import sample_tokens
+
+
+class RequestStatus(enum.Enum):
+    PENDING = "pending"
+    PREFILLING = "prefilling"
+    DECODING = "decoding"
+    COMPLETED = "completed"
+
+
+@dataclasses.dataclass
+class Request:
+    """reference ``Request`` (request_manager.h:92-278)."""
+
+    request_id: int
+    prompt: str
+    tokens: List[int]                 # prompt + generated so far
+    prompt_len: int
+    gen: GenerationConfig
+    status: RequestStatus = RequestStatus.PENDING
+    slot: int = -1
+    n_cached: int = 0                 # tokens whose K/V are in the cache
+    profile: ProfileInfo = dataclasses.field(default_factory=ProfileInfo)
+
+    @property
+    def output_tokens(self) -> List[int]:
+        return self.tokens[self.prompt_len :]
+
+
+class RequestManager:
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        tokenizer: Any = None,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.eos_token_id = eos_token_id
+        if eos_token_id is None and tokenizer is not None:
+            self.eos_token_id = getattr(tokenizer, "eos_token_id", None)
+        self.requests: Dict[int, Request] = {}
+        self.pending: List[int] = []
+        self.slots: List[Optional[int]] = [None] * engine.num_slots
+        self._next_id = 1000000  # reference starts guids at 1000000
+        self._key = jax.random.PRNGKey(seed)
+        self._step_counter = 0
+
+    # ------------------------------------------------------------------
+    # registration (reference register_new_request, request_manager.cc:137)
+
+    def register_request(
+        self,
+        prompt: Union[str, Sequence[int]],
+        gen: Optional[GenerationConfig] = None,
+    ) -> int:
+        gen = gen or GenerationConfig()
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompt requires a tokenizer")
+            tokens = list(self.tokenizer.encode(prompt))
+            text = prompt
+        else:
+            tokens = [int(t) for t in prompt]
+            text = ""
+        if not tokens:
+            raise ValueError("empty prompt")
+        max_len = self.engine.serving.max_sequence_length
+        if len(tokens) >= max_len:
+            tokens = tokens[: max_len - 1]
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(
+            request_id=rid,
+            prompt=text,
+            tokens=list(tokens),
+            prompt_len=len(tokens),
+            gen=gen,
+        )
+        req.profile.start_time = time.perf_counter()
+        self.requests[rid] = req
+        self.pending.append(rid)
+        return rid
+
+    # ------------------------------------------------------------------
+    # slot management
+
+    def _admit_pending(self):
+        for i, occupant in enumerate(self.slots):
+            if occupant is not None or not self.pending:
+                continue
+            rid = self.pending.pop(0)
+            req = self.requests[rid]
+            req.slot = i
+            req.status = RequestStatus.PREFILLING
+            req.n_cached = 0
+            self.slots[i] = rid
+
+    def _active(self, status: RequestStatus) -> List[Request]:
+        out = []
+        for rid in self.slots:
+            if rid is None:
+                continue
+            r = self.requests[rid]
+            if r.status is status:
+                out.append(r)
+        return out
+
+    def _finish(self, req: Request):
+        req.status = RequestStatus.COMPLETED
+        req.profile.finish_time = time.perf_counter()
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+            req.slot = -1
+
+    # ------------------------------------------------------------------
+    # batch building (reference prepare_next_batch, request_manager.cc:350)
+
+    def _prepare_batch(self) -> Optional[BatchConfig]:
+        """Build one mixed prefill+decode batch. Decoding slots always
+        contribute their one pending token, so decode never stalls behind
+        a long prompt's prefill (no head-of-line blocking); the chunk is
+        1 when nobody is prefilling."""
+        prefilling = self._active(RequestStatus.PREFILLING)
+        decoding = self._active(RequestStatus.DECODING)
+        if not prefilling and not decoding:
+            return None
+        sc = self.engine.serving
+        chunk = sc.prefill_chunk if prefilling else 1
+        bc = BatchConfig.empty(self.engine.num_slots, chunk, self.engine.scratch_pos)
+        for req in prefilling:
+            off = req.n_cached
+            toks = req.tokens[off : off + chunk]
+            n = len(toks)
+            bc.tokens[req.slot, :n] = toks
+            bc.positions[req.slot, :n] = np.arange(off, off + n)
+            bc.active[req.slot] = True
+            bc.logits_idx[req.slot] = n - 1
+        for req in decoding:
+            bc.tokens[req.slot, 0] = req.tokens[-1]
+            bc.positions[req.slot, 0] = len(req.tokens) - 1
+            bc.active[req.slot] = True
+            bc.logits_idx[req.slot] = 0
+        return bc
+
+    # ------------------------------------------------------------------
+    # sampling glue
+
+    def _sample(self, logits) -> np.ndarray:
+        """Sample one token per slot from (R, V) logits using each slot's
+        GenerationConfig (mixed greedy/sampling in one program)."""
+        R = self.engine.num_slots
+        greedy = np.ones((R,), bool)
+        temp = np.ones((R,), np.float32)
+        topp = np.ones((R,), np.float32) * 2.0  # disabled
+        for rid in self.slots:
+            if rid is None:
+                continue
+            req = self.requests[rid]
+            greedy[req.slot] = not req.gen.do_sample
+            temp[req.slot] = req.gen.temperature
+            topp[req.slot] = req.gen.topp if req.gen.do_sample else 2.0
+        self._key, sub = jax.random.split(self._key)
+        toks = sample_tokens(
+            logits,
+            sub,
+            greedy=jnp.asarray(greedy),
+            temperature=jnp.asarray(temp),
+            topp=jnp.asarray(topp),
+        )
+        return np.asarray(jax.device_get(toks))
+
+    def _append_token(self, req: Request, token: int):
+        req.tokens.append(int(token))
+        req.profile.llm_decoding_steps += 1
+        gen_len = len(req.tokens) - req.prompt_len
+        eos = self.eos_token_id
+        max_total = self.engine.serving.max_sequence_length
+        stops = set(req.gen.stop_token_ids)
+        if eos is not None:
+            stops.add(eos)
+        if (
+            (int(token) in stops)
+            or gen_len >= req.gen.max_new_tokens
+            or len(req.tokens) >= max_total
+        ):
+            self._finish(req)
+
+    # ------------------------------------------------------------------
+    # incremental decoding loop (reference generate_incr_decoding, :2292)
+
+    def step(self) -> bool:
+        """One scheduling step. Returns False when no work remains."""
+        self._admit_pending()
+        bc = self._prepare_batch()
+        if bc is None:
+            return bool(self.pending)
+        prefilling = self._active(RequestStatus.PREFILLING)
+        decoding = self._active(RequestStatus.DECODING)
+        logits = self.engine.run(bc)
+        sampled = self._sample(logits)
+        for req in decoding:
+            req.n_cached += 1
+            self._append_token(req, sampled[req.slot])
+        for req in prefilling:
+            n = int(bc.logits_idx[req.slot]) + 1  # tokens cached this chunk
+            req.n_cached += n
+            if req.n_cached >= len(req.tokens):
+                # prompt fully cached: first output token sampled now
+                req.status = RequestStatus.DECODING
+                self._append_token(req, sampled[req.slot])
+        self._step_counter += 1
+        return True
+
+    def generate(
+        self,
+        prompts: Union[str, Sequence[Union[str, Sequence[int]]]],
+        gen: Optional[GenerationConfig] = None,
+        max_new_tokens: Optional[int] = None,
+    ) -> List[GenerationResult]:
+        """Blocking generate over a batch of prompts (reference
+        ``FFModel::generate`` → ``generate_incr_decoding``)."""
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        gen = gen or GenerationConfig()
+        if max_new_tokens is not None:
+            gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
+        rids = [self.register_request(p, gen) for p in prompts]
+        while any(
+            self.requests[r].status is not RequestStatus.COMPLETED for r in rids
+        ):
+            if not self.step():
+                break
+        results = []
+        for rid in rids:
+            req = self.requests[rid]
+            out = req.output_tokens
+            text = (
+                self.tokenizer.decode(out) if self.tokenizer is not None else ""
+            )
+            results.append(
+                GenerationResult(
+                    request_id=rid,
+                    prompt=req.prompt,
+                    input_tokens=req.tokens[: req.prompt_len],
+                    output_tokens=list(out),
+                    output_text=text,
+                    profile=req.profile,
+                )
+            )
+        return results

@@ -1,0 +1,75 @@
+"""Decode heads: greedy argmax, temperature, top-k, top-p sampling.
+
+TPU-native equivalents of the reference decode operators ``argmax``,
+``sampling`` (top-p via sorted cumsum, reference ``src/ops/sampling.cc``),
+``arg_topk``/``beam_topk`` (reference ``src/ops/arg_topk.cc``,
+``beam_topk.cc``). One jitted function handles a whole batch with
+per-request parameters as arrays, so mixed greedy/sampling batches run in
+a single program (the reference dispatches per-model decode-head ops).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _apply_topk(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Static-k top-k filter: keep the k largest logits per row."""
+    if k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _topp_filter(logits: jnp.ndarray, topp: jnp.ndarray) -> jnp.ndarray:
+    """Top-p (nucleus) filter — sorted cumulative-probability cut exactly
+    like the reference's sorted-cumsum kernel (sampling.cc). ``topp`` is
+    per-row (R,); topp >= 1 keeps everything."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # Keep tokens while the cumulative mass *before* them is < topp.
+    keep_sorted = (cum - sorted_probs) < topp[..., None]
+    # Threshold logit: smallest kept logit per row.
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+@functools.partial(jax.jit, static_argnames=("topk",))
+def sample_tokens(
+    logits: jnp.ndarray,      # (R, V) float
+    key: jax.Array,
+    *,
+    greedy: jnp.ndarray,      # (R,) bool — argmax instead of sampling
+    temperature: jnp.ndarray, # (R,) float
+    topp: jnp.ndarray,        # (R,) float; >=1 disables
+    topk: int = 0,            # static; 0 disables
+) -> jnp.ndarray:
+    """Sample one token per request slot. Returns (R,) int32."""
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temperature, 1e-6)[..., None]
+    scaled = logits / t
+    scaled = _apply_topk(scaled, topk)
+    scaled = _topp_filter(scaled, topp)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(greedy, greedy_tok, sampled)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def beam_topk(logprobs: jnp.ndarray, k: int):
+    """Top-k over the vocab per row — the SSM beam expansion head
+    (reference ``beam_topk.cc``). Returns (values, indices) each (..., k)."""
+    return jax.lax.top_k(logprobs, k)
+
+
+@jax.jit
+def log_softmax(logits: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

@@ -1,0 +1,149 @@
+"""Serving-stack tests — mirrors the reference's inference test strategy
+(reference tests/inference/python_inference_tests.sh): incremental
+decoding must match a naive full-forward greedy loop, chunked prefill
+must match single-shot prefill, and continuous batching must not change
+any request's output.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import (
+    GenerationConfig,
+    InferenceEngine,
+    RequestManager,
+    ServingConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def ref_greedy(cfg, params, prompt, n_new):
+    """Naive reference decoder: full forward over the growing sequence."""
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = llama.forward(
+            params, jnp.asarray([toks], dtype=jnp.int32), cfg
+        )
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def make_engine(tiny, **kw):
+    cfg, params = tiny
+    sc = ServingConfig(
+        max_requests_per_batch=4,
+        max_sequence_length=96,
+        prefill_chunk=8,
+        max_spec_tree_tokens=8,
+        cache_dtype=jnp.float32,
+        **kw,
+    )
+    return InferenceEngine(llama, cfg, params, sc)
+
+
+class TestIncrementalDecoding:
+    def test_matches_full_forward_greedy(self, tiny):
+        cfg, params = tiny
+        eng = make_engine(tiny)
+        rm = RequestManager(eng)
+        prompt = [3, 17, 91, 42, 7]
+        out = rm.generate([prompt], max_new_tokens=12)[0]
+        expect = ref_greedy(cfg, params, prompt, 12)
+        assert out.output_tokens == expect
+
+    def test_chunked_prefill_matches(self, tiny):
+        """Prompt longer than prefill_chunk → multiple prefill steps, same
+        output as the reference loop."""
+        cfg, params = tiny
+        eng = make_engine(tiny)
+        rm = RequestManager(eng)
+        prompt = [(i * 7 + 3) % cfg.vocab_size for i in range(21)]  # 3 chunks
+        out = rm.generate([prompt], max_new_tokens=8)[0]
+        assert out.output_tokens == ref_greedy(cfg, params, prompt, 8)
+
+    def test_continuous_batching_isolation(self, tiny):
+        """Multiple concurrent requests produce exactly the single-request
+        outputs (slot reuse + shared cache cannot leak across requests)."""
+        cfg, params = tiny
+        prompts = [
+            [1, 2, 3, 4],
+            [9, 8, 7, 6, 5, 4, 3, 2, 1, 11, 12, 13],
+            [100, 200],
+            [42] * 17,
+            [5, 10, 15],  # 5 requests > 4 slots: exercises queueing
+        ]
+        eng = make_engine(tiny)
+        rm = RequestManager(eng)
+        outs = rm.generate(prompts, max_new_tokens=6)
+        for p, o in zip(prompts, outs):
+            assert o.output_tokens == ref_greedy(cfg, params, p, 6), p
+
+    def test_slot_reuse_no_stale_cache(self, tiny):
+        """A request admitted into a previously-used slot must not read the
+        old occupant's KV lines."""
+        cfg, params = tiny
+        eng = make_engine(tiny)
+        rm = RequestManager(eng)
+        first = rm.generate([[7, 7, 7, 7, 7, 7, 7, 7]], max_new_tokens=4)[0]
+        second = rm.generate([[3, 1]], max_new_tokens=4)[0]
+        assert second.output_tokens == ref_greedy(cfg, params, [3, 1], 4)
+        assert first.output_tokens == ref_greedy(
+            cfg, params, [7] * 8, 4
+        )
+
+    def test_profiling_recorded(self, tiny):
+        eng = make_engine(tiny)
+        rm = RequestManager(eng)
+        out = rm.generate([[1, 2, 3]], max_new_tokens=5)[0]
+        assert out.profile.llm_decoding_steps == 5
+        assert out.profile.latency_s > 0
+
+
+class TestSampling:
+    def test_greedy_flag_matches_argmax(self):
+        from flexflow_tpu.serve.sampling import sample_tokens
+
+        logits = jnp.asarray(np.random.default_rng(0).normal(size=(4, 50)))
+        toks = sample_tokens(
+            logits,
+            jax.random.PRNGKey(0),
+            greedy=jnp.ones((4,), bool),
+            temperature=jnp.ones((4,)),
+            topp=jnp.ones((4,)) * 2,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.argmax(np.asarray(logits), -1)
+        )
+
+    def test_topp_restricts_support(self):
+        from flexflow_tpu.serve.sampling import sample_tokens
+
+        # One dominant token (prob ~1) → top-p 0.5 must always pick it.
+        logits = np.full((2, 32), -10.0, np.float32)
+        logits[:, 5] = 10.0
+        for i in range(20):
+            toks = sample_tokens(
+                jnp.asarray(logits),
+                jax.random.PRNGKey(i),
+                greedy=jnp.zeros((2,), bool),
+                temperature=jnp.ones((2,)),
+                topp=jnp.full((2,), 0.5),
+            )
+            assert np.all(np.asarray(toks) == 5)
+
+    def test_eos_stops_generation(self, tiny):
+        cfg, params = tiny
+        eng = make_engine(tiny)
+        # Find what greedy emits first, then declare it EOS.
+        first = ref_greedy(cfg, params, [4, 9], 1)[0]
+        rm = RequestManager(eng, eos_token_id=first)
+        out = rm.generate([[4, 9]], max_new_tokens=10)[0]
+        assert out.output_tokens == [first]
