@@ -454,7 +454,9 @@ def serve_attention(cfg: LLaMAConfig, q, k_cache, v_cache, mask):
 
 def serve_block(cfg: LLaMAConfig, p, x, cos, sin, mask, k_cache, v_cache, positions):
     """One transformer block on a serving step: project, RoPE, scatter new
-    K/V into the cache at ``positions``, attend over the whole cache."""
+    K/V into the cache at ``positions`` (cache line indices — for tree
+    tokens these differ from the RoPE positions baked into cos/sin),
+    attend over the whole cache."""
     R, C, D = x.shape
     H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     h = _rms(x, p["attn_norm"], cfg.rms_norm_eps)
@@ -477,14 +479,19 @@ def serve_step(
     params: Dict[str, Any],
     cache: Dict[str, jnp.ndarray],
     tokens: jnp.ndarray,     # (R, C) int32; padding points at scratch pos
-    positions: jnp.ndarray,  # (R, C) int32 cache positions (S = scratch)
+    positions: jnp.ndarray,  # (R, C) int32 RoPE/sequence positions
     logits_idx: jnp.ndarray, # (R,) int32 chunk index whose logits to return
     mask: Optional[jnp.ndarray],  # (R, C, S+1) bool, or None => causal
+    cache_positions: Optional[jnp.ndarray] = None,  # (R, C) cache line idx
     *,
     cfg: LLaMAConfig,
     all_logits: bool = False,
 ):
     """One serving step over R request slots × C tokens each.
+
+    ``cache_positions`` defaults to ``positions``; SpecInfer passes them
+    separately because sibling tree tokens share a sequence position
+    (prefix + depth) but need distinct cache lines (prefix + node index).
 
     Returns (logits, new_cache): logits (R, V) at ``logits_idx`` or
     (R, C, V) when ``all_logits`` (tree verification needs every token's
@@ -492,6 +499,8 @@ def serve_step(
     """
     R, C = tokens.shape
     S1 = cache["k"].shape[2]  # max_len + 1 (scratch row)
+    if cache_positions is None:
+        cache_positions = positions
     x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
     cos, sin = rope_freqs(cfg, positions)
     if mask is None:
@@ -504,7 +513,9 @@ def serve_step(
 
     def scan_body(h, xs):
         p_l, kc, vc = xs
-        h, kc, vc = serve_block(cfg, p_l, h, cos, sin, mask, kc, vc, positions)
+        h, kc, vc = serve_block(
+            cfg, p_l, h, cos, sin, mask, kc, vc, cache_positions
+        )
         return h, (kc, vc)
 
     x, (k_new, v_new) = lax.scan(
@@ -518,6 +529,26 @@ def serve_step(
     else:
         logits = jnp.matmul(x, head, preferred_element_type=jnp.float32)
     return logits, {"k": k_new, "v": v_new}
+
+
+def commit_kv(
+    cache: Dict[str, jnp.ndarray],
+    src: jnp.ndarray,  # (R, K) int32 cache lines to keep (tree node lines)
+    dst: jnp.ndarray,  # (R, K) int32 destination lines (contiguous suffix)
+) -> Dict[str, jnp.ndarray]:
+    """Move accepted speculative K/V lines into their committed positions
+    — the TPU-native version of the reference's token-commit copy kernels
+    (reference ``request_manager.cu`` commit_tokens + the KV-cache commit
+    in ``tree_inc_multihead_self_attention.cu``). Unused slots should map
+    scratch→scratch. Functional gather-then-scatter, so overlapping
+    src/dst ranges are safe."""
+    R = src.shape[0]
+    bidx = jnp.arange(R)[:, None]
+    out = {}
+    for name, buf in cache.items():  # (L, R, S1, KV, dk)
+        rows = buf[:, bidx, src]     # (L, R, K, KV, dk)
+        out[name] = buf.at[:, bidx, dst].set(rows)
+    return out
 
 
 def num_params(cfg: LLaMAConfig) -> int:

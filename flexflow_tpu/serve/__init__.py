@@ -10,6 +10,7 @@ from .batch_config import BatchConfig, GenerationConfig, GenerationResult
 from .engine import InferenceEngine, ServingConfig
 from .request_manager import Request, RequestManager
 from .sampling import sample_tokens
+from .specinfer import SpecConfig, SpecInferManager, TokenTree
 
 __all__ = [
     "BatchConfig",
@@ -20,4 +21,7 @@ __all__ = [
     "Request",
     "RequestManager",
     "sample_tokens",
+    "SpecConfig",
+    "SpecInferManager",
+    "TokenTree",
 ]

@@ -34,10 +34,13 @@ class BatchConfig:
     """
 
     tokens: np.ndarray        # (R, C) int32
-    positions: np.ndarray     # (R, C) int32
+    positions: np.ndarray     # (R, C) int32 RoPE/sequence positions
     logits_idx: np.ndarray    # (R,) int32 — which chunk index to sample from
     active: np.ndarray        # (R,) bool — slots participating this step
     mask: Optional[np.ndarray] = None  # (R, C, S+1) bool; None => causal
+    # Cache line indices when they differ from sequence positions (tree
+    # tokens: siblings share a position but need distinct lines).
+    cache_positions: Optional[np.ndarray] = None
 
     @property
     def num_slots(self) -> int:

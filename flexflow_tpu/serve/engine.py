@@ -44,10 +44,11 @@ class ServingConfig:
 class InferenceEngine:
     """Owns device-resident params + KV cache and the jitted step fns.
 
-    ``model`` is a model-family module exposing the serving protocol:
-    ``init_kv_cache(cfg, slots, max_len, dtype)`` and
+    ``model`` is a model-family module exposing the serving protocol
+    (see models/llama.py): ``init_kv_cache(cfg, slots, max_len, dtype)``,
+    ``commit_kv(cache, src, dst)`` and
     ``serve_step(params, cache, tokens, positions, logits_idx, mask,
-    *, cfg, all_logits)`` (see models/llama.py).
+    cache_positions, *, cfg, all_logits)``.
     """
 
     def __init__(
@@ -64,6 +65,7 @@ class InferenceEngine:
         self.mesh = mesh or MachineSpec().make_mesh(jax.devices()[:1])
         self.params = params
         self._steps: Dict[Tuple[int, bool, bool], Callable] = {}
+        self._commit: Optional[Callable] = None
         self.cache = self._alloc_cache()
 
     def _alloc_cache(self):
@@ -110,8 +112,8 @@ class InferenceEngine:
                 self.model.serve_step, cfg=self.cfg, all_logits=all_logits
             )
 
-            def step(params, cache, tokens, positions, logits_idx, mask):
-                return fn(params, cache, tokens, positions, logits_idx, mask)
+            def step(params, cache, tokens, positions, logits_idx, mask, cpos):
+                return fn(params, cache, tokens, positions, logits_idx, mask, cpos)
 
             self._steps[key] = jax.jit(step, donate_argnums=(1,))
         return self._steps[key]
@@ -129,8 +131,21 @@ class InferenceEngine:
                 jnp.asarray(bc.positions),
                 jnp.asarray(bc.logits_idx),
                 jnp.asarray(bc.mask) if bc.mask is not None else None,
+                jnp.asarray(bc.cache_positions)
+                if bc.cache_positions is not None
+                else None,
             )
         return logits
+
+    def commit(self, src: np.ndarray, dst: np.ndarray):
+        """Move accepted speculative cache lines to committed positions
+        (src/dst (R, K); unused entries scratch→scratch)."""
+        if self._commit is None:
+            self._commit = jax.jit(self.model.commit_kv, donate_argnums=(0,))
+        with jax.set_mesh(self.mesh):
+            self.cache = self._commit(
+                self.cache, jnp.asarray(src), jnp.asarray(dst)
+            )
 
     def reset(self):
         """Drop all cached sequences (fresh KV cache)."""

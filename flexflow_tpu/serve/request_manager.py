@@ -147,6 +147,15 @@ class RequestManager:
     # ------------------------------------------------------------------
     # batch building (reference prepare_next_batch, request_manager.cc:350)
 
+    def _fill_prefill_row(self, bc: BatchConfig, req: Request, chunk: int):
+        off = req.n_cached
+        toks = req.tokens[off : off + chunk]
+        n = len(toks)
+        bc.tokens[req.slot, :n] = toks
+        bc.positions[req.slot, :n] = np.arange(off, off + n)
+        bc.active[req.slot] = True
+        bc.logits_idx[req.slot] = n - 1
+
     def _prepare_batch(self) -> Optional[BatchConfig]:
         """Build one mixed prefill+decode batch. Decoding slots always
         contribute their one pending token, so decode never stalls behind
@@ -160,13 +169,7 @@ class RequestManager:
         chunk = sc.prefill_chunk if prefilling else 1
         bc = BatchConfig.empty(self.engine.num_slots, chunk, self.engine.scratch_pos)
         for req in prefilling:
-            off = req.n_cached
-            toks = req.tokens[off : off + chunk]
-            n = len(toks)
-            bc.tokens[req.slot, :n] = toks
-            bc.positions[req.slot, :n] = np.arange(off, off + n)
-            bc.active[req.slot] = True
-            bc.logits_idx[req.slot] = n - 1
+            self._fill_prefill_row(bc, req, chunk)
         for req in decoding:
             bc.tokens[req.slot, 0] = req.tokens[-1]
             bc.positions[req.slot, 0] = len(req.tokens) - 1
@@ -203,7 +206,6 @@ class RequestManager:
 
     def _append_token(self, req: Request, token: int):
         req.tokens.append(int(token))
-        req.profile.llm_decoding_steps += 1
         gen_len = len(req.tokens) - req.prompt_len
         eos = self.eos_token_id
         max_total = self.engine.serving.max_sequence_length
@@ -232,6 +234,7 @@ class RequestManager:
         sampled = self._sample(logits)
         for req in decoding:
             req.n_cached += 1
+            req.profile.llm_decoding_steps += 1
             self._append_token(req, sampled[req.slot])
         for req in prefilling:
             n = int(bc.logits_idx[req.slot]) + 1  # tokens cached this chunk
@@ -239,6 +242,7 @@ class RequestManager:
             if req.n_cached >= len(req.tokens):
                 # prompt fully cached: first output token sampled now
                 req.status = RequestStatus.DECODING
+                req.profile.llm_decoding_steps += 1
                 self._append_token(req, sampled[req.slot])
         self._step_counter += 1
         return True

@@ -1,0 +1,313 @@
+"""SpecInfer — speculative inference with token-tree verification.
+
+TPU-native counterpart of the reference SpecInfer loop (reference
+``src/runtime/request_manager.cc:2349-2421`` ``generate_spec_infer``,
+``BeamSearchBatchConfig``/``TreeVerifyBatchConfig`` ``batch_config.h:
+133-190``, and the spec/tree attention kernels ``spec_inc_multihead_self_
+attention.cu``, ``tree_inc_multihead_self_attention.cu``):
+
+* A small speculative model (SSM) grows a **token tree** per request by
+  beam expansion. Tree nodes live in the *speculative slack region* of
+  the SSM's own KV cache — each frontier step runs the shared
+  ``serve_step`` in tree-mask mode (siblings share a RoPE position
+  ``prefix+depth`` but occupy distinct cache lines ``prefix+node``), so
+  beams fork without copying any cache (the reference's sub-request
+  beam attention achieves the same sharing).
+* The LLM **verifies the whole tree in one step** with a causal bitmask
+  (ancestors-or-self), the reference's tree-verify attention.
+* The longest accepted root path is **committed** by moving its K/V
+  lines inside both caches (``commit_kv``) — the SSM therefore never
+  re-prefills committed tokens.
+
+Greedy verification: accepted output is token-identical to incremental
+greedy decoding (the property the reference's inference tests assert,
+``tests/inference/python_inference_tests.sh:111-123``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .batch_config import BatchConfig, GenerationConfig
+from .engine import InferenceEngine
+from .request_manager import Request, RequestManager, RequestStatus
+from .sampling import beam_topk, log_softmax
+
+
+@jax.jit
+def _greedy(logits):
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+class TokenTree:
+    """Host-side speculative token tree (reference ``BeamTree``,
+    batch_config.h:157-190 + RequestManager::traverse_beam_tree)."""
+
+    def __init__(self, root_token: int):
+        self.tokens: List[int] = [int(root_token)]
+        self.parents: List[int] = [-1]
+        self.depths: List[int] = [0]
+        self.logprobs: List[float] = [0.0]
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def add(self, token: int, parent: int, logprob: float) -> Optional[int]:
+        """Add a child; duplicate (parent, token) pairs are merged (the
+        analog of the reference's merge_dfs_trees dedup)."""
+        for i, (p, t) in enumerate(zip(self.parents, self.tokens)):
+            if p == parent and t == int(token):
+                return None
+        self.tokens.append(int(token))
+        self.parents.append(int(parent))
+        self.depths.append(self.depths[parent] + 1)
+        self.logprobs.append(float(logprob))
+        return len(self.tokens) - 1
+
+    def children(self, node: int) -> List[int]:
+        return [i for i, p in enumerate(self.parents) if p == node]
+
+    def ancestor_matrix(self) -> np.ndarray:
+        """anc[i, j] = node j is an ancestor of i or i itself — the causal
+        BitMask of the reference (batch_config.h:85-99)."""
+        n = len(self.tokens)
+        anc = np.zeros((n, n), bool)
+        for i in range(n):
+            j = i
+            while j >= 0:
+                anc[i, j] = True
+                j = self.parents[j]
+        return anc
+
+    def accept_greedy(self, greedy_next: np.ndarray) -> Tuple[List[int], int]:
+        """Walk from the root accepting children that match the LLM's
+        greedy prediction (reference traverse_verify_tree). Returns
+        (accepted node indices incl. root, bonus token)."""
+        path = [0]
+        cur = 0
+        while True:
+            target = int(greedy_next[cur])
+            nxt = None
+            for c in self.children(cur):
+                if self.tokens[c] == target:
+                    nxt = c
+                    break
+            if nxt is None:
+                return path, target
+            path.append(nxt)
+            cur = nxt
+
+
+@dataclasses.dataclass
+class SpecConfig:
+    """Speculation shape (reference MAX_BEAM_WIDTH=3 / MAX_BEAM_DEPTH=8,
+    batch_config.h:157-161)."""
+
+    beam_width: int = 2
+    beam_depth: int = 4
+
+    @property
+    def max_tree_tokens(self) -> int:
+        return 1 + self.beam_width * self.beam_depth
+
+
+class SpecInferManager(RequestManager):
+    """Request manager driving the SSM-speculate → LLM-verify loop.
+
+    The LLM engine and SSM engine share slot assignment and serving
+    limits; both caches always hold the same committed prefix per slot.
+    """
+
+    def __init__(
+        self,
+        llm_engine: InferenceEngine,
+        ssm_engine: InferenceEngine,
+        spec: Optional[SpecConfig] = None,
+        tokenizer: Any = None,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        super().__init__(llm_engine, tokenizer, eos_token_id, seed)
+        self.ssm = ssm_engine
+        self.spec = spec or SpecConfig()
+        assert (
+            ssm_engine.num_slots == llm_engine.num_slots
+            and ssm_engine.serving.cache_len == llm_engine.serving.cache_len
+        ), "LLM and SSM engines must share serving limits"
+        assert (
+            self.spec.max_tree_tokens
+            <= llm_engine.serving.max_spec_tree_tokens
+        ), "tree larger than the cache's speculative slack region"
+
+    # ------------------------------------------------------------------
+    # batch builders
+
+    def _tree_chunk_batch(
+        self,
+        engine: InferenceEngine,
+        reqs: List[Request],
+        trees: Dict[int, TokenTree],
+        node_lists: Dict[int, List[int]],
+        chunk: int,
+    ) -> BatchConfig:
+        """Batch feeding, per request, the tree nodes in ``node_lists``
+        (new frontier for SSM expansion; all nodes for LLM verify).
+        RoPE position = prefix + depth; cache line = prefix + node index;
+        mask = committed prefix + ancestors-or-self."""
+        S1 = engine.serving.cache_len + 1
+        R = engine.num_slots
+        bc = BatchConfig.empty(R, chunk, engine.scratch_pos)
+        bc.cache_positions = np.full((R, chunk), engine.scratch_pos, np.int32)
+        bc.mask = np.zeros((R, chunk, S1), bool)
+        for req in reqs:
+            tree = trees[req.request_id]
+            nodes = node_lists[req.request_id]
+            anc = tree.ancestor_matrix()
+            prefix = req.n_cached
+            for c, node in enumerate(nodes):
+                bc.tokens[req.slot, c] = tree.tokens[node]
+                bc.positions[req.slot, c] = prefix + tree.depths[node]
+                bc.cache_positions[req.slot, c] = prefix + node
+                bc.mask[req.slot, c, :prefix] = True
+                bc.mask[req.slot, c, prefix : prefix + len(tree)] = anc[node]
+            bc.active[req.slot] = True
+        return bc
+
+    # ------------------------------------------------------------------
+    # the SpecInfer round
+
+    def _grow_trees(self, reqs: List[Request]) -> Dict[int, TokenTree]:
+        """SSM beam expansion (reference prepare_next_batch_beam loop,
+        request_manager.cc:2397-2407): depth × (feed frontier, top-k per
+        beam, prune to beam_width by cumulative logprob)."""
+        W, D = self.spec.beam_width, self.spec.beam_depth
+        trees = {r.request_id: TokenTree(r.tokens[-1]) for r in reqs}
+        frontier = {r.request_id: [0] for r in reqs}
+        for depth in range(D):
+            node_lists = {
+                rid: nodes[:W] for rid, nodes in frontier.items()
+            }
+            bc = self._tree_chunk_batch(self.ssm, reqs, trees, node_lists, W)
+            logits = self.ssm.run(bc, all_logits=True)  # (R, W, V)
+            vals, idxs = beam_topk(log_softmax(logits), W)
+            vals = np.asarray(jax.device_get(vals))
+            idxs = np.asarray(jax.device_get(idxs))
+            for req in reqs:
+                rid = req.request_id
+                tree = trees[rid]
+                cands = []
+                for c, node in enumerate(node_lists[rid]):
+                    base = tree.logprobs[node]
+                    for k in range(W):
+                        cands.append(
+                            (
+                                base + float(vals[req.slot, c, k]),
+                                int(idxs[req.slot, c, k]),
+                                node,
+                            )
+                        )
+                cands.sort(key=lambda t: -t[0])
+                new_frontier = []
+                for lp, tok, parent in cands[:W]:
+                    idx = tree.add(tok, parent, lp)
+                    if idx is not None:
+                        new_frontier.append(idx)
+                frontier[rid] = new_frontier
+                req.profile.ssm_decoding_steps += 1
+            if all(not f for f in frontier.values()):
+                break
+        return trees
+
+    def _verify_and_commit(
+        self, reqs: List[Request], trees: Dict[int, TokenTree]
+    ):
+        """LLM tree-verify step + greedy acceptance + KV commit on both
+        caches (reference prepare_next_batch_verify + tree attention +
+        commit_tokens)."""
+        C = self.spec.max_tree_tokens
+        node_lists = {
+            r.request_id: list(range(len(trees[r.request_id]))) for r in reqs
+        }
+        bc = self._tree_chunk_batch(self.engine, reqs, trees, node_lists, C)
+        logits = self.engine.run(bc, all_logits=True)  # (R, C, V)
+        greedy = np.asarray(jax.device_get(_greedy(logits)))  # (R, C)
+
+        R = self.engine.num_slots
+        K = self.spec.beam_depth + 1
+        scratch = self.engine.scratch_pos
+        src = np.full((R, K), scratch, np.int32)
+        dst = np.full((R, K), scratch, np.int32)
+        for req in reqs:
+            tree = trees[req.request_id]
+            path, bonus = tree.accept_greedy(greedy[req.slot])
+            prefix = req.n_cached
+            for k, node in enumerate(path):
+                src[req.slot, k] = prefix + node
+                dst[req.slot, k] = prefix + k
+            req.profile.speculated_tokens += len(tree) - 1
+            req.profile.accepted_tokens += len(path) - 1
+            req.profile.llm_decoding_steps += 1
+            # Tokens: path nodes beyond the root are newly committed
+            # outputs; the bonus token is the LLM's own next sample.
+            new_tokens = [tree.tokens[n] for n in path[1:]] + [bonus]
+            req.n_cached += len(path)
+            for t in new_tokens:
+                if req.status is RequestStatus.DECODING:
+                    self._append_token(req, t)
+        self.engine.commit(src, dst)
+        self.ssm.commit(src, dst)
+
+    # ------------------------------------------------------------------
+    # scheduling
+
+    def register_request(self, prompt, gen: Optional[GenerationConfig] = None):
+        gen = gen or GenerationConfig()
+        if gen.do_sample:
+            # Greedy tree verification cannot honor sampling configs —
+            # fail loudly rather than emit a hybrid output (the reference
+            # spec path is greedy too; its tests diff spec vs incr greedy).
+            raise ValueError(
+                "SpecInferManager is greedy-only; use RequestManager for "
+                "sampling requests"
+            )
+        return super().register_request(prompt, gen)
+
+    def step(self) -> bool:
+        """One SpecInfer scheduling step (reference generate_spec_infer
+        loop body). While anyone is prefilling, the mixed batch (prefill
+        chunks + decode tokens) goes through BOTH engines so decoding
+        slots keep making one-token progress with the caches in sync —
+        no head-of-line blocking; otherwise one full speculate→verify→
+        commit round runs for all decoding requests."""
+        self._admit_pending()
+        prefilling = self._active(RequestStatus.PREFILLING)
+        if prefilling:
+            bc = self._prepare_batch()
+            decoding = self._active(RequestStatus.DECODING)
+            logits = self.engine.run(bc)
+            self.ssm.run(bc)  # same tokens into the SSM cache
+            sampled = self._sample(logits)
+            for req in decoding:
+                req.n_cached += 1
+                req.profile.llm_decoding_steps += 1
+                self._append_token(req, sampled[req.slot])
+            for req in prefilling:
+                n = int(bc.logits_idx[req.slot]) + 1
+                req.n_cached += n
+                if req.n_cached >= len(req.tokens):
+                    req.status = RequestStatus.DECODING
+                    req.profile.llm_decoding_steps += 1
+                    self._append_token(req, sampled[req.slot])
+            self._step_counter += 1
+            return True
+        decoding = self._active(RequestStatus.DECODING)
+        if decoding:
+            trees = self._grow_trees(decoding)
+            self._verify_and_commit(decoding, trees)
+            self._step_counter += 1
+            return True
+        return bool(self.pending)

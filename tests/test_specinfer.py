@@ -1,0 +1,137 @@
+"""SpecInfer tests — the reference's key correctness property is that
+speculative inference produces token-identical output to incremental
+greedy decoding (reference tests/inference/python_inference_tests.sh:
+111-123 diffs the two), while taking fewer LLM steps.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import (
+    InferenceEngine,
+    RequestManager,
+    ServingConfig,
+    SpecConfig,
+    SpecInferManager,
+    TokenTree,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def tiny_ssm():
+    # A *different* tiny model as the draft: partial acceptance path.
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32, num_hidden_layers=1)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+def ref_greedy(cfg, params, prompt, n_new):
+    toks = list(prompt)
+    for _ in range(n_new):
+        logits = llama.forward(params, jnp.asarray([toks], dtype=jnp.int32), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def make_engine(model_params):
+    cfg, params = model_params
+    sc = ServingConfig(
+        max_requests_per_batch=4,
+        max_sequence_length=96,
+        prefill_chunk=8,
+        max_spec_tree_tokens=16,
+        cache_dtype=jnp.float32,
+    )
+    return InferenceEngine(llama, cfg, params, sc)
+
+
+class TestTokenTree:
+    def test_dedup_and_ancestors(self):
+        t = TokenTree(5)
+        a = t.add(1, 0, -0.1)
+        b = t.add(2, 0, -0.5)
+        assert t.add(1, 0, -0.2) is None  # duplicate (parent, token)
+        c = t.add(3, a, -0.3)
+        anc = t.ancestor_matrix()
+        assert anc[c, a] and anc[c, 0] and anc[c, c]
+        assert not anc[c, b] and not anc[a, b]
+        assert t.depths == [0, 1, 1, 2]
+
+    def test_accept_walk(self):
+        t = TokenTree(5)
+        a = t.add(1, 0, 0)
+        t.add(2, 0, 0)
+        c = t.add(3, a, 0)
+        # greedy_next per node: root->1 (match a), a->3 (match c), c->9 (bonus)
+        greedy = np.zeros(len(t), np.int32)
+        greedy[0], greedy[a], greedy[c] = 1, 3, 9
+        path, bonus = t.accept_greedy(greedy)
+        assert path == [0, a, c] and bonus == 9
+
+    def test_accept_stops_on_mismatch(self):
+        t = TokenTree(5)
+        t.add(1, 0, 0)
+        greedy = np.full(len(t), 42, np.int32)
+        path, bonus = t.accept_greedy(greedy)
+        assert path == [0] and bonus == 42
+
+
+class TestSpecInfer:
+    def test_self_speculation_matches_greedy(self, tiny):
+        """SSM == LLM: every speculated token is accepted; output must be
+        identical to incremental greedy and use far fewer LLM steps."""
+        cfg, params = tiny
+        llm_eng = make_engine(tiny)
+        ssm_eng = make_engine(tiny)
+        mgr = SpecInferManager(
+            llm_eng, ssm_eng, SpecConfig(beam_width=2, beam_depth=3)
+        )
+        prompt = [3, 17, 91, 42, 7]
+        out = mgr.generate([prompt], max_new_tokens=12)[0]
+        assert out.output_tokens == ref_greedy(cfg, params, prompt, 12)
+        # Perfect draft => every round commits depth+1 tokens.
+        assert out.profile.llm_decoding_steps < 12
+        assert out.profile.accepted_tokens > 0
+
+    def test_weak_draft_still_matches_greedy(self, tiny, tiny_ssm):
+        """A different draft model changes only the speed, never the
+        output (the defining spec-decoding invariant)."""
+        cfg, params = tiny
+        for prompt in ([5, 9, 2], [77] * 11):
+            mgr2 = SpecInferManager(
+                make_engine(tiny), make_engine(tiny_ssm),
+                SpecConfig(beam_width=2, beam_depth=4),
+            )
+            out = mgr2.generate([prompt], max_new_tokens=10)[0]
+            assert out.output_tokens == ref_greedy(cfg, params, prompt, 10), prompt
+
+    def test_batch_spec_infer(self, tiny, tiny_ssm):
+        cfg, params = tiny
+        mgr = SpecInferManager(
+            make_engine(tiny), make_engine(tiny_ssm),
+            SpecConfig(beam_width=2, beam_depth=3),
+        )
+        prompts = [[1, 2, 3, 4], [9, 8, 7], [42] * 10]
+        outs = mgr.generate(prompts, max_new_tokens=8)
+        for p, o in zip(prompts, outs):
+            assert o.output_tokens == ref_greedy(cfg, params, p, 8), p
+
+    def test_spec_matches_incremental_manager(self, tiny, tiny_ssm):
+        """End-to-end: SpecInferManager output == RequestManager output."""
+        prompt = [11, 22, 33]
+        rm = RequestManager(make_engine(tiny))
+        incr = rm.generate([prompt], max_new_tokens=9)[0]
+        mgr = SpecInferManager(
+            make_engine(tiny), make_engine(tiny_ssm), SpecConfig(2, 3)
+        )
+        spec = mgr.generate([prompt], max_new_tokens=9)[0]
+        assert spec.output_tokens == incr.output_tokens
