@@ -1,3 +1,17 @@
-from . import llama
+from . import llama, transformer, opt, falcon, mpt, starcoder, hf_utils
 
-__all__ = ["llama"]
+# Model-family registry (reference python/flexflow/serve/models/__init__.py
+# maps HF architectures to FlexFlow builders).
+FAMILIES = {
+    "llama": llama,
+    "opt": opt,
+    "falcon": falcon,
+    "mpt": mpt,
+    "starcoder": starcoder,
+    "gpt_bigcode": starcoder,
+}
+
+__all__ = [
+    "llama", "transformer", "opt", "falcon", "mpt", "starcoder",
+    "hf_utils", "FAMILIES",
+]

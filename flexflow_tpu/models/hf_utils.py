@@ -1,0 +1,95 @@
+"""HuggingFace weight conversion utilities.
+
+The reference converts HF checkpoints into per-layer binary files and
+loads them partition-aware at startup (reference ``python/flexflow/serve/
+serve.py:167-227`` download/convert, ``inference/file_loader.cc:651-819``
+shard-aware load). The TPU-native pipeline is simpler: read the HF
+state dict (safetensors / torch .bin from a *local* directory — this
+environment has no network egress), map names into the framework's
+stacked-layer pytree, and `jax.device_put` with the model's
+NamedShardings — XLA lays out the shards, no manual head slicing.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def to_np(x) -> np.ndarray:
+    """torch.Tensor | np.ndarray → np.ndarray (f32 for float types)."""
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().to("cpu")
+        try:
+            import torch
+
+            if x.dtype in (torch.bfloat16, torch.float16):
+                x = x.float()
+        except ImportError:
+            pass
+        x = x.numpy()
+    return np.asarray(x)
+
+
+def linear_w(sd: Dict[str, Any], name: str) -> np.ndarray:
+    """HF Linear stores (out, in); the framework right-multiplies, so
+    transpose to (in, out)."""
+    return to_np(sd[name]).T
+
+
+def stack(arrs: List[np.ndarray], dtype) -> jnp.ndarray:
+    return jnp.asarray(np.stack(arrs, axis=0), dtype=dtype)
+
+
+def load_state_dict(model_dir: str) -> Dict[str, np.ndarray]:
+    """Load all weights from a local HF checkpoint directory
+    (*.safetensors preferred, falling back to pytorch_model*.bin)."""
+    sd: Dict[str, np.ndarray] = {}
+    st_files = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors import safe_open
+
+        for f in st_files:
+            with safe_open(os.path.join(model_dir, f), framework="np") as h:
+                for k in h.keys():
+                    sd[k] = h.get_tensor(k)
+        return sd
+    bin_files = sorted(
+        f
+        for f in os.listdir(model_dir)
+        if f.startswith("pytorch_model") and f.endswith(".bin")
+    )
+    if not bin_files:
+        raise FileNotFoundError(f"no safetensors/bin weights in {model_dir}")
+    import torch
+
+    for f in bin_files:
+        part = torch.load(
+            os.path.join(model_dir, f), map_location="cpu", weights_only=True
+        )
+        sd.update(part)
+    return sd
+
+
+def load_hf_config(model_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        return json.load(f)
+
+
+def device_put_sharded(params, mesh, pspecs):
+    """Place a host pytree onto the mesh with the model's shardings —
+    the analog of the reference's partition-aware weight copy."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shardings = jax.tree.map(
+        lambda p: NamedSharding(mesh, p),
+        pspecs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+    return jax.tree.map(jax.device_put, params, shardings)

@@ -426,7 +426,7 @@ def init_kv_cache(
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
-def kv_cache_pspecs() -> Dict[str, P]:
+def kv_cache_pspecs(cfg: Optional[LLaMAConfig] = None) -> Dict[str, P]:
     """Cache shards over TP on the KV-head dim (same axis the attention
     heads shard on) and over DP on the slot dim."""
     return {
@@ -567,3 +567,47 @@ def num_params(cfg: LLaMAConfig) -> int:
 def flops_per_token(cfg: LLaMAConfig, seq_len: int) -> int:
     """Forward FLOPs/token ≈ 2*n_params + attention quadratic term."""
     return 2 * num_params(cfg) + 4 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+
+
+def convert_hf_state_dict(sd: Dict[str, Any], cfg: LLaMAConfig) -> Dict[str, Any]:
+    """HF ``LlamaForCausalLM`` state dict → framework pytree (stacked
+    layer dim). The analog of the reference's per-layer weight-file
+    conversion (reference ``python/flexflow/serve/serve.py:167-227``,
+    ``inference/file_loader.cc:792``)."""
+    from .hf_utils import linear_w, stack, to_np
+
+    dt = cfg.dtype
+    L = cfg.num_hidden_layers
+    pre = "model."
+
+    def mats(fmt):
+        return stack([linear_w(sd, pre + fmt.format(i)) for i in range(L)], dt)
+
+    def vecs(fmt):
+        return stack([to_np(sd[pre + fmt.format(i)]) for i in range(L)], dt)
+
+    layers = {
+        "attn_norm": vecs("layers.{}.input_layernorm.weight"),
+        "wq": mats("layers.{}.self_attn.q_proj.weight"),
+        "wk": mats("layers.{}.self_attn.k_proj.weight"),
+        "wv": mats("layers.{}.self_attn.v_proj.weight"),
+        "wo": mats("layers.{}.self_attn.o_proj.weight"),
+        "ffn_norm": vecs("layers.{}.post_attention_layernorm.weight"),
+        "w1": mats("layers.{}.mlp.gate_proj.weight"),
+        "w2": mats("layers.{}.mlp.down_proj.weight"),
+        "w3": mats("layers.{}.mlp.up_proj.weight"),
+    }
+    params = {
+        "embed": jnp.asarray(to_np(sd[pre + "embed_tokens.weight"]), dt),
+        "layers": layers,
+        "final_norm": jnp.asarray(to_np(sd[pre + "norm.weight"]), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(linear_w(sd, "lm_head.weight"), dt)
+    return params
+
+
+def from_hf(hf: Dict[str, Any], **kw) -> LLaMAConfig:
+    """Module-level alias so the family registry has a uniform
+    ``from_hf`` entry point across model modules."""
+    return LLaMAConfig.from_hf(hf, **kw)

@@ -1,0 +1,561 @@
+"""Generic decoder-only transformer — one engine for the whole model zoo.
+
+The reference builds each serving architecture as a separate C++ graph
+builder (reference ``inference/models/{opt,falcon,mpt,starcoder}.cc`` and
+Python twins ``python/flexflow/serve/models/*.py``), each wiring the same
+operator set with per-family choices (norm type, positional scheme,
+MQA/GQA widths, FFN activation, parallel vs sequential block). The
+TPU-native design factors that variation into one configurable decoder:
+a single `lax.scan`-over-stacked-layers program whose config selects
+
+  * normalisation: LayerNorm (± bias) or RMSNorm,
+  * positions: RoPE, learned absolute embeddings, or ALiBi bias,
+  * attention widths: MHA / GQA / MQA via ``num_key_value_heads``,
+  * FFN: relu/gelu/gelu_tanh/silu, optionally gated (GLU),
+  * block topology: sequential (x + attn; x + ffn) or parallel
+    (x + attn + ffn, Falcon-style, with one or two input norms),
+  * biases and tied embeddings.
+
+Each family module (opt.py, falcon.py, mpt.py, starcoder.py) is then just
+a config mapping + HF weight converter. LLaMA keeps its tuned standalone
+implementation (models/llama.py) as the flagship.
+
+Sharding follows the same Megatron scheme as llama.py: QKV/up
+column-parallel and O/down row-parallel on the ``model`` mesh axis, layer
+stack sharded on ``pipe``, KV cache slots on ``data``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_key_value_heads: int = 12        # 1 = MQA (Falcon-7B, Starcoder)
+    max_position_embeddings: int = 2048
+    norm_type: str = "layernorm"         # "layernorm" | "rmsnorm"
+    norm_bias: bool = True
+    norm_eps: float = 1e-5
+    positions: str = "rope"              # "rope" | "learned" | "alibi"
+    learned_pos_offset: int = 0          # OPT stores positions at idx+2
+    rope_theta: float = 10000.0
+    activation: str = "gelu"             # "relu"|"gelu"|"gelu_tanh"|"silu"
+    glu: bool = False                    # gated FFN (SwiGLU-style)
+    parallel_block: bool = False         # Falcon: x + attn(h) + mlp(h)
+    parallel_two_norms: bool = False     # Falcon-40B: ln_attn + ln_mlp
+    qkv_bias: bool = False
+    out_bias: bool = False
+    mlp_bias: bool = False
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def _activation(cfg: DecoderConfig, x):
+    if cfg.activation == "relu":
+        return jax.nn.relu(x)
+    if cfg.activation == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if cfg.activation == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    if cfg.activation == "silu":
+        return jax.nn.silu(x)
+    raise ValueError(cfg.activation)
+
+
+def _norm(cfg: DecoderConfig, x, scale, bias):
+    xf = x.astype(jnp.float32)
+    if cfg.norm_type == "rmsnorm":
+        r = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + cfg.norm_eps)
+        return ((xf * r).astype(x.dtype)) * scale
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = ((xf - mu) * lax.rsqrt(var + cfg.norm_eps)).astype(x.dtype) * scale
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _mm(x, w):
+    return jnp.matmul(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Positions
+
+
+def rope_freqs(cfg: DecoderConfig, positions: jnp.ndarray):
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    angles = jnp.concatenate([angles, angles], axis=-1)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x * cos[..., None, :] + rotated * sin[..., None, :]).astype(x.dtype)
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Standard ALiBi head slopes (power-of-two geometric sequence, with
+    the interpolation rule for non-power-of-two head counts)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        s = pow2_slopes(num_heads)
+    else:
+        n = 2 ** math.floor(math.log2(num_heads))
+        s = pow2_slopes(n)
+        extra = pow2_slopes(2 * n)[0::2][: num_heads - n]
+        s = s + extra
+    return jnp.asarray(s, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+def init_params(key, cfg: DecoderConfig) -> Dict[str, Any]:
+    L, D, F = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+    H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    dt = cfg.dtype
+    ks = jax.random.split(key, 10)
+    std = 0.02
+
+    def w(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    ones = lambda shape: jnp.ones(shape, dt)
+    zeros = lambda shape: jnp.zeros(shape, dt)
+
+    layers: Dict[str, Any] = {
+        "attn_norm_scale": ones((L, D)),
+        "wq": w(ks[0], (L, D, H * dk)),
+        "wk": w(ks[1], (L, D, KV * dk)),
+        "wv": w(ks[2], (L, D, KV * dk)),
+        "wo": w(ks[3], (L, H * dk, D), std / math.sqrt(2 * L)),
+        "w_up": w(ks[4], (L, D, F)),
+        "w_down": w(ks[5], (L, F, D), std / math.sqrt(2 * L)),
+    }
+    if cfg.norm_bias:
+        layers["attn_norm_bias"] = zeros((L, D))
+    # Sequential blocks and Falcon-40B-style parallel blocks have a second
+    # norm; Falcon-7B-style parallel blocks share one input norm.
+    if (not cfg.parallel_block) or cfg.parallel_two_norms:
+        layers["mlp_norm_scale"] = ones((L, D))
+        if cfg.norm_bias:
+            layers["mlp_norm_bias"] = zeros((L, D))
+    if cfg.glu:
+        layers["w_gate"] = w(ks[6], (L, D, F))
+    if cfg.qkv_bias:
+        layers["bq"] = zeros((L, H * dk))
+        layers["bk"] = zeros((L, KV * dk))
+        layers["bv"] = zeros((L, KV * dk))
+    if cfg.out_bias:
+        layers["bo"] = zeros((L, D))
+    if cfg.mlp_bias:
+        layers["b_up"] = zeros((L, F))
+        layers["b_down"] = zeros((L, D))
+        if cfg.glu:
+            layers["b_gate"] = zeros((L, F))
+
+    params: Dict[str, Any] = {
+        "embed": w(ks[7], (cfg.vocab_size, D)),
+        "layers": layers,
+        "final_norm_scale": ones((D,)),
+    }
+    if cfg.norm_bias:
+        params["final_norm_bias"] = zeros((D,))
+    if cfg.positions == "learned":
+        params["pos_embed"] = w(
+            ks[8], (cfg.max_position_embeddings + cfg.learned_pos_offset, D)
+        )
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(ks[9], (D, cfg.vocab_size))
+    return params
+
+
+def param_pspecs(cfg: DecoderConfig, *, pipeline: bool = False) -> Dict[str, Any]:
+    """Megatron TP shardings on ``model``; stacked layer dim on ``pipe``
+    (the analog of the reference's hardcoded inference-TP rewrite,
+    reference ``src/runtime/model.cc:3239-3312``)."""
+    pp = PIPE_AXIS if pipeline else None
+    col = lambda: P(pp, None, MODEL_AXIS)     # D×(sharded out)
+    row = lambda: P(pp, MODEL_AXIS, None)     # (sharded in)×D
+    vec_col = lambda: P(pp, MODEL_AXIS)       # bias of a col-parallel matmul
+    vec_rep = lambda: P(pp, None)             # replicated per-layer vector
+
+    layers = {
+        "attn_norm_scale": vec_rep(),
+        "wq": col(), "wk": col(), "wv": col(), "wo": row(),
+        "w_up": col(), "w_down": row(),
+    }
+    opt_specs = {
+        "attn_norm_bias": vec_rep(),
+        "mlp_norm_scale": vec_rep(),
+        "mlp_norm_bias": vec_rep(),
+        "w_gate": col(),
+        "bq": vec_col(), "bk": vec_col(), "bv": vec_col(),
+        "bo": vec_rep(),
+        "b_up": vec_col(), "b_gate": vec_col(), "b_down": vec_rep(),
+    }
+    probe = init_shapes(cfg)
+    for name, spec in opt_specs.items():
+        if name in probe["layers"]:
+            layers[name] = spec
+    specs: Dict[str, Any] = {
+        "embed": P(None, None),
+        "layers": layers,
+        "final_norm_scale": P(None),
+    }
+    if "final_norm_bias" in probe:
+        specs["final_norm_bias"] = P(None)
+    if "pos_embed" in probe:
+        specs["pos_embed"] = P(None, None)
+    if "lm_head" in probe:
+        specs["lm_head"] = P(None, MODEL_AXIS)
+    return specs
+
+
+@functools.lru_cache(maxsize=32)
+def _shapes_cache(cfg: DecoderConfig):
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+def init_shapes(cfg: DecoderConfig):
+    return _shapes_cache(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Attention + block (shared by train and serve paths)
+
+
+def _gqa_attend(cfg: DecoderConfig, q, k, v, bias, mask):
+    """q (B,S,H,dk) vs k/v (B,T,KV,dk) grouped without materialising the
+    head repeat. ``bias`` (B,H,S,T) f32 or None; ``mask`` (B,S,T) bool."""
+    B, S, H, dk = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    qg = q.reshape(B, S, KV, G, dk)
+    scores = jnp.einsum(
+        "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(cfg.head_dim)
+    if bias is not None:
+        scores = scores + bias.reshape(B, KV, G, *bias.shape[-2:])
+    if mask is not None:
+        m = mask if mask.ndim == 3 else mask[None]
+        scores = jnp.where(m[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(B, S, H * dk)
+
+
+def _project_qkv(cfg: DecoderConfig, p, h):
+    B, S, _ = h.shape
+    H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    q = _mm(h, p["wq"])
+    k = _mm(h, p["wk"])
+    v = _mm(h, p["wv"])
+    if cfg.qkv_bias:
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    return (
+        q.reshape(B, S, H, dk),
+        k.reshape(B, S, KV, dk),
+        v.reshape(B, S, KV, dk),
+    )
+
+
+def _ffn(cfg: DecoderConfig, p, h):
+    up = _mm(h, p["w_up"])
+    if cfg.mlp_bias:
+        up = up + p["b_up"]
+    if cfg.glu:
+        gate = _mm(h, p["w_gate"])
+        if cfg.mlp_bias:
+            gate = gate + p["b_gate"]
+        act = _activation(cfg, gate) * up
+    else:
+        act = _activation(cfg, up)
+    out = _mm(act, p["w_down"])
+    if cfg.mlp_bias:
+        out = out + p["b_down"]
+    return out
+
+
+def block(
+    cfg: DecoderConfig,
+    p: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,              # (B, S, D)
+    rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
+    bias: Optional[jnp.ndarray],  # additive attention bias (ALiBi)
+    mask: Optional[jnp.ndarray],
+):
+    """One decoder block, full-sequence (training) attention."""
+    h = _norm(cfg, x, p["attn_norm_scale"], p.get("attn_norm_bias"))
+    q, k, v = _project_qkv(cfg, p, h)
+    if rope is not None:
+        cos, sin = rope
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    attn = _gqa_attend(cfg, q, k, v, bias, mask)
+    attn = _mm(attn, p["wo"])
+    if cfg.out_bias:
+        attn = attn + p["bo"]
+
+    if cfg.parallel_block:
+        if cfg.parallel_two_norms:
+            h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
+        else:
+            h2 = h
+        return x + attn + _ffn(cfg, p, h2), None
+    x = x + attn
+    h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
+    return x + _ffn(cfg, p, h2), None
+
+
+def _train_bias(cfg: DecoderConfig, positions):
+    """ALiBi additive bias for full-sequence attention: (B,H,S,S)."""
+    if cfg.positions != "alibi":
+        return None
+    slopes = alibi_slopes(cfg.num_attention_heads)
+    qp = positions.astype(jnp.float32)
+    dist = qp[:, None, :, None] - qp[:, None, None, :]  # (B,1,S,S) q - k
+    return -slopes[None, :, None, None] * dist
+
+
+def _embed_in(cfg: DecoderConfig, params, tokens, positions):
+    x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+    if cfg.positions == "learned":
+        # mode="clip": padding slots carry the scratch-row position, which
+        # exceeds the table; JAX's default out-of-bounds fill is NaN, which
+        # would poison attention through the scratch cache line.
+        x = x + jnp.take(
+            params["pos_embed"],
+            positions.astype(jnp.int32) + cfg.learned_pos_offset,
+            axis=0,
+            mode="clip",
+        )
+    return x
+
+
+def _lm_logits(cfg: DecoderConfig, params, x):
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.matmul(x, head, preferred_element_type=jnp.float32)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: DecoderConfig,
+    *,
+    positions: Optional[jnp.ndarray] = None,
+    remat: bool = False,
+    shard_activations: bool = False,
+) -> jnp.ndarray:
+    """Training/eval forward → logits (B, S, V)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = _embed_in(cfg, params, tokens, positions)
+    rope = rope_freqs(cfg, positions) if cfg.positions == "rope" else None
+    bias = _train_bias(cfg, positions)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+
+    def constrain(t):
+        if shard_activations:
+            return lax.with_sharding_constraint(t, P(DATA_AXIS, SEQ_AXIS, None))
+        return t
+
+    x = constrain(x)
+    blk = functools.partial(block, cfg)
+    if remat:
+        blk = jax.checkpoint(blk)
+
+    def scan_body(carry, p_l):
+        y, _ = blk(p_l, carry, rope, bias, mask)
+        return constrain(y), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
+    return _lm_logits(cfg, params, x)
+
+
+# ---------------------------------------------------------------------------
+# Serving path — the same engine protocol as models/llama.py: request-slot
+# paged KV cache with a scratch row, one compiled program per static
+# (chunk, all_logits, mask-mode) signature (reference's three attention
+# operators inc/spec/tree_inc_multihead_self_attention collapse into one).
+
+
+def needs_pos_cache(cfg: DecoderConfig) -> bool:
+    """ALiBi biases depend on key *sequence* positions at attention time
+    (RoPE bakes position into cached K instead), so the cache carries a
+    per-line position buffer."""
+    return cfg.positions == "alibi"
+
+
+def init_kv_cache(cfg: DecoderConfig, num_slots: int, max_len: int, dtype=None):
+    L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
+    dt = dtype or cfg.dtype
+    shape = (L, num_slots, max_len + 1, KV, dk)
+    cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if needs_pos_cache(cfg):
+        cache["pos"] = jnp.zeros((num_slots, max_len + 1), jnp.int32)
+    return cache
+
+
+def kv_cache_pspecs(cfg: DecoderConfig = None):
+    specs = {
+        "k": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+        "v": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+    }
+    if cfg is not None and needs_pos_cache(cfg):
+        specs["pos"] = P(DATA_AXIS, None)
+    return specs
+
+
+def _serve_attend(cfg: DecoderConfig, q, k_cache, v_cache, bias, mask):
+    """q (R,C,H,dk) against cache (R,S1,KV,dk)."""
+    R, C, H, dk = q.shape
+    KV = k_cache.shape[2]
+    G = H // KV
+    qg = q.reshape(R, C, KV, G, dk)
+    scores = jnp.einsum(
+        "rckgd,rskd->rkgcs", qg, k_cache, preferred_element_type=jnp.float32
+    ) / math.sqrt(cfg.head_dim)
+    if bias is not None:  # (R,H,C,S1)
+        scores = scores + bias.reshape(R, KV, G, *bias.shape[-2:])
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("rkgcs,rskd->rckgd", probs, v_cache)
+    return out.reshape(R, C, H * dk)
+
+
+def serve_block(cfg, p, x, rope, bias, mask, k_cache, v_cache, cache_positions):
+    R, C, D = x.shape
+    h = _norm(cfg, x, p["attn_norm_scale"], p.get("attn_norm_bias"))
+    q, k, v = _project_qkv(cfg, p, h)
+    if rope is not None:
+        cos, sin = rope
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    bidx = jnp.arange(R)[:, None]
+    k_cache = k_cache.at[bidx, cache_positions].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[bidx, cache_positions].set(v.astype(v_cache.dtype))
+    attn = _serve_attend(cfg, q, k_cache, v_cache, bias, mask)
+    attn = _mm(attn, p["wo"])
+    if cfg.out_bias:
+        attn = attn + p["bo"]
+    if cfg.parallel_block:
+        if cfg.parallel_two_norms:
+            h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
+        else:
+            h2 = h
+        return x + attn + _ffn(cfg, p, h2), k_cache, v_cache
+    x = x + attn
+    h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
+    return x + _ffn(cfg, p, h2), k_cache, v_cache
+
+
+def serve_step(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,      # (R, C)
+    positions: jnp.ndarray,   # (R, C) sequence positions
+    logits_idx: jnp.ndarray,  # (R,)
+    mask: Optional[jnp.ndarray],   # (R, C, S1) bool or None => causal
+    cache_positions: Optional[jnp.ndarray] = None,
+    *,
+    cfg: DecoderConfig,
+    all_logits: bool = False,
+):
+    """One serving step over R request slots × C tokens; same contract as
+    ``models.llama.serve_step`` (see engine protocol in serve/engine.py)."""
+    R, C = tokens.shape
+    S1 = cache["k"].shape[2]
+    if cache_positions is None:
+        cache_positions = positions
+    x = _embed_in(cfg, params, tokens, positions)
+    rope = rope_freqs(cfg, positions) if cfg.positions == "rope" else None
+    if mask is None:
+        key_pos = jnp.arange(S1, dtype=jnp.int32)
+        mask = key_pos[None, None, :] <= positions[:, :, None]
+        mask = mask & (key_pos[None, None, :] < S1 - 1)
+
+    bias = None
+    if needs_pos_cache(cfg):
+        bidx = jnp.arange(R)[:, None]
+        pos_cache = cache["pos"].at[bidx, cache_positions].set(
+            positions.astype(jnp.int32)
+        )
+        slopes = alibi_slopes(cfg.num_attention_heads)
+        dist = (
+            positions.astype(jnp.float32)[:, None, :, None]
+            - pos_cache.astype(jnp.float32)[:, None, None, :]
+        )  # (R,1,C,S1)
+        bias = -slopes[None, :, None, None] * dist
+
+    def scan_body(h, xs):
+        p_l, kc, vc = xs
+        h, kc, vc = serve_block(
+            cfg, p_l, h, rope, bias, mask, kc, vc, cache_positions
+        )
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
+    if not all_logits:
+        x = jnp.take_along_axis(x, logits_idx[:, None, None], axis=1)
+        logits = _lm_logits(cfg, params, x)[:, 0]
+    else:
+        logits = _lm_logits(cfg, params, x)
+    new_cache = {"k": k_new, "v": v_new}
+    if needs_pos_cache(cfg):
+        new_cache["pos"] = pos_cache
+    return logits, new_cache
+
+
+def commit_kv(cache, src, dst):
+    """Move accepted speculative cache lines into committed positions (see
+    ``models.llama.commit_kv``; reference ``request_manager.cu`` token
+    commit). Handles the extra (R, S1) position buffer for ALiBi caches."""
+    R = src.shape[0]
+    bidx = jnp.arange(R)[:, None]
+    out = {}
+    for name, buf in cache.items():
+        if name == "pos":  # (R, S1)
+            out[name] = buf.at[bidx, dst].set(buf[bidx, src])
+        else:  # (L, R, S1, KV, dk)
+            out[name] = buf.at[:, bidx, dst].set(buf[:, bidx, src])
+    return out
+
+
+def num_params(cfg: DecoderConfig) -> int:
+    shapes = init_shapes(cfg)
+    return sum(
+        int(math.prod(s.shape)) for s in jax.tree.leaves(shapes)
+    )

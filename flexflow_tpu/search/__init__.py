@@ -1,0 +1,43 @@
+"""Unity-style auto-parallelization search, re-designed for TPU.
+
+The reference's Unity subsystem (reference ``src/runtime/graph.cc``,
+``substitution.cc``, ``simulator.cc``, ``machine_model.cc``; SURVEY.md
+§2.1/L5) jointly searches algebraic graph substitutions and per-operator
+MachineView placements, guided by an execution simulator. The TPU-native
+re-design keeps the same three pillars but changes their meaning:
+
+  * **machine model** → analytic TPU chip + ICI/DCN topology roofline
+    (:mod:`.machine_model`) instead of measured CUDA kernels + NIC/PCIe
+    graphs; optional on-device measured timings refine it.
+  * **placement** → a *sharding strategy* (mesh axis degrees + per-op
+    sharding choices, :mod:`.strategy`) instead of per-task device lists:
+    GSPMD generates the collectives the reference inserted as parallel
+    ops (Repartition/Combine/Replicate/Reduction/AllReduce).
+  * **search** → substitution rewrites over the PCG IR
+    (:mod:`.substitutions`) + a DP over per-op sharding states with
+    resharding edge costs (:mod:`.placement`), orchestrated by
+    :func:`~.unity.optimize` with an MCMC fallback — mirroring
+    ``GraphSearchHelper::graph_optimize`` + ``FFModel::mcmc_optimize``.
+"""
+from .machine_model import TPUChip, TPUTopology, CollectiveModel
+from .strategy import OpShardingChoice, ParallelStrategy
+from .simulator import CostModel, estimate_graph_cost
+from .substitutions import SUBSTITUTIONS, apply_substitutions, Substitution
+from .placement import placement_dp
+from .unity import optimize, mcmc_optimize
+
+__all__ = [
+    "TPUChip",
+    "TPUTopology",
+    "CollectiveModel",
+    "OpShardingChoice",
+    "ParallelStrategy",
+    "CostModel",
+    "estimate_graph_cost",
+    "SUBSTITUTIONS",
+    "Substitution",
+    "apply_substitutions",
+    "placement_dp",
+    "optimize",
+    "mcmc_optimize",
+]

@@ -1,0 +1,134 @@
+"""Analytic TPU machine model for the auto-parallel search.
+
+The reference's simulator is parameterised by a machine model hierarchy —
+``SimpleMachineModel`` (intra/inter-node bandwidths) up to
+``NetworkedMachineModel`` with explicit topology + routing (reference
+``src/runtime/machine_model.cc:1-1287``, ``network.cc:47``,
+``machine_config_example``). A TPU pod is far more regular: identical
+chips on a 2-D/3-D ICI torus, slices joined over DCN. So the TPU model
+is a chip roofline (MXU peak, HBM bandwidth) + per-hop ICI link
+bandwidth + DCN bandwidth, and collective costs follow the standard
+ring/band formulas instead of weighted-shortest-path routing.
+
+All times in seconds, sizes in bytes, rates in bytes/s or FLOP/s.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+from ..core.mesh import AXIS_ORDER
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUChip:
+    """Single-chip roofline parameters."""
+
+    name: str
+    bf16_flops: float          # peak MXU FLOP/s at bf16
+    hbm_bandwidth: float       # bytes/s
+    hbm_capacity: float        # bytes
+    ici_bandwidth: float       # bytes/s per ICI link direction
+    mxu_efficiency: float = 0.55   # achievable fraction of peak on big GEMMs
+    hbm_efficiency: float = 0.80
+
+    # -- presets ------------------------------------------------------
+
+    @classmethod
+    def v5e(cls):
+        return cls(
+            name="v5e",
+            bf16_flops=197e12,
+            hbm_bandwidth=819e9,
+            hbm_capacity=16e9,
+            ici_bandwidth=45e9,
+        )
+
+    @classmethod
+    def v5p(cls):
+        return cls(
+            name="v5p",
+            bf16_flops=459e12,
+            hbm_bandwidth=2765e9,
+            hbm_capacity=95e9,
+            ici_bandwidth=90e9,
+        )
+
+    @classmethod
+    def v4(cls):
+        return cls(
+            name="v4",
+            bf16_flops=275e12,
+            hbm_bandwidth=1228e9,
+            hbm_capacity=32e9,
+            ici_bandwidth=45e9,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUTopology:
+    """A slice (ICI-connected mesh of chips) optionally multiplied over
+    DCN (multi-slice). Mesh axes map onto ICI first (innermost axes) —
+    matching ``core.mesh.AXIS_ORDER``'s convention that ``model`` rides
+    the fastest links — and any axis marked in ``dcn_axes`` pays DCN
+    bandwidth instead."""
+
+    chip: TPUChip
+    num_chips: int = 1
+    dcn_bandwidth: float = 25e9     # bytes/s per host pair
+    dcn_axes: tuple = ()            # mesh axes that cross slice boundaries
+    per_hop_latency: float = 1e-6   # ICI hop latency (s)
+    dcn_latency: float = 10e-6
+
+    def axis_bandwidth(self, axis: str) -> float:
+        return self.dcn_bandwidth if axis in self.dcn_axes else self.chip.ici_bandwidth
+
+    def axis_latency(self, axis: str) -> float:
+        return self.dcn_latency if axis in self.dcn_axes else self.per_hop_latency
+
+
+class CollectiveModel:
+    """Ring-algorithm collective cost estimates over one mesh axis.
+
+    The reference prices its parallel ops (AllReduce/Combine/Replicate/
+    Repartition/Reduction, SURVEY.md §2.1) through per-pair transfer
+    routing; on TPU the GSPMD-inserted collectives follow closed-form
+    ring costs over the axis's ICI links.
+    """
+
+    def __init__(self, topo: TPUTopology):
+        self.topo = topo
+
+    def _ring(self, bytes_total: float, degree: int, axis: str, factor: float) -> float:
+        if degree <= 1 or bytes_total <= 0:
+            return 0.0
+        bw = self.topo.axis_bandwidth(axis)
+        lat = self.topo.axis_latency(axis) * (degree - 1)
+        return factor * (degree - 1) / degree * bytes_total / bw + lat
+
+    def all_reduce(self, bytes_total: float, degree: int, axis: str) -> float:
+        # reduce-scatter + all-gather
+        return self._ring(bytes_total, degree, axis, 2.0)
+
+    def all_gather(self, bytes_total: float, degree: int, axis: str) -> float:
+        return self._ring(bytes_total, degree, axis, 1.0)
+
+    def reduce_scatter(self, bytes_total: float, degree: int, axis: str) -> float:
+        return self._ring(bytes_total, degree, axis, 1.0)
+
+    def all_to_all(self, bytes_total: float, degree: int, axis: str) -> float:
+        # each chip keeps 1/degree locally; bisection-limited on a ring
+        return self._ring(bytes_total, degree, axis, 0.5)
+
+    def ppermute(self, bytes_per_chip: float, axis: str) -> float:
+        if bytes_per_chip <= 0:
+            return 0.0
+        return bytes_per_chip / self.topo.axis_bandwidth(axis) + self.topo.axis_latency(axis)
+
+
+def compute_time(chip: TPUChip, flops: float, bytes_moved: float) -> float:
+    """Roofline: compute-bound on the MXU or bandwidth-bound on HBM."""
+    t_flops = flops / (chip.bf16_flops * chip.mxu_efficiency)
+    t_mem = bytes_moved / (chip.hbm_bandwidth * chip.hbm_efficiency)
+    return max(t_flops, t_mem)

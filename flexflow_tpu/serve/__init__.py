@@ -8,6 +8,7 @@ three attention operators become one compiled program per static mode.
 """
 from .batch_config import BatchConfig, GenerationConfig, GenerationResult
 from .engine import InferenceEngine, ServingConfig
+from .llm import LLM, SSM, detect_family
 from .request_manager import Request, RequestManager
 from .sampling import sample_tokens
 from .specinfer import SpecConfig, SpecInferManager, TokenTree
@@ -17,6 +18,9 @@ __all__ = [
     "GenerationConfig",
     "GenerationResult",
     "InferenceEngine",
+    "LLM",
+    "SSM",
+    "detect_family",
     "ServingConfig",
     "Request",
     "RequestManager",

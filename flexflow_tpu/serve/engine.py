@@ -83,7 +83,7 @@ class InferenceEngine:
         )
         with jax.set_mesh(self.mesh):
             if any(n > 1 for n in self.mesh.shape.values()):
-                pspecs = self.model.kv_cache_pspecs()
+                pspecs = self.model.kv_cache_pspecs(self.cfg)
                 shardings = jax.tree.map(
                     lambda p: NamedSharding(self.mesh, p),
                     pspecs,

@@ -1,0 +1,163 @@
+"""High-level ``LLM``/``SSM`` serving API.
+
+TPU-native counterpart of the reference's Python serving entry points
+(reference ``python/flexflow/serve/serve.py:71-502``: ``LLM``/``SSM``
+classes that download + convert HF weights, compile per inference mode,
+and generate). Differences by design: weights load from a *local* HF
+checkpoint directory straight into sharded device arrays (no binary
+file cache), and "compile" builds jitted step functions over the mesh
+instead of a Legion task graph.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import MachineSpec
+from .. import models as zoo
+from ..models import hf_utils
+from .batch_config import GenerationConfig, GenerationResult
+from .engine import InferenceEngine, ServingConfig
+from .request_manager import RequestManager
+from .specinfer import SpecConfig, SpecInferManager
+
+
+def detect_family(hf_config: Dict[str, Any]):
+    """Map an HF config to a model-family module (reference
+    ``serve.py:__get_ff_model_type`` dispatch on architectures)."""
+    mt = hf_config.get("model_type", "")
+    if mt in zoo.FAMILIES:
+        return zoo.FAMILIES[mt]
+    for arch in hf_config.get("architectures", []):
+        for key, mod in zoo.FAMILIES.items():
+            if key.replace("_", "") in arch.lower().replace("_", ""):
+                return mod
+    raise ValueError(f"unsupported model family: {mt!r} / "
+                     f"{hf_config.get('architectures')}")
+
+
+class LLM:
+    """A servable causal LM bound to a mesh.
+
+    Build either from a local HF checkpoint directory
+    (``LLM.from_pretrained``) or from in-memory (family, cfg, params)
+    — the latter is what tests and SSM distillation use.
+    """
+
+    def __init__(
+        self,
+        family: Any,
+        cfg: Any,
+        params: Optional[Dict[str, Any]] = None,
+        *,
+        tokenizer: Any = None,
+        machine: Optional[MachineSpec] = None,
+        mesh=None,
+        seed: int = 0,
+    ):
+        self.family = family
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        if mesh is None:
+            machine = machine or MachineSpec()
+            mesh = machine.make_mesh(jax.devices()[: machine.num_devices])
+        self.mesh = mesh
+        if params is None:
+            params = family.init_params(jax.random.PRNGKey(seed), cfg)
+        self.params = params
+        self.engine: Optional[InferenceEngine] = None
+        self.rm: Optional[RequestManager] = None
+
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        model_dir: str,
+        *,
+        dtype: Any = jnp.bfloat16,
+        tokenizer: Any = "auto",
+        machine: Optional[MachineSpec] = None,
+        mesh=None,
+        **cfg_overrides,
+    ) -> "LLM":
+        """Load config + weights from a local HF checkpoint directory
+        (this environment has no network egress; the reference's HF-hub
+        download step happens out of band)."""
+        hf_cfg = hf_utils.load_hf_config(model_dir)
+        family = detect_family(hf_cfg)
+        cfg = family.from_hf(hf_cfg, dtype=dtype, **cfg_overrides)
+        sd = hf_utils.load_state_dict(model_dir)
+        params = family.convert_hf_state_dict(sd, cfg)
+        if tokenizer == "auto":
+            try:
+                from transformers import AutoTokenizer
+
+                tokenizer = AutoTokenizer.from_pretrained(
+                    model_dir, local_files_only=True
+                )
+            except Exception:
+                tokenizer = None
+        return cls(
+            family, cfg, params, tokenizer=tokenizer, machine=machine, mesh=mesh
+        )
+
+    # ------------------------------------------------------------------
+
+    def compile(
+        self,
+        serving: Optional[ServingConfig] = None,
+        *,
+        ssms: Sequence["LLM"] = (),
+        spec: Optional[SpecConfig] = None,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> None:
+        """Build the inference engine(s) and request manager (reference
+        ``LLM.compile`` → InferenceManager.compile_model_and_allocate_buffer).
+        With ``ssms`` the request manager runs the SpecInfer loop."""
+        serving = serving or ServingConfig()
+        self.params = hf_utils.device_put_sharded(
+            self.params, self.mesh, self.family.param_pspecs(self.cfg)
+        )
+        self.engine = InferenceEngine(
+            self.family, self.cfg, self.params, serving, self.mesh
+        )
+        if ssms:
+            assert len(ssms) == 1, "one SSM supported per LLM (multi-SSM trees TBD)"
+            ssm = ssms[0]
+            ssm.params = hf_utils.device_put_sharded(
+                ssm.params, self.mesh, ssm.family.param_pspecs(ssm.cfg)
+            )
+            ssm.engine = InferenceEngine(
+                ssm.family, ssm.cfg, ssm.params, serving, self.mesh
+            )
+            self.rm = SpecInferManager(
+                self.engine, ssm.engine, spec,
+                tokenizer=self.tokenizer, eos_token_id=eos_token_id, seed=seed,
+            )
+        else:
+            self.rm = RequestManager(
+                self.engine,
+                tokenizer=self.tokenizer,
+                eos_token_id=eos_token_id,
+                seed=seed,
+            )
+
+    def generate(
+        self,
+        prompts: Union[str, Sequence[Union[str, Sequence[int]]]],
+        gen: Optional[GenerationConfig] = None,
+        max_new_tokens: Optional[int] = None,
+    ) -> List[GenerationResult]:
+        if self.rm is None:
+            self.compile()
+        return self.rm.generate(prompts, gen, max_new_tokens)
+
+
+class SSM(LLM):
+    """Small speculative model (reference ``serve.py`` SSM): same object
+    as LLM, compiled onto the LLM's mesh by ``LLM.compile(ssms=[...])``."""

@@ -222,6 +222,11 @@ class RequestManager:
     # ------------------------------------------------------------------
     # incremental decoding loop (reference generate_incr_decoding, :2292)
 
+    def _run_batch(self, bc: BatchConfig):
+        """Hook: run one prepared batch through the engine(s).
+        SpecInferManager overrides this to keep the SSM cache in sync."""
+        return self.engine.run(bc)
+
     def step(self) -> bool:
         """One scheduling step. Returns False when no work remains."""
         self._admit_pending()
@@ -230,7 +235,7 @@ class RequestManager:
             return bool(self.pending)
         prefilling = self._active(RequestStatus.PREFILLING)
         decoding = self._active(RequestStatus.DECODING)
-        logits = self.engine.run(bc)
+        logits = self._run_batch(bc)
         sampled = self._sample(logits)
         for req in decoding:
             req.n_cached += 1

@@ -142,6 +142,10 @@ class SpecInferManager(RequestManager):
             self.spec.max_tree_tokens
             <= llm_engine.serving.max_spec_tree_tokens
         ), "tree larger than the cache's speculative slack region"
+        assert llm_engine.cfg.vocab_size == ssm_engine.cfg.vocab_size, (
+            "LLM/SSM vocab mismatch: draft tokens would be silently "
+            "clipped at the verifier's embedding"
+        )
 
     # ------------------------------------------------------------------
     # batch builders
@@ -276,34 +280,22 @@ class SpecInferManager(RequestManager):
             )
         return super().register_request(prompt, gen)
 
+    def _run_batch(self, bc):
+        logits = self.engine.run(bc)
+        self.ssm.run(bc)  # same tokens into the SSM cache
+        return logits
+
     def step(self) -> bool:
         """One SpecInfer scheduling step (reference generate_spec_infer
         loop body). While anyone is prefilling, the mixed batch (prefill
-        chunks + decode tokens) goes through BOTH engines so decoding
-        slots keep making one-token progress with the caches in sync —
-        no head-of-line blocking; otherwise one full speculate→verify→
-        commit round runs for all decoding requests."""
+        chunks + decode tokens) goes through BOTH engines (the
+        ``_run_batch`` hook) so decoding slots keep making one-token
+        progress with the caches in sync — no head-of-line blocking;
+        otherwise one full speculate→verify→commit round runs for all
+        decoding requests."""
         self._admit_pending()
-        prefilling = self._active(RequestStatus.PREFILLING)
-        if prefilling:
-            bc = self._prepare_batch()
-            decoding = self._active(RequestStatus.DECODING)
-            logits = self.engine.run(bc)
-            self.ssm.run(bc)  # same tokens into the SSM cache
-            sampled = self._sample(logits)
-            for req in decoding:
-                req.n_cached += 1
-                req.profile.llm_decoding_steps += 1
-                self._append_token(req, sampled[req.slot])
-            for req in prefilling:
-                n = int(bc.logits_idx[req.slot]) + 1
-                req.n_cached += n
-                if req.n_cached >= len(req.tokens):
-                    req.status = RequestStatus.DECODING
-                    req.profile.llm_decoding_steps += 1
-                    self._append_token(req, sampled[req.slot])
-            self._step_counter += 1
-            return True
+        if self._active(RequestStatus.PREFILLING):
+            return super().step()
         decoding = self._active(RequestStatus.DECODING)
         if decoding:
             trees = self._grow_trees(decoding)

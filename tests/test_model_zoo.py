@@ -1,0 +1,163 @@
+"""Model-zoo alignment tests vs HuggingFace transformers.
+
+The reference validates serving correctness by diffing its greedy output
+against HF transformers (reference ``tests/inference/huggingface_inference.py``
++ ``python_inference_tests.sh:111-131``). Here tiny randomly-initialised
+HF models are built *locally* (no download) for every supported family,
+their weights converted through each family's ``convert_hf_state_dict``,
+and logits compared exactly; a second test checks the serving path
+(chunked prefill + decode through the KV cache) reproduces the training
+forward's logits.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import flexflow_tpu.models as zoo
+from flexflow_tpu.models import falcon, llama, mpt, opt, starcoder
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+B, S, V = 2, 17, 256
+
+
+def _hf_llama():
+    cfg = transformers.LlamaConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    return transformers.LlamaForCausalLM(cfg), llama.LLaMAConfig.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), llama
+
+
+def _hf_opt():
+    cfg = transformers.OPTConfig(
+        vocab_size=V, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        word_embed_proj_dim=64, do_layer_norm_before=True,
+    )
+    return transformers.OPTForCausalLM(cfg), opt.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), opt
+
+
+def _hf_falcon():
+    cfg = transformers.FalconConfig(
+        vocab_size=V, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False,
+        max_position_embeddings=128,
+    )
+    return transformers.FalconForCausalLM(cfg), falcon.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), falcon
+
+
+def _hf_mpt():
+    cfg = transformers.MptConfig(
+        d_model=64, n_heads=4, n_layers=2, vocab_size=V, max_seq_len=128,
+        expansion_ratio=4,
+    )
+    return transformers.MptForCausalLM(cfg), mpt.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), mpt
+
+
+def _hf_starcoder():
+    cfg = transformers.GPTBigCodeConfig(
+        vocab_size=V, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+        multi_query=True, activation_function="gelu_pytorch_tanh",
+    )
+    return transformers.GPTBigCodeForCausalLM(cfg), starcoder.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), starcoder
+
+
+BUILDERS = {
+    "llama": _hf_llama,
+    "opt": _hf_opt,
+    "falcon": _hf_falcon,
+    "mpt": _hf_mpt,
+    "starcoder": _hf_starcoder,
+}
+
+
+@pytest.fixture(scope="module", params=sorted(BUILDERS))
+def family(request):
+    torch.manual_seed(0)
+    hf_model, cfg, mod = BUILDERS[request.param]()
+    hf_model = hf_model.eval()
+    params = mod.convert_hf_state_dict(hf_model.state_dict(), cfg)
+    return request.param, hf_model, cfg, mod, params
+
+
+def test_hf_alignment(family):
+    name, hf_model, cfg, mod, params = family
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, V, size=(B, S))
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(tokens)).logits.float().numpy()
+    got = np.asarray(mod.forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_serve_matches_forward(family):
+    """Chunked prefill through the KV cache must reproduce the training
+    forward's logits at every chunk boundary (the reference's
+    incremental-vs-full equivalence property)."""
+    name, hf_model, cfg, mod, params = family
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, V, size=(2, 12)).astype(np.int32)
+    full = np.asarray(mod.forward(params, jnp.asarray(tokens), cfg))
+
+    cache = mod.init_kv_cache(cfg, num_slots=2, max_len=31, dtype=jnp.float32)
+    chunk = 4
+    for c0 in range(0, 12, chunk):
+        tk = jnp.asarray(tokens[:, c0 : c0 + chunk])
+        pos = jnp.asarray(
+            np.broadcast_to(np.arange(c0, c0 + chunk, dtype=np.int32), (2, chunk))
+        )
+        logits, cache = mod.serve_step(
+            params, cache, tk, pos,
+            jnp.full((2,), chunk - 1, jnp.int32), None,
+            cfg=cfg, all_logits=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), full[:, c0 + chunk - 1], atol=3e-4, rtol=3e-4
+        )
+
+
+def test_family_registry():
+    assert set(zoo.FAMILIES) >= {"llama", "opt", "falcon", "mpt", "starcoder"}
+
+
+def test_llm_from_pretrained_e2e(tmp_path):
+    """Save a tiny HF OPT checkpoint locally, then load + generate
+    through the high-level LLM API (reference serve.py flow, minus the
+    hub download)."""
+    from flexflow_tpu.serve import LLM, ServingConfig
+
+    torch.manual_seed(0)
+    hf_model, _, _ = _hf_opt()
+    hf_model.save_pretrained(tmp_path / "opt-tiny")
+
+    llm = LLM.from_pretrained(
+        str(tmp_path / "opt-tiny"), dtype=jnp.float32, tokenizer=None
+    )
+    llm.compile(ServingConfig(max_requests_per_batch=2,
+                              max_sequence_length=64, prefill_chunk=8))
+    prompts = [[5, 6, 7], [9, 10, 11, 12]]
+    out = llm.generate(prompts, max_new_tokens=5)
+    assert len(out) == 2
+    assert all(len(r.output_tokens) == 5 for r in out)
+
+    # greedy serving output must match HF greedy generate
+    with torch.no_grad():
+        hf_out = hf_model.generate(
+            torch.tensor([prompts[0]]), max_new_tokens=5, do_sample=False
+        )[0, 3:].tolist()
+    assert out[0].output_tokens == hf_out
