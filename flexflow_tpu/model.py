@@ -82,6 +82,7 @@ class FFModel:
         self._step_count = 0
         # sharding overrides installed by the parallelize pass
         self._param_pspecs: Optional[Dict[str, Any]] = None
+        self._search_report = None
 
     # ------------------------------------------------------------------
     # graph construction
@@ -515,6 +516,63 @@ class FFModel:
         spec = self.config.machine_spec()
         return spec.make_mesh()
 
+    def _run_unity_search(self, output: Optional[Tensor], comp_mode: str) -> bool:
+        """Unity-style auto-parallelization (reference compile step 2:
+        GRAPH_OPTIMIZE_TASK_ID → graph_optimize_task, model.cc:3337,
+        graph.cc:2108). Rewrites self.graph, sets mesh degrees and the
+        weight-sharding override from the found strategy; honors the
+        import/export strategy files (config.h:171-172). Returns True
+        when the graph was rewritten (node ids re-numbered)."""
+        from . import search as unity
+        from .core.mesh import MachineSpec
+
+        cfgf = self.config
+        rewritten = False
+        if cfgf.import_strategy_file:
+            strategy = unity.ParallelStrategy.load(cfgf.import_strategy_file)
+        else:
+            assert output is None or output.ref.node_id == len(self.graph.nodes) - 1, (
+                "auto_parallel currently requires the output to be the "
+                "final graph node (rewrites re-number nodes)"
+            )
+            # The search owns the ICI axes not explicitly configured:
+            # fixed pipeline/expert/sequence degrees carve the device
+            # count down first (the reference likewise fixes inference
+            # PP outside its search).
+            fixed = (
+                cfgf.pipeline_parallelism_degree
+                * cfgf.expert_parallelism_degree
+                * cfgf.sequence_parallelism_degree
+            )
+            assert cfgf.num_devices % fixed == 0, (
+                f"num_devices={cfgf.num_devices} not divisible by fixed "
+                f"pipe*expert*seq degrees = {fixed}"
+            )
+            budget = cfgf.search_budget if cfgf.search_budget > 0 else 32
+            graph2, strategy, report = unity.optimize(
+                self.graph,
+                cfgf.num_devices // fixed,
+                training=(comp_mode == TRAINING),
+                budget=budget,
+                alpha=cfgf.search_alpha,
+            )
+            rewritten = graph2 is not self.graph
+            self.graph = graph2
+            self._search_report = report
+        strategy.stamp(self.graph)
+        self._param_pspecs = strategy.weight_pspecs(self.graph)
+        cfgf.tensor_parallelism_degree = strategy.machine.model
+        cfgf.data_parallelism_degree = (
+            cfgf.num_devices
+            // cfgf.tensor_parallelism_degree
+            // cfgf.pipeline_parallelism_degree
+            // cfgf.expert_parallelism_degree
+            // cfgf.sequence_parallelism_degree
+        )
+        if cfgf.export_strategy_file:
+            strategy.save(cfgf.export_strategy_file)
+        return rewritten
+
     def _param_shardings(self):
         """PartitionSpec tree matching params, from per-op TP rules (or the
         parallelize pass's overrides)."""
@@ -542,16 +600,23 @@ class FFModel:
         metrics: Sequence[str] = ("accuracy",),
         comp_mode: str = TRAINING,
         output: Optional[Tensor] = None,
+        auto_parallel: bool = False,
     ):
         """Lower the graph to jitted step functions (reference
-        ``FFModel::compile``, model.cc:3314). The Unity search is replaced
-        for now by the config's explicit degrees; the search module can
-        override ``_param_pspecs`` with a found strategy."""
+        ``FFModel::compile``, model.cc:3314). With ``auto_parallel`` the
+        Unity-style search (flexflow_tpu.search) picks mesh degrees +
+        per-op shardings and may rewrite the graph; otherwise the
+        config's explicit degrees apply (plus an import-strategy file,
+        the reference's ``--import-strategy``)."""
         self.optimizer = optimizer or SGDOptimizer(lr=self.config.learning_rate)
         self.loss_type = loss_type
         self.metrics_names = tuple(metrics)
+        if auto_parallel or self.config.import_strategy_file:
+            rewritten = self._run_unity_search(output, comp_mode)
+            if rewritten:
+                output = None  # output ref re-resolved against rewritten graph
         self.mesh = self._make_mesh()
-        if self.config.tensor_parallelism_degree > 1:
+        if self._param_pspecs is None and self.config.tensor_parallelism_degree > 1:
             from .parallel.tp import apply_tensor_parallel
 
             apply_tensor_parallel(self.graph, self.config.tensor_parallelism_degree)

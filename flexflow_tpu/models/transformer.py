@@ -428,9 +428,13 @@ def init_kv_cache(cfg: DecoderConfig, num_slots: int, max_len: int, dtype=None):
 
 
 def kv_cache_pspecs(cfg: DecoderConfig = None):
+    # MQA (KV=1) caches replicate across TP: a size-1 head dim cannot
+    # split over the model axis (the memory cost is the standard MQA
+    # serving trade; queries still shard by head).
+    kv_axis = None if (cfg is not None and cfg.num_key_value_heads == 1) else MODEL_AXIS
     specs = {
-        "k": P(None, DATA_AXIS, None, MODEL_AXIS, None),
-        "v": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+        "k": P(None, DATA_AXIS, None, kv_axis, None),
+        "v": P(None, DATA_AXIS, None, kv_axis, None),
     }
     if cfg is not None and needs_pos_cache(cfg):
         specs["pos"] = P(DATA_AXIS, None)

@@ -55,6 +55,9 @@ class OpContext:
         return jax.random.fold_in(self.rng, node_id)
 
 
+_WEIGHT_SHAPE_MEMO: Dict[Any, Any] = {}
+
+
 class OpDef:
     type: str = "abstract"
 
@@ -72,8 +75,21 @@ class OpDef:
     ) -> Dict:
         """PartitionSpec per weight leaf for Megatron-style TP. Default:
         fully replicated."""
-        w = jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0), in_specs, attrs))
+        w = self.weight_shapes(in_specs, attrs)
         return jax.tree.map(lambda _: PartitionSpec(), w)
+
+    def weight_shapes(self, in_specs: List[TensorSpec], attrs: Dict):
+        """Abstract weight pytree (ShapeDtypeStructs), memoized — the one
+        shared shape-walk used by the search cost model, strategy
+        lowering, and FFModel sharding (avoids re-tracing ``init``)."""
+        from ..core.graph import freeze_attrs
+
+        key = (self.type, freeze_attrs(attrs), tuple(in_specs))
+        if key not in _WEIGHT_SHAPE_MEMO:
+            _WEIGHT_SHAPE_MEMO[key] = jax.eval_shape(
+                lambda: self.init(jax.random.PRNGKey(0), in_specs, attrs)
+            )
+        return _WEIGHT_SHAPE_MEMO[key]
 
     def flops(self, in_specs: List[TensorSpec], attrs: Dict) -> int:
         """Forward FLOPs estimate for the search cost model."""

@@ -1,0 +1,96 @@
+"""Per-operator sharding-state assignment via dynamic programming.
+
+The reference solves "optimal MachineView per op" with a DP that splits
+the PCG at 2-terminal nodes into sequence/nonsequence subproblems and
+memoizes (graph, sink-view) costs (reference ``SearchHelper::graph_cost``
+``graph.cc:1600``, ``find_optimal_{sequence,nonsequence}_graph_time``
+``graph.cc:129,281``). The TPU state space is much smaller — a handful
+of sharding states per op instead of every device sub-grid — so a
+forward Viterbi pass over the topological order suffices:
+
+    dp[n][s] = op_cost(n, s) + Σ_{e=(p→n)} min_sp dp-edge(p, sp, s)
+
+For ops with a single consumer the per-edge min is exact (chain DP =
+the reference's sequence split); at fan-out nodes each consumer chooses
+its preferred producer state independently, which can under-count a
+producer forced to serve two states — the same approximation the
+reference accepts inside its nonsequence enumeration fallback. Fan-in
+re-synchronises states exactly.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..core.graph import Graph
+from ..core.mesh import MachineSpec
+from .simulator import CostModel, candidate_states
+from .strategy import ParallelStrategy
+
+
+def placement_dp(
+    graph: Graph,
+    cost_model: CostModel,
+) -> ParallelStrategy:
+    """Assign a sharding state to every op, minimising estimated step
+    time (op roofline + resharding collectives). Returns the strategy
+    with per-node choices and its estimated cost (before grad-sync,
+    which is state-independent enough to add afterwards)."""
+    machine = cost_model.machine
+    # dp[node_id][state] = (best cumulative cost along the best
+    # predecessor states, best predecessor-state pick per input edge)
+    dp: Dict[int, Dict[str, float]] = {}
+    back: Dict[int, Dict[str, Dict[int, str]]] = {}
+
+    for node in graph.nodes:
+        states = candidate_states(node, machine)
+        dp[node.id] = {}
+        back[node.id] = {}
+        for s in states:
+            cost = cost_model.op_cost(graph, node, s)
+            picks: Dict[int, str] = {}
+            for ref in node.inputs:
+                spec = graph.out_spec(ref)
+                best_c, best_p = float("inf"), None
+                for p_state, p_cost in dp[ref.node_id].items():
+                    # amortise a shared producer's cost over its fan-out
+                    fan = max(1, len(graph.consumers(ref.node_id)))
+                    c = p_cost / fan + cost_model.reshard_cost(
+                        graph, spec, p_state, s
+                    )
+                    if c < best_c:
+                        best_c, best_p = c, p_state
+                cost += best_c if best_p is not None else 0.0
+                if best_p is not None:
+                    picks[ref.node_id] = best_p
+            dp[node.id][s] = cost
+            back[node.id][s] = picks
+
+    # Backtrack from every sink (ops with no consumers), voting on shared
+    # producers; ties resolve to the most-voted state.
+    choices: Dict[int, str] = {}
+    votes: Dict[int, Dict[str, int]] = {}
+
+    def vote(nid: int, state: str):
+        votes.setdefault(nid, {}).setdefault(state, 0)
+        votes[nid][state] += 1
+
+    sinks = [n for n in graph.nodes if not graph.consumers(n.id)]
+    total = 0.0
+    stack: List[Tuple[int, str]] = []
+    for sink in sinks:
+        s = min(dp[sink.id], key=dp[sink.id].get)
+        total += dp[sink.id][s]
+        stack.append((sink.id, s))
+    while stack:
+        nid, s = stack.pop()
+        vote(nid, s)
+        for pid, p_state in back[nid][s].items():
+            stack.append((pid, p_state))
+    for nid, v in votes.items():
+        choices[nid] = max(v, key=v.get)
+
+    strategy = ParallelStrategy(machine=machine, choices=choices)
+    strategy.estimated_step_time = total + cost_model.grad_sync_cost(
+        graph, strategy
+    )
+    return strategy

@@ -1,0 +1,228 @@
+"""Execution cost simulator for the auto-parallel search.
+
+The reference simulates a candidate strategy by timing each operator's
+real CUDA kernels on device (memoized) and pricing communication through
+the machine model, then event-simulating the task graph (reference
+``src/runtime/simulator.cc:797``, ``Op::inner_measure_operator_cost``
+``model.cu:38``). The TPU version inverts the default: the *analytic*
+roofline (MXU/HBM per op + ring-collective formulas) is primary because
+XLA fuses away op boundaries anyway, and an optional *measured* mode
+jit-compiles a per-(op, shape, state) micro-benchmark on the real chip
+to calibrate — cached aggressively, as the survey prescribes
+(SURVEY.md §7 "hard parts").
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..core.graph import Graph, OpNode
+from ..core.mesh import DATA_AXIS, MODEL_AXIS, MachineSpec
+from ..ops.registry import get_op
+from .machine_model import CollectiveModel, TPUChip, TPUTopology, compute_time
+from .strategy import ParallelStrategy, STATES
+
+_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int32": 4, "int8": 1}
+
+
+def _nbytes(spec) -> float:
+    return spec.num_elements * _BYTES.get(str(spec.dtype), 4)
+
+
+def weight_bytes(graph: Graph, node: OpNode) -> float:
+    """Total parameter bytes of one op (memoized via OpDef.weight_shapes)."""
+    import jax
+
+    op = get_op(node.op_type)
+    in_specs = [graph.out_spec(r) for r in node.inputs]
+    w = op.weight_shapes(in_specs, node.attrs_dict)
+    return float(
+        sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(w))
+    )
+
+
+# Resharding table: producer state -> consumer state -> (collective, operand)
+# operand: "act" = activation bytes move over the model axis; "none" = free.
+# Mirrors the parallel-op insertion the reference search performs between
+# differently-viewed operators (SURVEY.md §2.1 parallel operators).
+_RESHARD = {
+    ("DP", "DP"): None,
+    ("DP", "TP_COL"): None,            # replicated-in, col weights: free
+    ("DP", "TP_ROW"): None,  # row-parallel wants feature-sharded input, and
+    # every model-rank of a DP activation holds full features: a local
+    # slice, no collective.
+    ("TP_COL", "DP"): ("all_gather",),  # gather features back
+    ("TP_COL", "TP_ROW"): None,         # Megatron pair: col feeds row directly
+    ("TP_COL", "TP_COL"): ("all_gather",),
+    ("TP_ROW", "DP"): ("all_reduce",),  # unreduced partial sums
+    ("TP_ROW", "TP_COL"): ("all_reduce",),
+    ("TP_ROW", "TP_ROW"): ("all_reduce",),
+    ("REP", "DP"): None,
+    ("DP", "REP"): ("all_gather_batch",),
+    ("REP", "REP"): None,
+    ("REP", "TP_COL"): None,
+    ("REP", "TP_ROW"): None,
+    ("TP_COL", "REP"): ("all_gather",),
+    ("TP_ROW", "REP"): ("all_reduce",),
+}
+
+# TP states each op type actually implements in its weight_pspecs (only
+# states the strategy can materialise may be priced — otherwise the search
+# picks shardings that silently never happen).
+_TP_STATES = {
+    "dense": ("TP_COL", "TP_ROW"),
+    "embedding": ("TP_COL",),
+    "multihead_attention": ("TP_COL", "TP_ROW"),  # both stamp tp_shard=heads
+}
+_ANY = ("REP", "DP")
+
+
+def candidate_states(node: OpNode, machine: MachineSpec) -> Tuple[str, ...]:
+    if node.op_type == "input":
+        return ("DP",) if machine.data > 1 else ("REP",)
+    if machine.model > 1 and node.op_type in _TP_STATES:
+        return _ANY + _TP_STATES[node.op_type]
+    return _ANY
+
+
+@dataclasses.dataclass
+class CostModel:
+    topo: TPUTopology
+    machine: MachineSpec
+    training: bool = True
+    # measured-mode memo: (op_type, attrs, shapes, state) -> seconds
+    measured: Optional[Dict] = None
+
+    def __post_init__(self):
+        self.coll = CollectiveModel(self.topo)
+
+    # ------------------------------------------------------------------
+
+    def op_cost(self, graph: Graph, node: OpNode, state: str) -> float:
+        """Time for one execution of ``node`` under ``state`` on this
+        machine (fwd, or fwd+bwd when training — the reference times both,
+        simulator.cc forward_time+backward_time)."""
+        if node.op_type == "input":
+            return 0.0
+        op = get_op(node.op_type)
+        in_specs = [graph.out_spec(r) for r in node.inputs]
+        flops = float(op.flops(in_specs, node.attrs_dict))
+        bytes_moved = sum(_nbytes(s) for s in in_specs) + sum(
+            _nbytes(s) for s in node.out_specs
+        )
+        if self.training:
+            flops *= 3.0  # fwd + ~2x bwd
+            bytes_moved *= 2.0
+        # work divides over the axes this state shards
+        div = 1
+        if state in ("DP", "TP_COL", "TP_ROW"):
+            div *= self.machine.data
+        if state in ("TP_COL", "TP_ROW"):
+            div *= self.machine.model
+        key = None
+        if self.measured is not None:
+            key = (node.op_type, node.attrs, tuple(s.shape for s in in_specs), state)
+            if key in self.measured:
+                return self.measured[key]
+        t = compute_time(self.topo.chip, flops / div, bytes_moved / div)
+        return t
+
+    def reshard_cost(
+        self, graph: Graph, edge_spec, producer_state: str, consumer_state: str
+    ) -> float:
+        """Collective cost of moving one activation between two op
+        sharding states (the priced equivalents of the reference's
+        Repartition/Combine/Replicate/Reduction/AllReduce nodes)."""
+        rule = _RESHARD.get((producer_state, consumer_state))
+        if rule is None:
+            return 0.0
+        act_bytes = _nbytes(edge_spec)
+        if self.machine.data > 1:
+            act_bytes /= self.machine.data  # per-data-shard activation
+        kind = rule[0]
+        if kind == "all_reduce":
+            return self.coll.all_reduce(act_bytes, self.machine.model, MODEL_AXIS)
+        if kind == "all_gather":
+            return self.coll.all_gather(act_bytes, self.machine.model, MODEL_AXIS)
+        if kind == "all_gather_batch":
+            return self.coll.all_gather(
+                act_bytes * self.machine.data, self.machine.data, DATA_AXIS
+            )
+        return 0.0
+
+    def grad_sync_cost(self, graph: Graph, strategy: ParallelStrategy) -> float:
+        """Per-step DP gradient all-reduce over replicated weights
+        (reference: NCCL optimizer path, optimizer_kernel.cu:88)."""
+        if not self.training or self.machine.data <= 1:
+            return 0.0
+        total = 0.0
+        for node in graph.nodes:
+            if node.op_type == "input":
+                continue
+            nbytes = weight_bytes(graph, node)
+            state = strategy.choices.get(node.id, "DP")
+            if state in ("TP_COL", "TP_ROW"):
+                nbytes /= self.machine.model  # sharded grads all-reduce less
+            total += nbytes
+        return self.coll.all_reduce(total, self.machine.data, DATA_AXIS)
+
+    # ------------------------------------------------------------------
+    # measured mode (reference inner_measure_operator_cost, model.cu:38)
+
+    def measure_op(self, graph: Graph, node: OpNode, state: str, iters: int = 5):
+        """Time the op's jitted forward on the current default device and
+        memoize. Used to calibrate the analytic model on real hardware."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.registry import OpContext, get_op
+
+        if self.measured is None:
+            self.measured = {}
+        op = get_op(node.op_type)
+        in_specs = [graph.out_spec(r) for r in node.inputs]
+        key = (node.op_type, node.attrs, tuple(s.shape for s in in_specs), state)
+        if key in self.measured:
+            return self.measured[key]
+        kk = jax.random.PRNGKey(0)
+        weights = op.init(kk, in_specs, node.attrs_dict)
+        inputs = [
+            jax.random.normal(jax.random.fold_in(kk, i), s.shape, jnp.float32)
+            for i, s in enumerate(in_specs)
+        ]
+        ctx = OpContext(training=self.training)
+        fn = jax.jit(
+            lambda w, xs: op.forward(w, xs, node.attrs_dict, ctx)
+        )
+        out = fn(weights, inputs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(weights, inputs)
+        jax.block_until_ready(out)
+        t = (time.perf_counter() - t0) / iters
+        self.measured[key] = t
+        return t
+
+
+def estimate_graph_cost(
+    graph: Graph,
+    strategy: ParallelStrategy,
+    cost_model: CostModel,
+) -> float:
+    """Total estimated step time of ``graph`` under ``strategy`` — the
+    analog of ``Simulator::simulate_runtime`` (simulator.cc:797), with
+    XLA overlap approximated by straight summation (conservative)."""
+    total = 0.0
+    for node in graph.nodes:
+        state = strategy.choices.get(node.id, "DP")
+        total += cost_model.op_cost(graph, node, state)
+        for ref in node.inputs:
+            pstate = strategy.choices.get(ref.node_id, "DP")
+            total += cost_model.reshard_cost(
+                graph, graph.out_spec(ref), pstate, state
+            )
+    total += cost_model.grad_sync_cost(graph, strategy)
+    return total

@@ -1,0 +1,147 @@
+"""Parallelization strategy — the TPU-native MachineView assignment.
+
+The reference's search output is a ``MachineView`` per PCG operator
+(device list + strides) plus inserted parallel ops (reference
+``machine_view.h:18-39``, ``graph.cc:2225`` serialization). Under GSPMD
+the equivalent is (a) mesh axis degrees and (b) a per-operator *sharding
+state* describing how that op's computation is laid out; the XLA
+partitioner materialises the communication the reference represented as
+explicit Repartition/Combine/Replicate/Reduction/AllReduce nodes.
+
+Sharding states (per op):
+
+  * ``REP``     — fully replicated (reference: MachineView on 1 device /
+                  replicated weights).
+  * ``DP``      — batch dim sharded over the ``data`` axis (reference:
+                  Repartition on the sample dim).
+  * ``TP_COL``  — weights column-parallel on ``model``; output features
+                  sharded (reference: Replicate input + partition weight
+                  out-channels).
+  * ``TP_ROW``  — weights row-parallel on ``model``; consumes
+                  feature-sharded input, output needs a psum (reference:
+                  partition in-channels + Reduction after).
+
+States compose with DP: ``DP`` shards only batch; ``TP_*`` states also
+shard batch when ``data`` degree > 1 (the hybrid the Unity search
+explores via its extra parallel dims).
+
+Strategies serialize to JSON — the analog of ``--export-strategy`` /
+``--import-strategy`` (reference ``config.h:171-172``, TRAIN.md:58-60).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from jax.sharding import PartitionSpec as P
+
+from ..core.graph import Graph
+from ..core.mesh import DATA_AXIS, MODEL_AXIS, MachineSpec
+
+# The per-op sharding state space.
+STATES = ("REP", "DP", "TP_COL", "TP_ROW")
+
+
+@dataclasses.dataclass(frozen=True)
+class OpShardingChoice:
+    node_id: int
+    state: str  # one of STATES
+
+    def __post_init__(self):
+        assert self.state in STATES, self.state
+
+
+@dataclasses.dataclass
+class ParallelStrategy:
+    machine: MachineSpec
+    choices: Dict[int, str]  # node_id -> state
+    estimated_step_time: float = 0.0
+
+    # ------------------------------------------------------------------
+    # lowering to GSPMD annotations
+
+    def weight_pspecs(self, graph: Graph) -> Dict[str, object]:
+        """Per-op weight PartitionSpec trees keyed by node name — plugs
+        into FFModel._param_shardings (the compile-pipeline hook the
+        reference fills from deserialized optimal MachineViews)."""
+        import jax
+
+        from ..ops.registry import get_op
+
+        out: Dict[str, object] = {}
+        for node in graph.nodes:
+            if node.op_type == "input":
+                continue
+            op = get_op(node.op_type)
+            in_specs = [graph.out_spec(r) for r in node.inputs]
+            w = op.weight_shapes(in_specs, node.attrs_dict)
+            if not w:
+                continue
+            state = self.choices.get(node.id, "DP")
+            if state in ("TP_COL", "TP_ROW"):
+                attrs = node.attrs_dict
+                attrs["tp_shard"] = self._tp_kind(node.op_type, state)
+                out[node.name] = op.weight_pspecs(in_specs, attrs, MODEL_AXIS)
+            else:
+                out[node.name] = jax.tree.map(lambda _: P(), w)
+        return out
+
+    @staticmethod
+    def _tp_kind(op_type: str, state: str) -> str:
+        if op_type == "multihead_attention":
+            return "heads"
+        return "col" if state == "TP_COL" else "row"
+
+    def stamp(self, graph: Graph) -> None:
+        """Stamp ``tp_shard`` attrs onto the graph in place so the
+        compile pipeline's weight-sharding hook (FFModel._param_shardings)
+        and GSPMD see the found strategy — the analog of the reference's
+        convert_graph_to_operators materialising searched MachineViews
+        (model.cc:3347-3349)."""
+        for node in graph.nodes:
+            state = self.choices.get(node.id)
+            if state in ("TP_COL", "TP_ROW"):
+                d = dict(node.attrs)
+                d["tp_shard"] = self._tp_kind(node.op_type, state)
+                node.attrs = tuple(sorted(d.items()))
+
+    def activation_pspec(self, node_id: int) -> P:
+        state = self.choices.get(node_id, "DP")
+        data = DATA_AXIS if self.machine.data > 1 else None
+        if state == "TP_COL":
+            return P(data, MODEL_AXIS)  # features sharded
+        if state in ("DP", "TP_ROW"):
+            return P(data)
+        return P()
+
+    # ------------------------------------------------------------------
+    # (de)serialization — reference --export-strategy/--import-strategy
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "machine": dataclasses.asdict(self.machine),
+                "choices": {str(k): v for k, v in self.choices.items()},
+                "estimated_step_time": self.estimated_step_time,
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "ParallelStrategy":
+        d = json.loads(text)
+        return cls(
+            machine=MachineSpec(**d["machine"]),
+            choices={int(k): v for k, v in d["choices"].items()},
+            estimated_step_time=d.get("estimated_step_time", 0.0),
+        )
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "ParallelStrategy":
+        with open(path) as f:
+            return cls.from_json(f.read())

@@ -1,0 +1,285 @@
+"""Algebraic graph substitutions + backtracking search.
+
+The reference's substitution engine pattern-matches OpX/TensorX template
+graphs and runs a cost-pruned best-first search over rewrite sequences
+(reference ``src/runtime/substitution.cc:1675-2445``; rules generated
+per parallel degree at :1742-1810, plus JSON rules in
+``substitutions/graph_subst_3_v2.json``). Two TPU-design deltas:
+
+  * Parallel-op rewrites (replicate_linear_combine, partition_*_combine…)
+    don't exist here — GSPMD owns resharding, so the *placement* search
+    (:mod:`.placement`) covers that axis of Unity's space.
+  * What remains valuable at graph level is computation algebra that XLA
+    cannot see across our op boundaries: activation fusion into matmuls,
+    sibling-GEMM merging (one bigger MXU matmul), and shape-op
+    elimination. Rules are small Python match/apply pairs over the PCG
+    IR instead of template graphs.
+
+Every rule is semantics-preserving; tests check numerical equivalence of
+``run_graph`` before/after each rewrite.
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.graph import Graph, OpNode, TensorRef
+
+
+# ---------------------------------------------------------------------------
+# Graph surgery helper: rebuild a Graph with some nodes dropped/replaced.
+
+
+def rebuild(
+    graph: Graph,
+    drop: set,
+    replace_node: Dict[int, Tuple[str, Dict, Tuple[TensorRef, ...]]],
+    redirect: Dict[TensorRef, TensorRef],
+) -> Graph:
+    """Produce a new Graph: nodes in ``drop`` removed, nodes in
+    ``replace_node`` rebuilt with (op_type, attrs, inputs), and every
+    edge passed through ``redirect`` (old ref -> new ref). Node ids are
+    re-assigned densely in topological order; names are preserved so
+    weight pytrees keyed by name survive rewrites."""
+    id_map: Dict[int, int] = {}
+    out = Graph()
+
+    def map_ref(ref: TensorRef, follow_redirect: bool) -> TensorRef:
+        if follow_redirect and ref in redirect:
+            ref = redirect[ref]  # single-step: rules never chain redirects
+        return TensorRef(id_map[ref.node_id], ref.out_idx)
+
+    for node in graph.nodes:
+        if node.id in drop:
+            continue
+        if node.id in replace_node:
+            op_type, attrs, inputs = replace_node[node.id]
+            follow = False  # explicit inputs already state the new wiring
+        else:
+            op_type, attrs, inputs = node.op_type, node.attrs_dict, node.inputs
+            follow = True
+        new_inputs = tuple(map_ref(r, follow) for r in inputs)
+        if op_type == "input":
+            out_specs = node.out_specs
+        else:
+            from ..ops.registry import get_op
+
+            in_specs = [out.out_spec(r) for r in new_inputs]
+            out_specs = get_op(op_type).infer(in_specs, attrs)
+        new = out.add_node(op_type, attrs, new_inputs, out_specs, name=node.name)
+        id_map[node.id] = new.id
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rules
+
+
+@dataclasses.dataclass(frozen=True)
+class Substitution:
+    name: str
+    apply_fn: Callable[[Graph], Optional[Graph]]
+
+    def apply(self, graph: Graph) -> Optional[Graph]:
+        """Return a rewritten graph, or None when the rule doesn't match
+        anywhere. Applies at the *first* match site; the search loop
+        re-applies for further sites."""
+        return self.apply_fn(graph)
+
+
+_ACT_OPS = {"relu", "sigmoid", "tanh", "gelu", "elu"}
+
+
+def _consumers(graph: Graph, node_id: int) -> List[OpNode]:
+    return graph.consumers(node_id)
+
+
+def _fuse_dense_activation(graph: Graph) -> Optional[Graph]:
+    """dense(act=None) → elementwise-activation ⇒ dense(act) (reference
+    rule linear_relu_merge, substitution.cc:1779)."""
+    for node in graph.nodes:
+        if node.op_type != "element_unary":
+            continue
+        a = node.attrs_dict
+        if a.get("op") not in _ACT_OPS or a.get("scalar") is not None:
+            continue
+        (src,) = node.inputs
+        prod = graph.node(src.node_id)
+        if prod.op_type != "dense" or prod.attrs_dict.get("activation"):
+            continue
+        if len(_consumers(graph, prod.id)) != 1:
+            continue
+        attrs = prod.attrs_dict
+        attrs["activation"] = node.attrs_dict["op"]
+        return rebuild(
+            graph,
+            drop={node.id},
+            replace_node={prod.id: ("dense", attrs, prod.inputs)},
+            redirect={TensorRef(node.id, 0): TensorRef(prod.id, 0)},
+        )
+    return None
+
+
+def _merge_sibling_dense(graph: Graph) -> Optional[Graph]:
+    """Two dense ops on the same input with identical activation/bias ⇒
+    one wider GEMM + split (the fuse_head pattern; bigger MXU tiles). The
+    merged node keeps the first sibling's name so only the second's
+    weights re-key."""
+    for node in graph.nodes:
+        dense_consumers = [
+            c
+            for c in _consumers(graph, node.id)
+            if c.op_type == "dense"
+            and len(c.inputs) == 1
+            and c.inputs[0] == TensorRef(node.id, 0)
+        ]
+        for a, b in itertools.combinations(dense_consumers, 2):
+            aa, ba = a.attrs_dict, b.attrs_dict
+            if aa.get("activation") != ba.get("activation"):
+                continue
+            if aa.get("use_bias", True) != ba.get("use_bias", True):
+                continue
+            # redirected consumers will read from the split (b's slot):
+            # they must all sit after b in topo order
+            if any(
+                c.id <= b.id
+                for nid in (a.id, b.id)
+                for c in _consumers(graph, nid)
+                if c.id != b.id
+            ):
+                continue
+            oa, ob = aa["out_dim"], ba["out_dim"]
+            merged_attrs = dict(aa)
+            merged_attrs["out_dim"] = oa + ob
+            split_attrs = {"sizes": (oa, ob), "axis": -1}
+            # merged dense replaces `a`; split node replaces `b`
+            return rebuild(
+                graph,
+                drop=set(),
+                replace_node={
+                    a.id: ("dense", merged_attrs, a.inputs),
+                    b.id: ("split", split_attrs, (TensorRef(a.id, 0),)),
+                },
+                redirect={
+                    # consumers of a read split output 0; of b, output 1
+                    TensorRef(a.id, 0): TensorRef(b.id, 0),
+                    TensorRef(b.id, 0): TensorRef(b.id, 1),
+                },
+            )
+    return None
+
+
+def _drop_identity_reshape(graph: Graph) -> Optional[Graph]:
+    """reshape to the same shape ⇒ eliminate."""
+    for node in graph.nodes:
+        if node.op_type != "reshape":
+            continue
+        (src,) = node.inputs
+        if graph.out_spec(src).shape == node.out_specs[0].shape:
+            return rebuild(
+                graph,
+                drop={node.id},
+                replace_node={},
+                redirect={TensorRef(node.id, 0): src},
+            )
+    return None
+
+
+def _drop_inverse_transpose(graph: Graph) -> Optional[Graph]:
+    """transpose(p) ∘ transpose(q) with p∘q = id ⇒ eliminate both."""
+    for node in graph.nodes:
+        if node.op_type != "transpose":
+            continue
+        (src,) = node.inputs
+        prod = graph.node(src.node_id)
+        if prod.op_type != "transpose":
+            continue
+        p = prod.attrs_dict["perm"]
+        q = node.attrs_dict["perm"]
+        if tuple(q[i] for i in p) != tuple(range(len(p))):
+            continue
+        if len(_consumers(graph, prod.id)) != 1:
+            continue
+        return rebuild(
+            graph,
+            drop={node.id, prod.id},
+            replace_node={},
+            redirect={TensorRef(node.id, 0): prod.inputs[0]},
+        )
+    return None
+
+
+def _merge_cast_chain(graph: Graph) -> Optional[Graph]:
+    """cast ∘ cast ⇒ single cast to the final dtype."""
+    for node in graph.nodes:
+        if node.op_type != "cast":
+            continue
+        (src,) = node.inputs
+        prod = graph.node(src.node_id)
+        if prod.op_type != "cast" or len(_consumers(graph, prod.id)) != 1:
+            continue
+        return rebuild(
+            graph,
+            drop={prod.id},
+            replace_node={node.id: ("cast", node.attrs_dict, prod.inputs)},
+            redirect={},
+        )
+    return None
+
+
+SUBSTITUTIONS: List[Substitution] = [
+    Substitution("fuse_dense_activation", _fuse_dense_activation),
+    Substitution("merge_sibling_dense", _merge_sibling_dense),
+    Substitution("drop_identity_reshape", _drop_identity_reshape),
+    Substitution("drop_inverse_transpose", _drop_inverse_transpose),
+    Substitution("merge_cast_chain", _merge_cast_chain),
+]
+
+
+# ---------------------------------------------------------------------------
+# Best-first rewrite search (reference base_optimize, substitution.cc:2245)
+
+
+def apply_substitutions(
+    graph: Graph,
+    cost_fn: Callable[[Graph], float],
+    budget: int = 64,
+    alpha: float = 1.05,
+    rules: Optional[List[Substitution]] = None,
+) -> Tuple[Graph, float, List[str]]:
+    """Best-first search over rewrite sequences: expand the cheapest
+    graph state, prune candidates costing more than ``alpha`` × best
+    (the reference's alpha pruning + ``--budget``). Returns (best graph,
+    best cost, applied-rule trace)."""
+    rules = rules if rules is not None else SUBSTITUTIONS
+    start_cost = cost_fn(graph)
+    best_graph, best_cost, best_trace = graph, start_cost, []
+    seen = {_graph_key(graph)}
+    counter = itertools.count()
+    heap = [(start_cost, next(counter), graph, [])]
+    expansions = 0
+    while heap and expansions < budget:
+        cost, _, g, trace = heapq.heappop(heap)
+        expansions += 1
+        for rule in rules:
+            g2 = rule.apply(g)
+            if g2 is None:
+                continue
+            key = _graph_key(g2)
+            if key in seen:
+                continue
+            seen.add(key)
+            c2 = cost_fn(g2)
+            if c2 < best_cost:
+                best_graph, best_cost, best_trace = g2, c2, trace + [rule.name]
+            if c2 <= alpha * best_cost:
+                heapq.heappush(heap, (c2, next(counter), g2, trace + [rule.name]))
+    return best_graph, best_cost, best_trace
+
+
+def _graph_key(graph: Graph) -> Tuple:
+    return tuple(
+        (n.op_type, n.attrs, n.inputs) for n in graph.nodes
+    )

@@ -1,0 +1,140 @@
+"""Top-level Unity-style joint optimization + MCMC fallback.
+
+Mirrors the reference's two searches:
+
+  * :func:`optimize` — the Unity path (reference
+    ``GraphSearchHelper::graph_optimize``, substitution.cc:1914): for
+    each candidate mesh shape (axis-degree factorization of the device
+    count — the analog of enumerating MachineResource splits), run the
+    substitution best-first search with the placement DP as the cost
+    oracle, keep the (graph, strategy) with the lowest simulated step
+    time.
+  * :func:`mcmc_optimize` — the legacy simulated-annealing fallback
+    (reference ``FFModel::mcmc_optimize``, model.cc:3808): random
+    single-op state flips accepted by the Metropolis rule.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Iterable, List, Optional, Tuple
+
+from ..core.graph import Graph
+from ..core.mesh import MachineSpec
+from .machine_model import TPUChip, TPUTopology
+from .placement import placement_dp
+from .simulator import CostModel, candidate_states, estimate_graph_cost
+from .strategy import ParallelStrategy
+from .substitutions import SUBSTITUTIONS, apply_substitutions
+
+
+def mesh_candidates(num_devices: int, max_model: int = 8) -> List[MachineSpec]:
+    """Factor the device count over (data, model) axis degrees — the
+    search's machine-grid enumeration. Pipeline/seq/expert degrees are
+    driven by explicit config for now (the reference likewise fixes
+    inference PP outside the search)."""
+    out = []
+    d = 1
+    while d <= num_devices:
+        if num_devices % d == 0:
+            model = num_devices // d
+            if model <= max_model or model == num_devices:
+                out.append(MachineSpec(data=d, model=model))
+        d *= 2
+    if not any(m.model == 1 for m in out):
+        out.append(MachineSpec(data=num_devices, model=1))
+    return out
+
+
+@dataclasses.dataclass
+class SearchReport:
+    best_cost: float
+    machine: MachineSpec
+    substitutions_applied: List[str]
+    candidates_evaluated: int
+
+
+def optimize(
+    graph: Graph,
+    num_devices: int,
+    topo: Optional[TPUTopology] = None,
+    *,
+    training: bool = True,
+    budget: int = 32,
+    alpha: float = 1.05,
+    machines: Optional[Iterable[MachineSpec]] = None,
+) -> Tuple[Graph, ParallelStrategy, SearchReport]:
+    """Joint substitution + sharding search. Returns the rewritten graph,
+    the winning strategy, and a report."""
+    topo = topo or TPUTopology(chip=TPUChip.v5e(), num_chips=num_devices)
+    machines = list(machines) if machines is not None else mesh_candidates(num_devices)
+
+    best: Optional[Tuple[float, Graph, ParallelStrategy, List[str]]] = None
+    evaluated = 0
+    for machine in machines:
+        cm = CostModel(topo=topo, machine=machine, training=training)
+
+        def cost_fn(g: Graph) -> float:
+            return placement_dp(g, cm).estimated_step_time
+
+        g2, cost2, trace = apply_substitutions(
+            graph, cost_fn, budget=budget, alpha=alpha
+        )
+        strat = placement_dp(g2, cm)
+        evaluated += 1
+        if best is None or strat.estimated_step_time < best[0]:
+            best = (strat.estimated_step_time, g2, strat, trace)
+    cost, g_best, s_best, trace = best
+    report = SearchReport(
+        best_cost=cost,
+        machine=s_best.machine,
+        substitutions_applied=trace,
+        candidates_evaluated=evaluated,
+    )
+    return g_best, s_best, report
+
+
+def mcmc_optimize(
+    graph: Graph,
+    cost_model: CostModel,
+    *,
+    iters: int = 500,
+    temperature: float = 0.25,
+    seed: int = 0,
+    init: Optional[ParallelStrategy] = None,
+) -> ParallelStrategy:
+    """Metropolis search over per-op sharding states (reference
+    ``FFModel::mcmc_optimize``: random op gets a random machine view,
+    accept if exp(-Δ/T) beats a coin flip)."""
+    rng = random.Random(seed)
+    machine = cost_model.machine
+    nodes = [n for n in graph.nodes if n.op_type != "input"]
+    if init is not None:
+        choices = dict(init.choices)
+    else:
+        choices = {n.id: "DP" for n in graph.nodes}
+    strat = ParallelStrategy(machine=machine, choices=choices)
+    cur = estimate_graph_cost(graph, strat, cost_model)
+    best_choices, best_cost = dict(choices), cur
+    for _ in range(iters):
+        node = rng.choice(nodes)
+        states = candidate_states(node, machine)
+        new_state = rng.choice(states)
+        old_state = choices.get(node.id, "DP")
+        if new_state == old_state:
+            continue
+        choices[node.id] = new_state
+        cand = estimate_graph_cost(
+            graph, ParallelStrategy(machine=machine, choices=choices), cost_model
+        )
+        delta = cand - cur
+        if delta <= 0 or rng.random() < math.exp(-delta / (temperature * max(cur, 1e-12))):
+            cur = cand
+            if cur < best_cost:
+                best_cost, best_choices = cur, dict(choices)
+        else:
+            choices[node.id] = old_state
+    out = ParallelStrategy(machine=machine, choices=best_choices)
+    out.estimated_step_time = best_cost
+    return out

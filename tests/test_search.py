@@ -1,0 +1,192 @@
+"""Unity-search tests: substitution semantics preservation, placement DP
+sanity, strategy round-trip, and end-to-end auto-parallel compile — the
+TPU analog of the reference's ``tests/unit`` search-infrastructure tests
+(machine views, substitutions) per SURVEY.md §4."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.core.mesh import MachineSpec
+from flexflow_tpu.search import (
+    CostModel,
+    ParallelStrategy,
+    SUBSTITUTIONS,
+    TPUChip,
+    TPUTopology,
+    apply_substitutions,
+    estimate_graph_cost,
+    mcmc_optimize,
+    optimize,
+    placement_dp,
+)
+from flexflow_tpu.search.substitutions import (
+    _drop_identity_reshape,
+    _fuse_dense_activation,
+    _merge_sibling_dense,
+)
+
+
+def _mlp_model(hidden=32, out=4):
+    cfg = ff.FFConfig(batch_size=16, num_devices=1)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((16, 8), name="x")
+    t = m.dense(t, hidden)
+    t = m.relu(t)
+    t = m.dense(t, out)
+    return m
+
+
+def _run(model, params, x):
+    out, _ = model.run_graph(params, {"x": jnp.asarray(x)}, training=False)
+    return np.asarray(out)
+
+
+def test_fuse_dense_activation_preserves_semantics():
+    m = _mlp_model()
+    params = m.init_params(jax.random.PRNGKey(0))
+    x = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+    before = _run(m, params, x)
+    n_before = len(m.graph)
+
+    g2 = _fuse_dense_activation(m.graph)
+    assert g2 is not None and len(g2) == n_before - 1
+    m.graph = g2
+    after = _run(m, params, x)
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_merge_sibling_dense_is_wider_gemm():
+    cfg = ff.FFConfig(batch_size=4, num_devices=1)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((4, 8), name="x")
+    a = m.dense(t, 6, name="head_a")
+    b = m.dense(t, 10, name="head_b")
+    params = m.init_params(jax.random.PRNGKey(1))
+    x = np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32)
+    ya, _ = m.run_graph(params, {"x": jnp.asarray(x)}, training=False, upto=a.ref)
+    yb, _ = m.run_graph(params, {"x": jnp.asarray(x)}, training=False, upto=b.ref)
+
+    g2 = _merge_sibling_dense(m.graph)
+    assert g2 is not None
+    kinds = [n.op_type for n in g2.nodes]
+    assert kinds.count("dense") == 1 and "split" in kinds
+
+    # merged weights = concat of the originals along out_dim
+    merged = {
+        "head_a": {
+            "kernel": jnp.concatenate(
+                [params["head_a"]["kernel"], params["head_b"]["kernel"]], axis=1
+            ),
+            "bias": jnp.concatenate(
+                [params["head_a"]["bias"], params["head_b"]["bias"]]
+            ),
+        }
+    }
+    m.graph = g2
+    split_node = next(n for n in g2.nodes if n.op_type == "split")
+    from flexflow_tpu.core.graph import TensorRef
+
+    ya2, _ = m.run_graph(
+        merged, {"x": jnp.asarray(x)}, training=False, upto=TensorRef(split_node.id, 0)
+    )
+    yb2, _ = m.run_graph(
+        merged, {"x": jnp.asarray(x)}, training=False, upto=TensorRef(split_node.id, 1)
+    )
+    np.testing.assert_allclose(np.asarray(ya2), np.asarray(ya), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(yb2), np.asarray(yb), rtol=1e-6)
+
+
+def test_drop_identity_reshape():
+    cfg = ff.FFConfig(batch_size=4, num_devices=1)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((4, 8), name="x")
+    t = m.reshape(t, (4, 8))
+    t = m.dense(t, 3)
+    g2 = _drop_identity_reshape(m.graph)
+    assert g2 is not None
+    assert all(n.op_type != "reshape" for n in g2.nodes)
+
+
+def test_substitution_search_finds_fusion():
+    m = _mlp_model()
+    topo = TPUTopology(chip=TPUChip.v5e(), num_chips=1)
+    cm = CostModel(topo=topo, machine=MachineSpec(), training=True)
+
+    def cost_fn(g):
+        return placement_dp(g, cm).estimated_step_time
+
+    g2, cost, trace = apply_substitutions(m.graph, cost_fn, budget=16)
+    assert "fuse_dense_activation" in trace
+    assert cost <= cost_fn(m.graph) + 1e-12
+
+
+def test_placement_prefers_tp_when_grad_sync_dominates():
+    """Tiny batch + fat weights: pure DP pays a huge gradient all-reduce,
+    so the DP should choose TP states for the big dense ops (Unity's
+    core value proposition)."""
+    cfg = ff.FFConfig(batch_size=2, num_devices=8)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((2, 4096), name="x")
+    t = m.dense(t, 8192)
+    t = m.dense(t, 4096)
+    topo = TPUTopology(chip=TPUChip.v5e(), num_chips=8)
+    machine = MachineSpec(data=2, model=4)
+    cm = CostModel(topo=topo, machine=machine, training=True)
+    strat = placement_dp(m.graph, cm)
+    dense_states = [
+        strat.choices[n.id] for n in m.graph.nodes if n.op_type == "dense"
+    ]
+    assert any(s.startswith("TP_") for s in dense_states), dense_states
+
+    # and the found strategy beats all-DP
+    all_dp = ParallelStrategy(
+        machine=machine, choices={n.id: "DP" for n in m.graph.nodes}
+    )
+    assert strat.estimated_step_time <= estimate_graph_cost(m.graph, all_dp, cm)
+
+
+def test_optimize_and_strategy_roundtrip(tmp_path):
+    m = _mlp_model(hidden=64)
+    g2, strat, report = optimize(m.graph, num_devices=8, budget=8)
+    assert report.best_cost > 0
+    assert strat.machine.num_devices == 8
+
+    p = tmp_path / "strategy.json"
+    strat.save(str(p))
+    back = ParallelStrategy.load(str(p))
+    assert back.choices == strat.choices
+    assert back.machine == strat.machine
+
+
+def test_mcmc_not_worse_than_all_dp():
+    m = _mlp_model(hidden=128)
+    topo = TPUTopology(chip=TPUChip.v5e(), num_chips=8)
+    cm = CostModel(topo=topo, machine=MachineSpec(data=4, model=2), training=True)
+    strat = mcmc_optimize(m.graph, cm, iters=200, seed=3)
+    all_dp = ParallelStrategy(
+        machine=cm.machine, choices={n.id: "DP" for n in m.graph.nodes}
+    )
+    assert strat.estimated_step_time <= estimate_graph_cost(m.graph, all_dp, cm) + 1e-12
+
+
+def test_compile_auto_parallel_e2e():
+    """auto_parallel compile must train: search rewrites the graph, picks
+    degrees, and the jitted step runs on the 8-device CPU mesh."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 16)).astype(np.float32) + np.repeat(
+        np.eye(4, 16) * 3, 16, axis=0
+    ).astype(np.float32)
+    y = np.repeat(np.arange(4), 16).astype(np.int32)
+    cfg = ff.FFConfig(batch_size=32, epochs=3, num_devices=8)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((32, 16), name="x")
+    t = m.dense(t, 64)
+    t = m.relu(t)
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05), auto_parallel=True)
+    assert m._search_report is not None
+    perf = m.fit(x, y)
+    assert perf.averages()["accuracy"] > 0.5
