@@ -364,6 +364,101 @@ class FFModel:
         return self._add("batch_matmul", {}, [a, b], name)
 
     # elementwise builders
+    # --- MoE builders (reference model.h:509-645) ----------------------
+
+    def top_k(self, input: Tensor, k: int, name: str = ""):
+        """Router top-k values+indices (reference ``FFModel::top_k``)."""
+        return self._add("top_k", dict(k=k), [input], name)
+
+    def group_by(
+        self,
+        input: Tensor,
+        probs: Tensor,
+        k: int,
+        capacity_factor: float = 1.25,
+        name: str = "",
+    ):
+        """Dispatch tokens into per-expert buckets (reference
+        ``FFModel::group_by``; alpha → capacity_factor)."""
+        return self._add(
+            "group_by",
+            dict(k=k, capacity_factor=capacity_factor),
+            [input, probs],
+            name,
+        )
+
+    def aggregate(
+        self,
+        expert_out: Tensor,
+        combine: Tensor,
+        probs: Tensor,
+        load_balance_lambda: float = 1e-2,
+        name: str = "",
+    ):
+        """Weighted combine + load-balance loss (reference
+        ``FFModel::aggregate`` with λ)."""
+        return self._add(
+            "aggregate",
+            dict(load_balance_lambda=load_balance_lambda),
+            [expert_out, combine, probs],
+            name,
+        )
+
+    def moe(
+        self,
+        input: Tensor,
+        num_experts: int,
+        top_k: int,
+        expert_hidden: int,
+        capacity_factor: float = 1.25,
+        activation: str = "relu",
+        load_balance_lambda: float = 1e-2,
+        use_bias: bool = False,
+        name: str = "",
+    ) -> Tensor:
+        """Fused MoE layer (reference ``FFModel::moe``, model.h:622-645)."""
+        return self._add(
+            "moe",
+            dict(
+                num_experts=num_experts,
+                top_k=top_k,
+                expert_hidden=expert_hidden,
+                capacity_factor=capacity_factor,
+                activation=activation,
+                load_balance_lambda=load_balance_lambda,
+                use_bias=use_bias,
+            ),
+            [input],
+            name,
+        )
+
+    def experts(
+        self,
+        input: Tensor,
+        idx: Tensor,
+        gates: Tensor,
+        num_experts: int,
+        top_k: int,
+        expert_hidden: int,
+        capacity_factor: float = 2.0,
+        activation: str = "gelu",
+        name: str = "",
+    ) -> Tensor:
+        """Fused inference experts on precomputed routing (reference
+        ``FFModel::experts``, src/ops/experts.cc)."""
+        return self._add(
+            "experts",
+            dict(
+                num_experts=num_experts,
+                top_k=top_k,
+                expert_hidden=expert_hidden,
+                capacity_factor=capacity_factor,
+                activation=activation,
+            ),
+            [input, idx, gates],
+            name,
+        )
+
     def _unary(self, op, input, name="", scalar=None):
         attrs = {"op": op}
         if scalar is not None:
@@ -666,7 +761,13 @@ class FFModel:
                     state=state,
                     upto=self._output_ref,
                 )
-                return loss_fn(preds, labels), (preds, st_up)
+                loss = loss_fn(preds, labels)
+                # auxiliary losses collected by ops (MoE load-balance,
+                # reference aggregate λ term)
+                aux = st_up.pop("__aux__", None)
+                if aux:
+                    loss = loss + jnp.sum(jnp.stack(aux))
+                return loss, (preds, st_up)
 
             (loss, (preds, st_up)), grads = jax.value_and_grad(
                 lossf, has_aux=True

@@ -1,4 +1,5 @@
 from .registry import OpDef, OpContext, register, get_op, all_ops
 from . import core  # noqa: F401  (registers core ops)
+from . import moe   # noqa: F401  (registers MoE ops)
 
 __all__ = ["OpDef", "OpContext", "register", "get_op", "all_ops"]

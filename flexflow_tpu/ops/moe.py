@@ -1,0 +1,329 @@
+"""Mixture-of-Experts operators.
+
+The reference ships two MoE paths (SURVEY.md §2.1/§2.2 EP):
+
+  * training: ``top_k → group_by → per-expert dense → aggregate`` with a
+    load-balancing term (reference ``model.h:509-531,622-645``,
+    ``src/ops/{topk,group_by,aggregate}.cc``, MoE example
+    ``examples/cpp/mixture_of_experts/moe.cc:100-130``);
+  * inference: the fused ``Experts`` op — thrust-sorted token routing +
+    batched GEMMs with expert-range sharding (``src/ops/experts.cc``,
+    params ``num_experts``/``experts_start_idx``).
+
+TPU re-design: scatter/sort routing is hostile to the MXU, so dispatch
+is **dense one-hot matmul** (Switch-Transformer style): tokens →
+capacity-bucketed one-hot dispatch tensor → batched expert GEMMs via
+einsum → weighted combine. Everything is static-shaped, vmappable, and
+the expert dim shards over the ``expert`` mesh axis so each device
+group holds only its expert range (the TPU version of
+``experts_start_idx`` range sharding); GSPMD inserts the all-to-alls.
+
+Ops registered here:
+  * ``top_k``     — router values+indices (reference topk.cc)
+  * ``group_by``  — dispatch tokens to (E, C, D) expert buckets
+  * ``aggregate`` — weighted combine back to (N, D), adds the
+                    load-balance aux loss during training
+  * ``moe``       — fused gate+dispatch+experts+combine layer
+  * ``experts``   — fused expert FFN on pre-computed routing (inference)
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.mesh import EXPERT_AXIS
+from ..core.tensor import TensorSpec
+from ..core.dtypes import DataType
+from .registry import OpDef, register
+from .. import initializers as ffinit
+
+
+def _capacity(num_tokens: int, num_experts: int, top_k: int, factor: float) -> int:
+    return max(1, int(math.ceil(top_k * num_tokens / num_experts * factor)))
+
+
+def _dispatch_from_topk(gates: jnp.ndarray, idx: jnp.ndarray, num_experts: int,
+                        capacity: int):
+    """(gates, idx) (N, K) → dispatch (N, E, C) one-hot + gate-weighted
+    combine (N, E, C). Queue positions are assigned k-major then
+    token-order (cumsum over the flattened (K, N) axis); tokens beyond
+    an expert's capacity are dropped — standard Switch semantics, and
+    the reference's group_by likewise truncates at ``alpha``-scaled
+    capacity. Shared by the training (moe/group_by) and inference
+    (experts) paths."""
+    N, K = gates.shape
+    dt = gates.dtype
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(idx, num_experts, dtype=dt)        # (N, K, E)
+    pos = jnp.cumsum(onehot.transpose(1, 0, 2).reshape(K * N, num_experts), axis=0)
+    pos = (pos - 1).reshape(K, N, num_experts).transpose(1, 0, 2)   # (N, K, E)
+    within = (pos < capacity) & (onehot > 0)
+    pos_clipped = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos_clipped, capacity, dtype=dt)     # (N,K,E,C)
+    dispatch_k = slot * within[..., None].astype(dt) * onehot[..., None]
+    dispatch = dispatch_k.sum(axis=1)                          # (N, E, C)
+    combine = (dispatch_k * gates[:, :, None, None]).sum(axis=1)
+    return dispatch, combine
+
+
+def _routing(probs: jnp.ndarray, top_k: int, capacity: int):
+    """probs (N, E) → (dispatch, combine, gates, idx)."""
+    gates, idx = lax.top_k(probs, top_k)                      # (N, K)
+    dispatch, combine = _dispatch_from_topk(gates, idx, probs.shape[-1], capacity)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    return dispatch, combine, gates, idx
+
+
+def _load_balance_loss(probs: jnp.ndarray, dispatch: jnp.ndarray) -> jnp.ndarray:
+    """Switch load-balance loss: E · Σ_e fraction_e · mean-prob_e (the
+    reference's aggregate λ term)."""
+    E = probs.shape[-1]
+    frac = (dispatch.sum(axis=2) > 0).astype(jnp.float32).mean(axis=0)  # (E,)
+    mean_prob = probs.astype(jnp.float32).mean(axis=0)
+    return E * jnp.sum(frac * mean_prob)
+
+
+def _expert_ffn(x_ecd, w, activation: str):
+    """Batched per-expert FFN: (E, C, D) × (E, D, F) × (E, F, D)."""
+    h = jnp.einsum("ecd,edf->ecf", x_ecd, w["w1"],
+                   preferred_element_type=jnp.float32).astype(x_ecd.dtype)
+    if "b1" in w:
+        h = h + w["b1"][:, None, :]
+    if activation == "relu":
+        h = jax.nn.relu(h)
+    elif activation == "gelu":
+        h = jax.nn.gelu(h)
+    elif activation == "silu":
+        h = jax.nn.silu(h)
+    elif activation not in (None, "none"):
+        raise ValueError(f"unknown expert activation {activation!r}")
+    y = jnp.einsum("ecf,efd->ecd", h, w["w2"],
+                   preferred_element_type=jnp.float32).astype(x_ecd.dtype)
+    if "b2" in w:
+        y = y + w["b2"][:, None, :]
+    return y
+
+
+def _maybe_constrain_experts(t, ctx, spec):
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is not None and EXPERT_AXIS in mesh.shape and mesh.shape[EXPERT_AXIS] > 1:
+        return lax.with_sharding_constraint(t, spec)
+    return t
+
+
+@register
+class TopKOp(OpDef):
+    """Router top-k — reference ``src/ops/topk.cc`` / ``arg_topk.cc``."""
+
+    type = "top_k"
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        k = attrs["k"]
+        out = x.shape[:-1] + (k,)
+        return [TensorSpec(out, x.dtype), TensorSpec(out, DataType.INT32)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        vals, idx = lax.top_k(x, attrs["k"])
+        return [vals, idx.astype(jnp.int32)]
+
+    def flops(self, in_specs, attrs):
+        return in_specs[0].num_elements * int(math.log2(max(2, attrs["k"])))
+
+
+@register
+class GroupByOp(OpDef):
+    """Dispatch tokens into per-expert capacity buckets — reference
+    ``src/ops/group_by.cc`` (its CUDA scatter becomes a one-hot matmul).
+    Inputs: x (N, D), probs (N, E). Outputs: buckets (E, C, D),
+    dispatch (N, E, C), combine (N, E, C)."""
+
+    type = "group_by"
+
+    def infer(self, in_specs, attrs):
+        x, probs = in_specs
+        N, D = x.shape
+        E = probs.shape[-1]
+        C = _capacity(N, E, attrs["k"], attrs.get("capacity_factor", 1.25))
+        return [
+            TensorSpec((E, C, D), x.dtype),
+            TensorSpec((N, E, C), x.dtype),
+            TensorSpec((N, E, C), x.dtype),
+        ]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        x, probs = inputs
+        N, D = x.shape
+        E = probs.shape[-1]
+        C = _capacity(N, E, attrs["k"], attrs.get("capacity_factor", 1.25))
+        dispatch, combine, _, _ = _routing(probs, attrs["k"], C)
+        buckets = jnp.einsum("nec,nd->ecd", dispatch, x,
+                             preferred_element_type=jnp.float32).astype(x.dtype)
+        buckets = _maybe_constrain_experts(buckets, ctx, P(EXPERT_AXIS, None, None))
+        return [buckets, dispatch, combine]
+
+    def flops(self, in_specs, attrs):
+        x, probs = in_specs
+        E = probs.shape[-1]
+        C = _capacity(x.shape[0], E, attrs["k"], attrs.get("capacity_factor", 1.25))
+        return 2 * x.num_elements * E * C  # 'nec,nd->ecd' = 2·N·D·E·C
+
+
+@register
+class AggregateOp(OpDef):
+    """Weighted combine of expert outputs — reference
+    ``src/ops/aggregate.cc`` (adds the load-balance aux loss in
+    training, like the reference's λ term in aggregate's backward).
+    Inputs: expert_out (E, C, D), combine (N, E, C), probs (N, E)."""
+
+    type = "aggregate"
+
+    def infer(self, in_specs, attrs):
+        eo, combine, probs = in_specs
+        N = combine.shape[0]
+        return [TensorSpec((N, eo.shape[-1]), eo.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        expert_out, combine, probs = inputs
+        y = jnp.einsum("nec,ecd->nd", combine, expert_out,
+                       preferred_element_type=jnp.float32).astype(expert_out.dtype)
+        lam = attrs.get("load_balance_lambda", 0.0)
+        if ctx.training and lam > 0.0 and ctx.state_updates is not None:
+            dispatch = (combine > 0).astype(jnp.float32)
+            aux = lam * _load_balance_loss(probs, dispatch)
+            ctx.state_updates.setdefault("__aux__", []).append(aux)
+        return [y]
+
+    def flops(self, in_specs, attrs):
+        eo, combine, _ = in_specs
+        return 2 * combine.num_elements * eo.shape[-1]
+
+
+@register
+class MoEOp(OpDef):
+    """Fused MoE layer: gate → top-k dispatch → batched expert FFNs →
+    combine (+ aux loss). The TPU equivalent of the reference's MoE
+    wrapper (``FFModel::moe``, model.h:622-645) and the training
+    composition in the MoE example. Expert weights carry a leading E dim
+    sharded over the ``expert`` mesh axis."""
+
+    type = "moe"
+
+    def infer(self, in_specs, attrs):
+        (x,) = in_specs
+        return [TensorSpec(x.shape, x.dtype)]
+
+    def init(self, key, in_specs, attrs):
+        (x,) = in_specs
+        D = x.shape[-1]
+        E, F = attrs["num_experts"], attrs["expert_hidden"]
+        k1, k2, k3 = jax.random.split(key, 3)
+        dt = x.jnp_dtype
+        glorot = ffinit.GlorotUniform()
+        w = {
+            "gate": glorot(k1, (D, E), dt),
+            "w1": glorot(k2, (E, D, F), dt),
+            "w2": glorot(k3, (E, F, D), dt),
+        }
+        if attrs.get("use_bias", False):
+            w["b1"] = jnp.zeros((E, F), dt)
+            w["b2"] = jnp.zeros((E, D), dt)
+        return w
+
+    def weight_pspecs(self, in_specs, attrs, model_axis):
+        specs = {
+            "gate": P(),
+            "w1": P(EXPERT_AXIS, None, None),
+            "w2": P(EXPERT_AXIS, None, None),
+        }
+        if attrs.get("use_bias", False):
+            specs["b1"] = P(EXPERT_AXIS, None)
+            specs["b2"] = P(EXPERT_AXIS, None)
+        return specs
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        orig_shape = x.shape
+        D = orig_shape[-1]
+        xt = x.reshape(-1, D)
+        N = xt.shape[0]
+        E, K = attrs["num_experts"], attrs["top_k"]
+        C = _capacity(N, E, K, attrs.get("capacity_factor", 1.25))
+        logits = jnp.matmul(xt, weights["gate"],
+                            preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        dispatch, combine, _, _ = _routing(probs, K, C)
+        buckets = jnp.einsum("nec,nd->ecd", dispatch, xt,
+                             preferred_element_type=jnp.float32).astype(x.dtype)
+        buckets = _maybe_constrain_experts(buckets, ctx, P(EXPERT_AXIS, None, None))
+        out = _expert_ffn(buckets, weights, attrs.get("activation", "relu"))
+        y = jnp.einsum("nec,ecd->nd", combine, out,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        lam = attrs.get("load_balance_lambda", 1e-2)
+        if ctx.training and lam > 0.0 and ctx.state_updates is not None:
+            aux = lam * _load_balance_loss(probs, dispatch)
+            ctx.state_updates.setdefault("__aux__", []).append(aux)
+        return [y.reshape(orig_shape)]
+
+    def flops(self, in_specs, attrs):
+        (x,) = in_specs
+        D = x.shape[-1]
+        N = x.num_elements // D
+        E, F = attrs["num_experts"], attrs["expert_hidden"]
+        C = _capacity(N, E, attrs["top_k"], attrs.get("capacity_factor", 1.25))
+        return 2 * N * D * E + 4 * E * C * D * F
+
+
+@register
+class ExpertsOp(OpDef):
+    """Fused inference experts on pre-computed routing — reference
+    ``src/ops/experts.cc`` (``num_experts`` + ``experts_start_idx``
+    range sharding → the E dim over the expert mesh axis here).
+    Inputs: x (N, D), idx (N, K) int32, gates (N, K)."""
+
+    type = "experts"
+
+    def infer(self, in_specs, attrs):
+        x = in_specs[0]
+        return [TensorSpec(x.shape, x.dtype)]
+
+    def init(self, key, in_specs, attrs):
+        x = in_specs[0]
+        D = x.shape[-1]
+        E, F = attrs["num_experts"], attrs["expert_hidden"]
+        k1, k2 = jax.random.split(key)
+        glorot = ffinit.GlorotUniform()
+        dt = x.jnp_dtype
+        return {"w1": glorot(k1, (E, D, F), dt), "w2": glorot(k2, (E, F, D), dt)}
+
+    def weight_pspecs(self, in_specs, attrs, model_axis):
+        return {"w1": P(EXPERT_AXIS, None, None), "w2": P(EXPERT_AXIS, None, None)}
+
+    def forward(self, weights, inputs, attrs, ctx):
+        x, idx, gates = inputs
+        N, D = x.shape
+        E, K = attrs["num_experts"], attrs["top_k"]
+        C = _capacity(N, E, K, attrs.get("capacity_factor", 2.0))
+        dispatch, combine = _dispatch_from_topk(
+            gates.astype(x.dtype), idx, E, C
+        )
+        buckets = jnp.einsum("nec,nd->ecd", dispatch, x,
+                             preferred_element_type=jnp.float32).astype(x.dtype)
+        buckets = _maybe_constrain_experts(buckets, ctx, P(EXPERT_AXIS, None, None))
+        out = _expert_ffn(buckets, weights, attrs.get("activation", "gelu"))
+        y = jnp.einsum("nec,ecd->nd", combine, out,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        return [y]
+
+    def flops(self, in_specs, attrs):
+        x = in_specs[0]
+        N, D = x.shape
+        E, F = attrs["num_experts"], attrs["expert_hidden"]
+        C = _capacity(N, E, attrs["top_k"], attrs.get("capacity_factor", 2.0))
+        return 4 * E * C * D * F
