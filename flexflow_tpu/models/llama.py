@@ -227,6 +227,7 @@ def block(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     mask: Optional[jnp.ndarray],
+    attn_fn=None,  # override for sequence-parallel attention
 ):
     """One transformer block, training path (full local-sequence
     attention). The serving path with KV cache is :func:`serve_block`.
@@ -241,7 +242,7 @@ def block(
     v = _mm(h, p["wv"]).reshape(B, S, KV, dk)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = attention(cfg, q, k, v, mask)
+    attn = (attn_fn or attention)(cfg, q, k, v, mask)
 
     x = x + _mm(attn.reshape(B, S, H * dk), p["wo"])
     h2 = _rms(x, p["ffn_norm"], cfg.rms_norm_eps)
@@ -253,6 +254,25 @@ def causal_mask(S: int) -> jnp.ndarray:
     return jnp.tril(jnp.ones((S, S), bool))
 
 
+def make_sp_attention(mesh, impl: str = "ring"):
+    """Build a sequence-parallel attention override for :func:`block`
+    (ring ppermute or Ulysses all-to-all over the ``seq`` axis — the
+    long-context capability the reference lacks, SURVEY.md §7 step 7)."""
+    from ..parallel.sequence import ring_attention, ulysses_attention
+
+    fn = ring_attention if impl == "ring" else ulysses_attention
+
+    def attn_fn(cfg, q, k, v, mask):
+        # K/V stay compact (GQA/MQA); the SP primitives expand per block
+        # so ring ppermute traffic is KV-sized, not H-sized.
+        return fn(
+            q, k, v, mesh, causal=True,
+            shard_heads=mesh.shape[MODEL_AXIS] > 1,
+        )
+
+    return attn_fn
+
+
 def forward(
     params: Dict[str, Any],
     tokens: jnp.ndarray,  # (B, S) int32
@@ -261,15 +281,19 @@ def forward(
     positions: Optional[jnp.ndarray] = None,
     remat: bool = False,
     shard_activations: bool = False,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """Training/eval forward: full causal attention, returns logits
-    (B, S, V)."""
+    (B, S, V). ``attn_fn`` overrides the attention computation (see
+    :func:`make_sp_attention` for ring/Ulysses sequence parallelism)."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
     cos, sin = rope_freqs(cfg, positions)
-    mask = causal_mask(S)
+    # SP attention derives causality from global positions — never
+    # materialise the S×S mask on the long-context path.
+    mask = None if attn_fn is not None else causal_mask(S)
 
     def constrain(t):
         if shard_activations:
@@ -280,7 +304,7 @@ def forward(
 
     x = constrain(x)
 
-    blk = functools.partial(block, cfg)
+    blk = functools.partial(block, cfg, attn_fn=attn_fn)
     if remat:
         blk = jax.checkpoint(blk)
 
@@ -339,6 +363,8 @@ def make_train_step(
         return params, opt_state
 
     if not pipeline:
+        sp = mesh.shape[SEQ_AXIS] > 1
+        attn_fn = make_sp_attention(mesh, "ring") if sp else None
 
         def loss_fn(params, tokens):
             return next_token_loss(
@@ -346,10 +372,16 @@ def make_train_step(
                 tokens,
                 cfg,
                 remat=remat,
-                shard_activations=shard_activations and mesh.shape[SEQ_AXIS] > 1,
+                shard_activations=shard_activations and sp,
+                attn_fn=attn_fn,
             )
 
     else:
+        assert mesh.shape[SEQ_AXIS] == 1, (
+            "sequence parallelism is not composed with the pipeline path "
+            "yet: pipe>1 with seq>1 would fall back to dense attention "
+            "over the gathered sequence (O(S^2) memory)"
+        )
         from ..parallel.pipeline import make_pipelined_apply
 
         blk = functools.partial(block, cfg)

@@ -148,7 +148,8 @@ class GroupByOp(OpDef):
 
     def infer(self, in_specs, attrs):
         x, probs = in_specs
-        N, D = x.shape
+        D = x.shape[-1]
+        N = x.num_elements // D                 # leading dims flatten
         E = probs.shape[-1]
         C = _capacity(N, E, attrs["k"], attrs.get("capacity_factor", 1.25))
         return [
@@ -159,6 +160,8 @@ class GroupByOp(OpDef):
 
     def forward(self, weights, inputs, attrs, ctx):
         x, probs = inputs
+        x = x.reshape(-1, x.shape[-1])          # accept (B, S, D) tokens
+        probs = probs.reshape(-1, probs.shape[-1])
         N, D = x.shape
         E = probs.shape[-1]
         C = _capacity(N, E, attrs["k"], attrs.get("capacity_factor", 1.25))
@@ -277,7 +280,8 @@ class MoEOp(OpDef):
         N = x.num_elements // D
         E, F = attrs["num_experts"], attrs["expert_hidden"]
         C = _capacity(N, E, attrs["top_k"], attrs.get("capacity_factor", 1.25))
-        return 2 * N * D * E + 4 * E * C * D * F
+        # gate + dispatch einsum + expert GEMMs + combine einsum
+        return 2 * N * D * E + 4 * N * D * E * C + 4 * E * C * D * F
 
 
 @register
@@ -307,6 +311,10 @@ class ExpertsOp(OpDef):
 
     def forward(self, weights, inputs, attrs, ctx):
         x, idx, gates = inputs
+        orig_shape = x.shape
+        x = x.reshape(-1, x.shape[-1])          # accept (B, S, D) tokens
+        idx = idx.reshape(-1, idx.shape[-1])
+        gates = gates.reshape(-1, gates.shape[-1])
         N, D = x.shape
         E, K = attrs["num_experts"], attrs["top_k"]
         C = _capacity(N, E, K, attrs.get("capacity_factor", 2.0))
