@@ -17,6 +17,7 @@ from .core import (
 from .model import FFModel, Tensor, TRAINING, INFERENCE
 from .optimizers import SGDOptimizer, AdamOptimizer
 from . import losses, metrics, initializers
+from . import keras, frontends  # noqa: F401  (import frontends)
 
 __version__ = "0.1.0"
 
@@ -38,4 +39,6 @@ __all__ = [
     "losses",
     "metrics",
     "initializers",
+    "keras",
+    "frontends",
 ]

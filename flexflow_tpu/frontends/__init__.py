@@ -1,0 +1,28 @@
+"""Model-import frontends.
+
+The reference ships three import paths into FFModel (SURVEY.md §2.4):
+a torch.fx tracer (reference ``python/flexflow/torch/model.py:2408``),
+an ONNX graph translator (``python/flexflow/onnx/model.py``), and a
+near-complete Keras clone (``python/flexflow/keras/``). The TPU
+equivalents map onto the same FFModel layer-builder API; weights
+convert to the framework's per-op pytrees so imported models are
+immediately trainable/servable on the mesh.
+"""
+def load_imported_weights(ffmodel) -> None:
+    """Overwrite compiled params with frontend-converted weights stored
+    on ``ffmodel._imported_params`` (shared by all importers)."""
+    import jax.numpy as jnp
+
+    assert ffmodel.params is not None, "compile() the model first"
+    for name, w in getattr(ffmodel, "_imported_params", {}).items():
+        if name in ffmodel.params:
+            ffmodel.params[name] = {
+                k: jnp.asarray(v, ffmodel.params[name][k].dtype)
+                for k, v in w.items()
+            }
+
+
+from .torch_fx import PyTorchModel
+from .onnx_model import ONNXModel
+
+__all__ = ["PyTorchModel", "ONNXModel", "load_imported_weights"]

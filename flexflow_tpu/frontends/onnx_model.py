@@ -1,0 +1,227 @@
+"""ONNX → FFModel importer.
+
+TPU-native counterpart of the reference's ONNX frontend (reference
+``python/flexflow/onnx/model.py:1-375``: per-node ``handleX`` methods
+emitting FFModel layer calls). Same per-op translation-table shape.
+Initializers (weights) convert into the framework's per-op pytrees.
+
+``onnx`` isn't a baked-in dependency; the importer accepts any object
+with the ONNX ModelProto interface (``graph.node``, ``graph.initializer``)
+— in tests a lightweight stand-in is used when the real package is
+missing.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _attr_map(node) -> Dict[str, Any]:
+    out = {}
+    for a in node.attribute:
+        # minimal AttributeProto decoding: ints, floats, int-lists
+        if a.type == 2:     # INT
+            out[a.name] = a.i
+        elif a.type == 1:   # FLOAT
+            out[a.name] = a.f
+        elif a.type == 7:   # INTS
+            out[a.name] = list(a.ints)
+        elif a.type == 6:   # FLOATS
+            out[a.name] = list(a.floats)
+        elif a.type == 3:   # STRING
+            out[a.name] = a.s.decode() if isinstance(a.s, bytes) else a.s
+    return out
+
+
+def _tensor_to_np(t) -> np.ndarray:
+    try:
+        from onnx import numpy_helper
+
+        return numpy_helper.to_array(t)
+    except ImportError:
+        # minimal decode: raw_data + dims + TensorProto data_type
+        dtypes = {1: np.float32, 6: np.int32, 7: np.int64, 11: np.float64,
+                  10: np.float16, 9: np.bool_}
+        dt = dtypes.get(getattr(t, "data_type", 1), np.float32)
+        return np.frombuffer(t.raw_data, dtype=dt).reshape(tuple(t.dims))
+
+
+class ONNXModel:
+    """``ONNXModel(model_proto_or_path).to_ff(ffmodel, inputs)`` replays
+    the ONNX graph as FFModel layers (reference ``ONNXModel.apply``)."""
+
+    def __init__(self, model: Any):
+        if isinstance(model, (str, bytes)):
+            import onnx
+
+            model = onnx.load(model)
+        self.model = model
+        self.initializers: Dict[str, np.ndarray] = {
+            t.name: _tensor_to_np(t) for t in model.graph.initializer
+        }
+
+    def to_ff(self, ffmodel, input_tensors: Sequence[Any]) -> List[Any]:
+        env: Dict[str, Any] = {}
+        graph_inputs = [
+            i for i in self.model.graph.input
+            if i.name not in self.initializers
+        ]
+        assert len(graph_inputs) == len(input_tensors)
+        for gi, t in zip(graph_inputs, input_tensors):
+            env[gi.name] = t
+        self._weights: Dict[str, Dict[str, np.ndarray]] = {}
+
+        for node in self.model.graph.node:
+            handler = getattr(self, f"_op_{node.op_type.lower()}", None)
+            if handler is None:
+                raise NotImplementedError(f"ONNX op {node.op_type}")
+            outs = handler(ffmodel, node, env)
+            if not isinstance(outs, (tuple, list)):
+                outs = [outs]
+            for name, val in zip(node.output, outs):
+                env[name] = val
+
+        ffmodel._imported_params = getattr(ffmodel, "_imported_params", {})
+        ffmodel._imported_params.update(self._weights)
+        return [env[o.name] for o in self.model.graph.output]
+
+    def load_weights(self, ffmodel) -> None:
+        from . import load_imported_weights
+
+        load_imported_weights(ffmodel)
+
+    # ------------------------------------------------------------------
+    # per-op handlers (reference handleX methods)
+
+    def _name(self, node):
+        return node.name or node.output[0]
+
+    def _op_gemm(self, ff, node, env):
+        x = env[node.input[0]]
+        w = self.initializers[node.input[1]]
+        attrs = _attr_map(node)
+        if attrs.get("transA", 0) or attrs.get("alpha", 1.0) != 1.0 or \
+                attrs.get("beta", 1.0) not in (0.0, 1.0):
+            raise NotImplementedError(
+                f"Gemm with transA/alpha/beta != defaults: {attrs}"
+            )
+        if attrs.get("transB", 0):
+            w = w.T
+        out_dim = w.shape[1]
+        use_bias = len(node.input) > 2
+        name = self._name(node)
+        out = ff.dense(x, out_dim, use_bias=use_bias, name=name)
+        weights = {"kernel": w}
+        if use_bias:
+            weights["bias"] = self.initializers[node.input[2]]
+        self._weights[name] = weights
+        return out
+
+    def _op_matmul(self, ff, node, env):
+        if node.input[1] in self.initializers:
+            w = self.initializers[node.input[1]]
+            name = self._name(node)
+            out = ff.dense(env[node.input[0]], w.shape[1], use_bias=False,
+                           name=name)
+            self._weights[name] = {"kernel": w}
+            return out
+        return ff.batch_matmul(env[node.input[0]], env[node.input[1]],
+                               name=self._name(node))
+
+    def _op_conv(self, ff, node, env):
+        x = env[node.input[0]]
+        w = self.initializers[node.input[1]]  # OIHW
+        attrs = _attr_map(node)
+        strides = attrs.get("strides", [1, 1])
+        pads = attrs.get("pads", [0, 0, 0, 0])
+        groups = attrs.get("group", 1)
+        name = self._name(node)
+        out = ff.conv2d(
+            x, w.shape[0], w.shape[2], w.shape[3],
+            strides[0], strides[1], pads[0], pads[1],
+            groups=groups, use_bias=len(node.input) > 2, name=name,
+        )
+        weights = {"kernel": w}  # framework conv kernels are OIHW
+        if len(node.input) > 2:
+            weights["bias"] = self.initializers[node.input[2]]
+        self._weights[name] = weights
+        return out
+
+    def _op_maxpool(self, ff, node, env):
+        a = _attr_map(node)
+        k = a["kernel_shape"]; s = a.get("strides", k); p = a.get("pads", [0]*4)
+        return ff.pool2d(env[node.input[0]], k[0], k[1], s[0], s[1], p[0], p[1],
+                         pool_type="max", name=self._name(node))
+
+    def _op_averagepool(self, ff, node, env):
+        a = _attr_map(node)
+        k = a["kernel_shape"]; s = a.get("strides", k); p = a.get("pads", [0]*4)
+        if any(p) and not a.get("count_include_pad", 0):
+            # our avg pool divides by kh*kw including padded cells
+            raise NotImplementedError(
+                "AveragePool with pads and count_include_pad=0"
+            )
+        return ff.pool2d(env[node.input[0]], k[0], k[1], s[0], s[1], p[0], p[1],
+                         pool_type="avg", name=self._name(node))
+
+    def _op_relu(self, ff, node, env):
+        return ff.relu(env[node.input[0]], name=self._name(node))
+
+    def _op_sigmoid(self, ff, node, env):
+        return ff.sigmoid(env[node.input[0]], name=self._name(node))
+
+    def _op_tanh(self, ff, node, env):
+        return ff.tanh(env[node.input[0]], name=self._name(node))
+
+    def _op_softmax(self, ff, node, env):
+        axis = _attr_map(node).get("axis", -1)
+        return ff.softmax(env[node.input[0]], axis=axis, name=self._name(node))
+
+    def _op_flatten(self, ff, node, env):
+        return ff.flat(env[node.input[0]], name=self._name(node))
+
+    def _op_add(self, ff, node, env):
+        return ff.add(env[node.input[0]], env[node.input[1]],
+                      name=self._name(node))
+
+    def _op_mul(self, ff, node, env):
+        return ff.multiply(env[node.input[0]], env[node.input[1]],
+                           name=self._name(node))
+
+    def _op_sub(self, ff, node, env):
+        return ff.subtract(env[node.input[0]], env[node.input[1]],
+                           name=self._name(node))
+
+    def _op_concat(self, ff, node, env):
+        axis = _attr_map(node).get("axis", 0)
+        return ff.concat([env[i] for i in node.input], axis=axis,
+                         name=self._name(node))
+
+    def _op_dropout(self, ff, node, env):
+        return ff.dropout(env[node.input[0]],
+                          rate=_attr_map(node).get("ratio", 0.5),
+                          name=self._name(node))
+
+    def _op_reshape(self, ff, node, env):
+        shape = self.initializers[node.input[1]].astype(int).tolist()
+        x = env[node.input[0]]
+        total = 1
+        for d in x.shape:
+            total *= d
+        # ONNX: 0 copies the input dim, -1 infers (at most one)
+        shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+        if -1 in shape:
+            known = 1
+            for s in shape:
+                if s != -1:
+                    known *= s
+            shape[shape.index(-1)] = total // known
+        return ff.reshape(x, tuple(shape), name=self._name(node))
+
+    def _op_transpose(self, ff, node, env):
+        perm = _attr_map(node)["perm"]
+        return ff.transpose(env[node.input[0]], perm, name=self._name(node))
+
+    def _op_identity(self, ff, node, env):
+        return env[node.input[0]]

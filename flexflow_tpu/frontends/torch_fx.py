@@ -1,0 +1,232 @@
+"""PyTorch → FFModel importer via torch.fx symbolic tracing.
+
+TPU-native counterpart of the reference's fx frontend (reference
+``python/flexflow/torch/model.py:1-2607``: ``PyTorchModel.torch_to_ff``
+walks a symbolically-traced graph and emits one FFModel layer call per
+fx node). Same architecture here: trace → per-node translation table →
+FFModel builder calls; weights are converted from the module's
+state_dict into the framework's per-op pytrees (HF linear layout
+transposed to (in, out)).
+
+Only imported when torch is available; the rest of the framework has no
+torch dependency.
+"""
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class PyTorchModel:
+    """Wraps a ``torch.nn.Module``; ``to_ff(ffmodel, input_tensors)``
+    replays its fx graph as FFModel layers and returns the outputs
+    (reference ``PyTorchModel.torch_to_ff``)."""
+
+    def __init__(self, module, batch_size: Optional[int] = None):
+        import torch.fx
+
+        self.module = module.eval()
+        self.graph_module = torch.fx.symbolic_trace(module)
+        self.batch_size = batch_size
+
+    # ------------------------------------------------------------------
+
+    def to_ff(self, ffmodel, input_tensors: Sequence[Any]) -> List[Any]:
+        """Translate the traced graph into ``ffmodel`` layer calls.
+        ``input_tensors`` are FFModel Tensors (one per fx placeholder,
+        in order). Returns the list of output Tensors; converted weights
+        are stored on ``ffmodel._imported_params`` keyed by node name so
+        ``compile()``-initialised params can be overwritten via
+        :meth:`load_weights`."""
+        import torch
+
+        env: Dict[str, Any] = {}
+        placeholders = [
+            n for n in self.graph_module.graph.nodes if n.op == "placeholder"
+        ]
+        assert len(placeholders) == len(input_tensors), (
+            f"model takes {len(placeholders)} inputs, got {len(input_tensors)}"
+        )
+        for node, t in zip(placeholders, input_tensors):
+            env[node.name] = t
+
+        self._weights: Dict[str, Dict[str, np.ndarray]] = {}
+        outputs: List[Any] = []
+
+        for node in self.graph_module.graph.nodes:
+            if node.op == "placeholder":
+                continue
+            if node.op == "output":
+                args = node.args[0]
+                outputs = list(args) if isinstance(args, (tuple, list)) else [args]
+                outputs = [env[a.name] for a in outputs]
+                continue
+            if node.op == "call_module":
+                mod = self.graph_module.get_submodule(node.target)
+                env[node.name] = self._module_node(ffmodel, node, mod, env)
+            elif node.op in ("call_function", "call_method"):
+                env[node.name] = self._function_node(ffmodel, node, env)
+            elif node.op == "get_attr":
+                raise NotImplementedError(
+                    f"get_attr nodes (free parameters) unsupported: {node.target}"
+                )
+        ffmodel._imported_params = getattr(ffmodel, "_imported_params", {})
+        ffmodel._imported_params.update(self._weights)
+        return outputs
+
+    def load_weights(self, ffmodel) -> None:
+        """Overwrite ``ffmodel.params`` entries with the converted torch
+        weights (call after ``compile()``)."""
+        from . import load_imported_weights
+
+        load_imported_weights(ffmodel)
+
+    # ------------------------------------------------------------------
+
+    def _arg(self, env, a):
+        import torch.fx
+
+        if isinstance(a, torch.fx.Node):
+            return env[a.name]
+        return a
+
+    def _module_node(self, ff, node, mod, env):
+        import torch.nn as nn
+
+        x = self._arg(env, node.args[0])
+        name = node.name
+
+        if isinstance(mod, nn.Linear):
+            out = ff.dense(x, mod.out_features, use_bias=mod.bias is not None,
+                           name=name)
+            w = {"kernel": mod.weight.detach().numpy().T}
+            if mod.bias is not None:
+                w["bias"] = mod.bias.detach().numpy()
+            self._weights[name] = w
+            return out
+        if isinstance(mod, nn.Conv2d):
+            out = ff.conv2d(
+                x, mod.out_channels, mod.kernel_size[0], mod.kernel_size[1],
+                mod.stride[0], mod.stride[1], mod.padding[0], mod.padding[1],
+                groups=mod.groups, use_bias=mod.bias is not None, name=name,
+            )
+            # framework conv kernels are OIHW like torch
+            w = {"kernel": mod.weight.detach().numpy()}
+            if mod.bias is not None:
+                w["bias"] = mod.bias.detach().numpy()
+            self._weights[name] = w
+            return out
+        if isinstance(mod, nn.Embedding):
+            out = ff.embedding(x, mod.num_embeddings, mod.embedding_dim, name=name)
+            self._weights[name] = {"table": mod.weight.detach().numpy()}
+            return out
+        if isinstance(mod, nn.LayerNorm):
+            out = ff.layer_norm(x, eps=mod.eps,
+                                elementwise_affine=mod.elementwise_affine,
+                                name=name)
+            if mod.elementwise_affine:
+                self._weights[name] = {
+                    "gamma": mod.weight.detach().numpy(),
+                    "beta": mod.bias.detach().numpy(),
+                }
+            return out
+        if isinstance(mod, nn.BatchNorm2d):
+            return ff.batch_norm(x, relu=False, name=name)
+        if isinstance(mod, nn.MaxPool2d):
+            kh, kw = self._pair(mod.kernel_size)
+            sh, sw = self._pair(mod.stride or mod.kernel_size)
+            ph, pw = self._pair(mod.padding)
+            return ff.pool2d(x, kh, kw, sh, sw, ph, pw, pool_type="max", name=name)
+        if isinstance(mod, nn.AvgPool2d):
+            kh, kw = self._pair(mod.kernel_size)
+            sh, sw = self._pair(mod.stride or mod.kernel_size)
+            ph, pw = self._pair(mod.padding)
+            return ff.pool2d(x, kh, kw, sh, sw, ph, pw, pool_type="avg", name=name)
+        if isinstance(mod, nn.ReLU):
+            return ff.relu(x, name=name)
+        if isinstance(mod, nn.GELU):
+            return ff.gelu(x, name=name)
+        if isinstance(mod, nn.Sigmoid):
+            return ff.sigmoid(x, name=name)
+        if isinstance(mod, nn.Tanh):
+            return ff.tanh(x, name=name)
+        if isinstance(mod, nn.Softmax):
+            return ff.softmax(x, axis=mod.dim if mod.dim is not None else -1,
+                              name=name)
+        if isinstance(mod, nn.Dropout):
+            return ff.dropout(x, rate=mod.p, name=name)
+        if isinstance(mod, nn.Flatten):
+            return ff.flat(x, name=name)
+        if isinstance(mod, nn.Identity):
+            return x
+        raise NotImplementedError(f"fx module {type(mod).__name__} ({node.target})")
+
+    @staticmethod
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else (v[0], v[1])
+
+    def _function_node(self, ff, node, env):
+        import torch
+        import torch.nn.functional as F
+
+        args = [self._arg(env, a) for a in node.args]
+        kwargs = {k: self._arg(env, v) for k, v in node.kwargs.items()}
+        t = node.target
+        name = node.name
+
+        if t in (operator.add, torch.add, "add"):
+            if hasattr(args[1], "ref"):
+                return ff.add(args[0], args[1], name=name)
+            return ff.scalar_add(args[0], float(args[1]), name=name)
+        if t in (operator.mul, torch.mul, "mul"):
+            if hasattr(args[1], "ref"):
+                return ff.multiply(args[0], args[1], name=name)
+            return ff.scalar_multiply(args[0], float(args[1]), name=name)
+        if t in (operator.sub, torch.sub, "sub"):
+            if hasattr(args[1], "ref"):
+                return ff.subtract(args[0], args[1], name=name)
+            return ff.scalar_sub(args[0], float(args[1]), name=name)
+        if t in (operator.truediv, torch.div, "div"):
+            return ff.scalar_truediv(args[0], float(args[1]), name=name)
+        if t in (F.relu, torch.relu, "relu"):
+            return ff.relu(args[0], name=name)
+        if t in (F.gelu, "gelu"):
+            return ff.gelu(args[0], name=name)
+        if t in (torch.sigmoid, F.sigmoid, "sigmoid"):
+            return ff.sigmoid(args[0], name=name)
+        if t in (torch.tanh, F.tanh, "tanh"):
+            return ff.tanh(args[0], name=name)
+        if t in (F.softmax, torch.softmax, "softmax"):
+            axis = kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            return ff.softmax(args[0], axis=axis if axis is not None else -1,
+                              name=name)
+        if t in (torch.flatten, "flatten"):
+            return ff.flat(args[0], name=name)
+        if t in (torch.cat, "cat"):
+            tensors = args[0]
+            axis = kwargs.get("dim", args[1] if len(args) > 1 else 0)
+            return ff.concat(tensors, axis=axis, name=name)
+        if t in (torch.reshape, "reshape", "view"):
+            shape = args[1] if isinstance(args[1], (tuple, list)) else args[1:]
+            shape = tuple(int(s) for s in shape)
+            if shape[0] == -1 and self.batch_size is not None:
+                shape = (self.batch_size,) + shape[1:]
+            return ff.reshape(args[0], shape, name=name)
+        if t in (torch.transpose, "transpose"):
+            x = args[0]
+            d0, d1 = int(args[1]), int(args[2])
+            ndim = len(x.shape)
+            perm = list(range(ndim))
+            perm[d0], perm[d1] = perm[d1], perm[d0]
+            return ff.transpose(x, perm, name=name)
+        if t in (torch.exp, "exp"):
+            return ff.exp(args[0], name=name)
+        if t in (torch.pow, operator.pow, "pow"):
+            return ff.pow(args[0], float(args[1]), name=name)
+        if t == "contiguous" or t is torch.clone:
+            return args[0]
+        if t in (F.dropout, "dropout"):
+            return ff.dropout(args[0], rate=kwargs.get("p", 0.5), name=name)
+        raise NotImplementedError(f"fx function/method {t} unsupported")

@@ -1,0 +1,34 @@
+"""Keras-style frontend.
+
+Compact functional equivalent of the reference's tf.keras clone
+(reference ``python/flexflow/keras/``: Sequential/Functional ``Model``,
+layer classes, optimizers, datasets glue — ~35 files). Layers are thin
+config records; ``Model``/``Sequential`` lower the symbolic layer graph
+onto :class:`flexflow_tpu.FFModel` builder calls, and ``compile/fit/
+evaluate/predict`` delegate to the FFModel training loop, so every
+Keras-built net inherits the mesh/sharding machinery for free.
+"""
+from .layers import (
+    Activation,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    LayerNormalization,
+    MaxPooling2D,
+)
+from .models import Model, Sequential
+from .optimizers import SGD, Adam
+
+__all__ = [
+    "Input", "Dense", "Conv2D", "MaxPooling2D", "AveragePooling2D",
+    "Flatten", "Dropout", "Activation", "Embedding", "Concatenate", "Add",
+    "BatchNormalization", "LayerNormalization",
+    "Model", "Sequential", "SGD", "Adam",
+]

@@ -1,0 +1,237 @@
+"""Keras-style layer records (reference ``python/flexflow/keras/layers/``).
+
+Each layer is a config object; calling it on a :class:`KTensor` records
+an edge in the symbolic graph. ``emit(ff, inputs)`` lowers onto FFModel
+builders at Model-build time.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Optional, Sequence, Tuple
+
+_counter = itertools.count()
+
+
+class KTensor:
+    """Symbolic tensor in the Keras graph (pre-FFModel)."""
+
+    __slots__ = ("layer", "inputs", "shape", "name", "dtype")
+
+    def __init__(self, layer, inputs, shape, name):
+        self.layer = layer          # producing Layer or None for Input
+        self.inputs = inputs        # list[KTensor]
+        self.shape = tuple(shape)
+        self.name = name
+        self.dtype = "float32"
+
+
+def Input(shape: Sequence[int], batch_size: Optional[int] = None,
+          dtype="float32", name: str = ""):
+    """Placeholder (reference keras ``Input``): ``shape`` excludes the
+    batch dim, matching tf.keras."""
+    name = name or f"input_{next(_counter)}"
+    full = (batch_size or 0,) + tuple(shape)
+    t = KTensor(None, [], full, name)
+    t.dtype = dtype
+    return t
+
+
+class Layer:
+    n_inputs = 1
+
+    def __init__(self, name: str = ""):
+        self.name = name or f"{type(self).__name__.lower()}_{next(_counter)}"
+
+    def __call__(self, x):
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        return KTensor(self, xs, self.output_shape([t.shape for t in xs]), self.name)
+
+    def output_shape(self, in_shapes: List[Tuple[int, ...]]):
+        return in_shapes[0]
+
+    def emit(self, ff, inputs):
+        raise NotImplementedError
+
+
+class Dense(Layer):
+    def __init__(self, units: int, activation: Optional[str] = None,
+                 use_bias: bool = True, name: str = ""):
+        super().__init__(name)
+        self.units, self.activation, self.use_bias = units, activation, use_bias
+
+    def output_shape(self, s):
+        return s[0][:-1] + (self.units,)
+
+    def emit(self, ff, inputs):
+        return ff.dense(inputs[0], self.units, activation=self.activation,
+                        use_bias=self.use_bias, name=self.name)
+
+
+class Conv2D(Layer):
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding="valid", activation: Optional[str] = None,
+                 use_bias: bool = True, groups: int = 1, name: str = ""):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel = (kernel_size,) * 2 if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.strides = (strides,) * 2 if isinstance(strides, int) else tuple(strides)
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = use_bias
+        self.groups = groups
+
+    def _pads(self):
+        if self.padding == "same":
+            if self.kernel[0] % 2 == 0 or self.kernel[1] % 2 == 0:
+                raise NotImplementedError(
+                    "padding='same' with even kernels needs asymmetric "
+                    "padding (TF semantics); use odd kernels or 'valid'"
+                )
+            return self.kernel[0] // 2, self.kernel[1] // 2
+        return 0, 0
+
+    def output_shape(self, s):
+        (b, c, h, w) = s[0]
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.kernel[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.kernel[1]) // self.strides[1] + 1
+        return (b, self.filters, oh, ow)
+
+    def emit(self, ff, inputs):
+        ph, pw = self._pads()
+        return ff.conv2d(inputs[0], self.filters, self.kernel[0], self.kernel[1],
+                         self.strides[0], self.strides[1], ph, pw,
+                         activation=self.activation, groups=self.groups,
+                         use_bias=self.use_bias, name=self.name)
+
+
+class _Pool2D(Layer):
+    kind = "max"
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
+                 name: str = ""):
+        super().__init__(name)
+        self.pool = (pool_size,) * 2 if isinstance(pool_size, int) else tuple(pool_size)
+        strides = strides if strides is not None else self.pool
+        self.strides = (strides,) * 2 if isinstance(strides, int) else tuple(strides)
+        self.padding = padding
+
+    def _pads(self):
+        if self.padding != "same":
+            return 0, 0
+        if self.pool[0] % 2 == 0 or self.pool[1] % 2 == 0:
+            raise NotImplementedError(
+                "padding='same' with even pool sizes needs asymmetric "
+                "padding (TF semantics); use odd sizes or 'valid'"
+            )
+        return self.pool[0] // 2, self.pool[1] // 2
+
+    def output_shape(self, s):
+        (b, c, h, w) = s[0]
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.pool[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.pool[1]) // self.strides[1] + 1
+        return (b, c, oh, ow)
+
+    def emit(self, ff, inputs):
+        ph, pw = self._pads()
+        return ff.pool2d(inputs[0], self.pool[0], self.pool[1],
+                         self.strides[0], self.strides[1], ph, pw,
+                         pool_type=self.kind, name=self.name)
+
+
+class MaxPooling2D(_Pool2D):
+    kind = "max"
+
+
+class AveragePooling2D(_Pool2D):
+    kind = "avg"
+
+
+class Flatten(Layer):
+    def output_shape(self, s):
+        b = s[0][0]
+        n = 1
+        for d in s[0][1:]:
+            n *= d
+        return (b, n)
+
+    def emit(self, ff, inputs):
+        return ff.flat(inputs[0], name=self.name)
+
+
+class Dropout(Layer):
+    def __init__(self, rate: float, name: str = ""):
+        super().__init__(name)
+        self.rate = rate
+
+    def emit(self, ff, inputs):
+        return ff.dropout(inputs[0], rate=self.rate, name=self.name)
+
+
+class Activation(Layer):
+    def __init__(self, activation: str, name: str = ""):
+        super().__init__(name)
+        self.activation = activation
+
+    def emit(self, ff, inputs):
+        if self.activation == "softmax":
+            return ff.softmax(inputs[0], name=self.name)
+        return getattr(ff, self.activation)(inputs[0], name=self.name)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int, name: str = ""):
+        super().__init__(name)
+        self.input_dim, self.output_dim = input_dim, output_dim
+
+    def output_shape(self, s):
+        return s[0] + (self.output_dim,)
+
+    def emit(self, ff, inputs):
+        return ff.embedding(inputs[0], self.input_dim, self.output_dim,
+                            name=self.name)
+
+
+class Concatenate(Layer):
+    n_inputs = None
+
+    def __init__(self, axis: int = -1, name: str = ""):
+        super().__init__(name)
+        self.axis = axis
+
+    def output_shape(self, s):
+        ax = self.axis if self.axis >= 0 else len(s[0]) + self.axis
+        out = list(s[0])
+        out[ax] = sum(shape[ax] for shape in s)
+        return tuple(out)
+
+    def emit(self, ff, inputs):
+        return ff.concat(list(inputs), axis=self.axis, name=self.name)
+
+
+class Add(Layer):
+    n_inputs = None
+
+    def emit(self, ff, inputs):
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = ff.add(out, t, name=self.name)
+        return out
+
+
+class BatchNormalization(Layer):
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+
+    def emit(self, ff, inputs):
+        return ff.batch_norm(inputs[0], relu=False, name=self.name)
+
+
+class LayerNormalization(Layer):
+    def __init__(self, epsilon: float = 1e-5, name: str = ""):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def emit(self, ff, inputs):
+        return ff.layer_norm(inputs[0], eps=self.epsilon, name=self.name)

@@ -1,0 +1,122 @@
+"""Keras-style Model / Sequential (reference
+``python/flexflow/keras/models/``): lower the symbolic layer graph onto
+an FFModel, then delegate compile/fit/evaluate/predict."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import FFConfig
+from ..model import FFModel
+from .layers import Input, KTensor, Layer
+
+
+_LOSS_MAP = {
+    "sparse_categorical_crossentropy": "sparse_categorical_crossentropy",
+    "categorical_crossentropy": "categorical_crossentropy",
+    "mse": "mean_squared_error",
+    "mean_squared_error": "mean_squared_error",
+}
+
+
+class Model:
+    """Functional model: ``Model(inputs, outputs)`` (reference keras
+    ``Model``). The KTensor graph is topologically lowered to FFModel
+    builder calls at construction."""
+
+    def __init__(self, inputs, outputs, batch_size: int = 64,
+                 config: Optional[FFConfig] = None, name: str = "model"):
+        self.inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        self.outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+        assert len(self.outputs) == 1, "single-output models supported"
+        self.name = name
+        self.config = config or FFConfig(batch_size=batch_size)
+        self.batch_size = self.config.batch_size
+        self.ffmodel = FFModel(self.config)
+        self._lower()
+
+    def _lower(self):
+        ff = self.ffmodel
+        env: Dict[int, Any] = {}
+        for kt in self.inputs:
+            shape = (self.batch_size,) + tuple(kt.shape[1:])
+            dtype = "int32" if getattr(kt, "dtype", "float32") in ("int32", "int64") else "float32"
+            env[id(kt)] = ff.create_tensor(shape, dtype=dtype, name=kt.name)
+
+        def visit(kt: KTensor):
+            if id(kt) in env:
+                return env[id(kt)]
+            ins = [visit(t) for t in kt.inputs]
+            env[id(kt)] = kt.layer.emit(ff, ins)
+            return env[id(kt)]
+
+        self._ff_output = visit(self.outputs[0])
+
+    # ------------------------------------------------------------------
+
+    def compile(self, optimizer=None, loss="sparse_categorical_crossentropy",
+                metrics: Sequence[str] = ("accuracy",), **kw):
+        loss = _LOSS_MAP.get(loss, loss)
+        self.ffmodel.compile(optimizer=optimizer, loss_type=loss,
+                             metrics=metrics, output=self._ff_output, **kw)
+        return self
+
+    def fit(self, x, y, epochs: int = 1, batch_size: Optional[int] = None,
+            **kw):
+        self.config.epochs = epochs
+        return self.ffmodel.fit(np.asarray(x), np.asarray(y),
+                                batch_size=batch_size)
+
+    def evaluate(self, x, y, **kw):
+        return self.ffmodel.evaluate(np.asarray(x), np.asarray(y))
+
+    def predict(self, x, **kw):
+        return self.ffmodel.forward(np.asarray(x))
+
+    def summary(self) -> str:
+        lines = [f'Model "{self.name}"']
+        for node in self.ffmodel.graph.nodes:
+            lines.append(f"  {node.name:<24} {node.op_type:<16} "
+                         f"{[s.shape for s in node.out_specs]}")
+        return "\n".join(lines)
+
+
+class Sequential(Model):
+    """reference keras ``Sequential``: stack of layers; input shape comes
+    from an ``Input`` first element or ``input_shape`` on the first
+    layer call."""
+
+    def __init__(self, layers: Optional[Sequence[Union[KTensor, Layer]]] = None,
+                 batch_size: int = 64, config: Optional[FFConfig] = None,
+                 name: str = "sequential"):
+        self._layers: List[Layer] = []
+        self._input: Optional[KTensor] = None
+        self._pending = list(layers or [])
+        self._batch_size = batch_size
+        self._config = config
+        self._name = name
+        self._built = False
+        for item in self._pending:
+            self.add(item, _defer=True)
+
+    def add(self, item: Union[KTensor, Layer], _defer: bool = False):
+        if isinstance(item, KTensor):
+            assert item.layer is None, "first element must be an Input"
+            self._input = item
+        else:
+            self._layers.append(item)
+
+    def _build(self):
+        assert self._input is not None, "Sequential needs an Input first"
+        t = self._input
+        for layer in self._layers:
+            t = layer(t)
+        super().__init__(self._input, t, batch_size=self._batch_size,
+                         config=self._config, name=self._name)
+        self._built = True
+
+    def compile(self, *a, **kw):
+        if not self._built:
+            self._build()
+        return super().compile(*a, **kw)

@@ -1,0 +1,18 @@
+"""Keras-style optimizer shims (reference
+``python/flexflow/keras/optimizers.py``) mapping onto the framework
+optimizers."""
+from __future__ import annotations
+
+from ..optimizers import AdamOptimizer, SGDOptimizer
+
+
+def SGD(learning_rate: float = 0.01, momentum: float = 0.0,
+        nesterov: bool = False, weight_decay: float = 0.0):
+    return SGDOptimizer(lr=learning_rate, momentum=momentum,
+                        nesterov=nesterov, weight_decay=weight_decay)
+
+
+def Adam(learning_rate: float = 0.001, beta_1: float = 0.9,
+         beta_2: float = 0.999, epsilon: float = 1e-8):
+    return AdamOptimizer(lr=learning_rate, beta1=beta_1, beta2=beta_2,
+                         epsilon=epsilon)

@@ -484,11 +484,15 @@ def serve_attention(cfg: LLaMAConfig, q, k_cache, v_cache, mask):
     return out.reshape(R, C, H * dk)
 
 
-def serve_block(cfg: LLaMAConfig, p, x, cos, sin, mask, k_cache, v_cache, positions):
+def serve_block(cfg: LLaMAConfig, p, x, cos, sin, mask, k_cache, v_cache,
+                positions, kernels: str = "xla"):
     """One transformer block on a serving step: project, RoPE, scatter new
     K/V into the cache at ``positions`` (cache line indices — for tree
     tokens these differ from the RoPE positions baked into cos/sin),
-    attend over the whole cache."""
+    attend over the whole cache. ``kernels="pallas"`` routes attention
+    through the fused flash-style TPU kernels (serve/kernels.py: decode
+    for C==1, tree-verify otherwise — the reference's
+    inc/tree_inc_multihead_self_attention CUDA kernels)."""
     R, C, D = x.shape
     H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     h = _rms(x, p["attn_norm"], cfg.rms_norm_eps)
@@ -500,7 +504,18 @@ def serve_block(cfg: LLaMAConfig, p, x, cos, sin, mask, k_cache, v_cache, positi
     bidx = jnp.arange(R)[:, None]
     k_cache = k_cache.at[bidx, positions].set(k.astype(k_cache.dtype))
     v_cache = v_cache.at[bidx, positions].set(v.astype(v_cache.dtype))
-    attn = serve_attention(cfg, q, k_cache, v_cache, mask)
+    if kernels == "pallas":
+        from ..serve import kernels as _pk
+
+        if C == 1:
+            seq_lens = mask[:, 0, :].sum(axis=-1).astype(jnp.int32)
+            attn = _pk.decode_attention(q[:, 0], k_cache, v_cache, seq_lens)
+            attn = attn.reshape(R, 1, H * dk)
+        else:
+            attn = _pk.verify_attention(q, k_cache, v_cache, mask)
+            attn = attn.reshape(R, C, H * dk)
+    else:
+        attn = serve_attention(cfg, q, k_cache, v_cache, mask)
     x = x + _mm(attn, p["wo"])
     h2 = _rms(x, p["ffn_norm"], cfg.rms_norm_eps)
     ffn = _mm(jax.nn.silu(_mm(h2, p["w1"])) * _mm(h2, p["w3"]), p["w2"])
@@ -518,6 +533,7 @@ def serve_step(
     *,
     cfg: LLaMAConfig,
     all_logits: bool = False,
+    kernels: str = "xla",
 ):
     """One serving step over R request slots × C tokens each.
 
@@ -546,7 +562,7 @@ def serve_step(
     def scan_body(h, xs):
         p_l, kc, vc = xs
         h, kc, vc = serve_block(
-            cfg, p_l, h, cos, sin, mask, kc, vc, cache_positions
+            cfg, p_l, h, cos, sin, mask, kc, vc, cache_positions, kernels
         )
         return h, (kc, vc)
 

@@ -33,6 +33,9 @@ class ServingConfig:
     prefill_chunk: int = 128
     max_spec_tree_tokens: int = 64
     cache_dtype: Any = jnp.bfloat16
+    # "xla" (default) or "pallas" — fused decode/tree-verify attention
+    # kernels (serve/kernels.py) for models that support the kwarg.
+    kernels: str = "xla"
 
     @property
     def cache_len(self) -> int:
@@ -108,9 +111,10 @@ class InferenceEngine:
         cached like Legion's replayed traces."""
         key = (chunk, all_logits, with_mask)
         if key not in self._steps:
-            fn = functools.partial(
-                self.model.serve_step, cfg=self.cfg, all_logits=all_logits
-            )
+            kw = dict(cfg=self.cfg, all_logits=all_logits)
+            if self.serving.kernels != "xla":
+                kw["kernels"] = self.serving.kernels
+            fn = functools.partial(self.model.serve_step, **kw)
 
             def step(params, cache, tokens, positions, logits_idx, mask, cpos):
                 return fn(params, cache, tokens, positions, logits_idx, mask, cpos)

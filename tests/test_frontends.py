@@ -1,0 +1,190 @@
+"""Frontend import tests — the analog of the reference's frontend suites
+(``examples/python/{keras,pytorch,onnx}`` + ``tests/align``): torch.fx
+imports must reproduce torch's forward numerics with converted weights;
+the Keras API must train end-to-end; the ONNX translator must build the
+right graph."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import flexflow_tpu as ff
+
+torch = pytest.importorskip("torch")
+
+
+def _blobs(n=128, d=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = (rng.normal(size=(n, d)) + np.repeat(np.eye(classes, d) * 4,
+                                             n // classes, 0)).astype(np.float32)
+    y = np.repeat(np.arange(classes), n // classes).astype(np.int32)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# torch.fx
+
+
+class TorchMLP(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(16, 32)
+        self.act = torch.nn.ReLU()
+        self.fc2 = torch.nn.Linear(32, 4)
+
+    def forward(self, x):
+        h = self.act(self.fc1(x))
+        return self.fc2(h) + 1.0
+
+
+def test_torch_fx_forward_matches_torch():
+    from flexflow_tpu.frontends import PyTorchModel
+
+    torch.manual_seed(0)
+    net = TorchMLP()
+    pt = PyTorchModel(net, batch_size=8)
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=1)
+    m = ff.FFModel(cfg)
+    x_t = m.create_tensor((8, 16), name="x")
+    (out,) = pt.to_ff(m, [x_t])
+    sm = m.softmax(out)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.01), output=sm)
+    pt.load_weights(m)
+
+    x = np.random.default_rng(1).normal(size=(8, 16)).astype(np.float32)
+    got = m.forward(x)
+    with torch.no_grad():
+        ref = torch.softmax(net(torch.from_numpy(x)), -1).numpy()
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-5)
+
+
+class TorchCNN(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = torch.nn.Conv2d(1, 4, 3, padding=1)
+        self.pool = torch.nn.MaxPool2d(2)
+        self.flat = torch.nn.Flatten()
+        self.fc = torch.nn.Linear(4 * 4 * 4, 3)
+
+    def forward(self, x):
+        return self.fc(self.flat(self.pool(torch.relu(self.conv(x)))))
+
+
+def test_torch_fx_cnn_matches_torch():
+    from flexflow_tpu.frontends import PyTorchModel
+
+    torch.manual_seed(1)
+    net = TorchCNN()
+    pt = PyTorchModel(net, batch_size=4)
+    cfg = ff.FFConfig(batch_size=4, num_devices=1)
+    m = ff.FFModel(cfg)
+    x_t = m.create_tensor((4, 1, 8, 8), name="x")
+    (out,) = pt.to_ff(m, [x_t])
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.01), output=out,
+              loss_type="mean_squared_error")
+    pt.load_weights(m)
+    x = np.random.default_rng(2).normal(size=(4, 1, 8, 8)).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Keras
+
+
+def test_keras_sequential_trains():
+    from flexflow_tpu import keras as K
+
+    x, y = _blobs()
+    model = K.Sequential([
+        K.Input((16,), name="x"),
+        K.Dense(32, activation="relu"),
+        K.Dropout(0.1),
+        K.Dense(4),
+        K.Activation("softmax"),
+    ], batch_size=32)
+    model.compile(optimizer=K.SGD(0.05), loss="sparse_categorical_crossentropy")
+    perf = model.fit(x, y, epochs=5)
+    assert perf.averages()["accuracy"] > 0.8
+    preds = model.predict(x[:32])
+    assert np.asarray(preds).shape == (32, 4)
+
+
+def test_keras_functional_graph():
+    from flexflow_tpu import keras as K
+
+    inp = K.Input((16,), name="x")
+    a = K.Dense(8, activation="relu")(inp)
+    b = K.Dense(8, activation="relu")(inp)
+    merged = K.Concatenate(axis=-1)([a, b])
+    out = K.Activation("softmax")(K.Dense(4)(merged))
+    model = K.Model(inp, out, batch_size=16)
+    model.compile(optimizer=K.Adam(0.01))
+    x, y = _blobs(64)
+    perf = model.fit(x, y, epochs=3)
+    assert perf.averages()["loss"] < 2.0
+    assert "concatenate" in model.summary().lower()
+
+
+# ---------------------------------------------------------------------------
+# ONNX (package not installed — drive the importer with a minimal
+# hand-built ModelProto stand-in, same field shapes as onnx protos)
+
+
+class _NS:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def _onnx_attr(name, value):
+    if isinstance(value, int):
+        return _NS(name=name, type=2, i=value)
+    if isinstance(value, float):
+        return _NS(name=name, type=1, f=value)
+    return _NS(name=name, type=7, ints=list(value))
+
+
+def _onnx_tensor(name, arr):
+    return _NS(name=name, raw_data=arr.astype(np.float32).tobytes(),
+               dims=list(arr.shape))
+
+
+def test_onnx_importer_mlp():
+    from flexflow_tpu.frontends import ONNXModel
+
+    rng = np.random.default_rng(3)
+    w1, b1 = rng.normal(size=(16, 32)).astype(np.float32), np.zeros(32, np.float32)
+    w2 = rng.normal(size=(32, 4)).astype(np.float32)
+    model = _NS(graph=_NS(
+        node=[
+            _NS(op_type="Gemm", name="fc1", input=["x", "w1", "b1"],
+                output=["h"], attribute=[_onnx_attr("transB", 0)]),
+            _NS(op_type="Relu", name="r1", input=["h"], output=["hr"],
+                attribute=[]),
+            _NS(op_type="Gemm", name="fc2", input=["hr", "w2"],
+                output=["logits"], attribute=[]),
+            _NS(op_type="Softmax", name="sm", input=["logits"],
+                output=["probs"], attribute=[_onnx_attr("axis", -1)]),
+        ],
+        initializer=[_onnx_tensor("w1", w1), _onnx_tensor("b1", b1),
+                     _onnx_tensor("w2", w2)],
+        input=[_NS(name="x"), _NS(name="w1"), _NS(name="b1"), _NS(name="w2")],
+        output=[_NS(name="probs")],
+    ))
+
+    cfg = ff.FFConfig(batch_size=8, num_devices=1)
+    m = ff.FFModel(cfg)
+    x_t = m.create_tensor((8, 16), name="x")
+    om = ONNXModel(model)
+    (out,) = om.to_ff(m, [x_t])
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.01), output=out)
+    om.load_weights(m)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    ref = x @ w1 + b1
+    ref = np.maximum(ref, 0) @ w2
+    ref = np.exp(ref - ref.max(-1, keepdims=True))
+    ref = ref / ref.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
