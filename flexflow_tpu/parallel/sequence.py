@@ -39,17 +39,24 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
-def _online_block(q, k, v, o, m, l, qpos, kpos, scale, causal):
+def _online_block(q, k, v, o, m, l, qpos, kpos, scale, causal, kv_len=None):
     """One K/V block of online-softmax attention.
 
     q (B,Sq,H,d) f.* ; k/v (B,Sk,H,d); o (B,Sq,H,d) f32 accumulator;
-    m/l (B,H,Sq) running max / denominator (f32).
+    m/l (B,H,Sq) running max / denominator (f32). ``kv_len`` masks
+    padded K/V positions (global kpos >= kv_len) when the sequence was
+    right-padded to a multiple of the seq-axis degree.
     """
     scores = jnp.einsum(
         "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
     ) * scale
+    mask = None
     if causal:
         mask = kpos[None, :] <= qpos[:, None]  # (Sq, Sk)
+    if kv_len is not None:
+        kv_valid = jnp.broadcast_to(kpos[None, :] < kv_len, (qpos.shape[0], kpos.shape[0]))
+        mask = kv_valid if mask is None else (mask & kv_valid)
+    if mask is not None:
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
     m_new = jnp.maximum(m, scores.max(axis=-1))
     # fully-masked rows keep m=-inf; guard the exp against -inf - -inf
@@ -65,7 +72,8 @@ def _online_block(q, k, v, o, m, l, qpos, kpos, scale, causal):
     return o_new, m_new, l_new
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
+                          kv_len: Optional[int] = None):
     """Per-shard body (inside shard_map): local q stays, k/v rotate.
     K/V may carry fewer (GQA/MQA) heads than q — they rotate compact
     (H/KV× less ppermute traffic) and expand only inside the block."""
@@ -82,7 +90,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float
         k_pos = j * S + jnp.arange(S)
         ke = jnp.repeat(kk, rep, axis=2) if rep > 1 else kk
         ve = jnp.repeat(vv, rep, axis=2) if rep > 1 else vv
-        o, m, l = _online_block(qf, ke, ve, o, m, l, q_pos, k_pos, scale, causal)
+        o, m, l = _online_block(qf, ke, ve, o, m, l, q_pos, k_pos, scale, causal, kv_len)
         perm = [(s, (s + 1) % n) for s in range(n)]
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
@@ -119,19 +127,27 @@ def ring_attention(
             f"the model-axis degree ({mesh.shape[MODEL_AXIS]}); repeat K/V "
             f"to full heads or drop head sharding"
         )
+    n_seq = mesh.shape[SEQ_AXIS]
+    S = q.shape[1]
+    pad = (-S) % n_seq  # shard_map needs S % n_seq == 0: right-pad + mask
+    if pad:
+        q, k, v = (jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0))) for t in (q, k, v))
     qspec = P(DATA_AXIS, SEQ_AXIS, h_axis, None)
     fn = shard_map(
         functools.partial(
-            _ring_attention_local, axis_name=SEQ_AXIS, causal=causal, scale=scale
+            _ring_attention_local, axis_name=SEQ_AXIS, causal=causal, scale=scale,
+            kv_len=S if pad else None,
         ),
         mesh=mesh,
         in_specs=(qspec, qspec, qspec),
         out_specs=qspec,
     )
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out[:, :S] if pad else out
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float,
+                   kv_len: Optional[int] = None):
     """Per-shard body: all-to-all seq→heads, dense attention, back."""
     n = lax.psum(1, axis_name)
 
@@ -156,8 +172,11 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     scores = jnp.einsum(
         "bshd,bthd->bhst", qh.astype(jnp.float32), kh, preferred_element_type=jnp.float32
     ) * scale
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), bool))
+    mask = jnp.tril(jnp.ones((S, S), bool)) if causal else None
+    if kv_len is not None:
+        kv_valid = jnp.broadcast_to(jnp.arange(S)[None, :] < kv_len, (S, S))
+        mask = kv_valid if mask is None else (mask & kv_valid)
+    if mask is not None:
         scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhst,bthd->bshd", probs, vh.astype(jnp.float32))
@@ -186,13 +205,19 @@ def ulysses_attention(
     )
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     h_axis = MODEL_AXIS if shard_heads else None
+    S = q.shape[1]
+    pad = (-S) % n_seq  # all_to_all needs S % n_seq == 0: right-pad + mask
+    if pad:
+        q, k, v = (jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0))) for t in (q, k, v))
     spec = P(DATA_AXIS, SEQ_AXIS, h_axis, None)
     fn = shard_map(
         functools.partial(
-            _ulysses_local, axis_name=SEQ_AXIS, causal=causal, scale=scale
+            _ulysses_local, axis_name=SEQ_AXIS, causal=causal, scale=scale,
+            kv_len=S if pad else None,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out[:, :S] if pad else out
