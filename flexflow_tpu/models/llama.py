@@ -458,12 +458,17 @@ def init_kv_cache(
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
-def kv_cache_pspecs(cfg: Optional[LLaMAConfig] = None) -> Dict[str, P]:
+def kv_cache_pspecs(
+    cfg: Optional[LLaMAConfig] = None, *, pipeline: bool = False
+) -> Dict[str, P]:
     """Cache shards over TP on the KV-head dim (same axis the attention
-    heads shard on) and over DP on the slot dim."""
+    heads shard on) and over DP on the slot dim; with ``pipeline`` the
+    layer-major leading dim shards over ``pipe`` so each stage holds the
+    cache for its own layers."""
+    pp = PIPE_AXIS if pipeline else None
     return {
-        "k": P(None, DATA_AXIS, None, MODEL_AXIS, None),
-        "v": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+        "k": P(pp, DATA_AXIS, None, MODEL_AXIS, None),
+        "v": P(pp, DATA_AXIS, None, MODEL_AXIS, None),
     }
 
 
@@ -534,12 +539,17 @@ def serve_step(
     cfg: LLaMAConfig,
     all_logits: bool = False,
     kernels: str = "xla",
+    mesh=None,
 ):
     """One serving step over R request slots × C tokens each.
 
     ``cache_positions`` defaults to ``positions``; SpecInfer passes them
     separately because sibling tree tokens share a sequence position
     (prefix + depth) but need distinct cache lines (prefix + node index).
+
+    With a ``mesh`` whose pipe axis is >1, the layer stack (and the
+    layer-major KV cache) is stage-sharded and activations flow through
+    the pipeline (reference inference_manager.cc:91-133 stage mapping).
 
     Returns (logits, new_cache): logits (R, V) at ``logits_idx`` or
     (R, C, V) when ``all_logits`` (tree verification needs every token's
@@ -566,9 +576,41 @@ def serve_step(
         )
         return h, (kc, vc)
 
-    x, (k_new, v_new) = lax.scan(
-        scan_body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    if mesh is not None and mesh.shape[PIPE_AXIS] > 1:
+        from ..parallel.pipeline import make_pipelined_serve
+
+        def stage_fn(stage_layers, caches, h, row):
+            kc, vc = caches
+
+            def body(hh, xs):
+                p_l, kcl, vcl = xs
+                hh, kcl, vcl = serve_block(
+                    cfg, p_l, hh, row["cos"], row["sin"], row["mask"],
+                    kcl, vcl, row["cpos"], kernels,
+                )
+                return hh, (kcl, vcl)
+
+            h, (kc, vc) = lax.scan(body, h, (stage_layers, kc, vc))
+            return h, (kc, vc)
+
+        row = {"cos": cos, "sin": sin, "mask": mask, "cpos": cache_positions}
+        piped = make_pipelined_serve(
+            mesh,
+            stage_fn,
+            params_spec=jax.tree.map(lambda _: P(PIPE_AXIS), params["layers"]),
+            cache_spec=(
+                P(PIPE_AXIS, DATA_AXIS),
+                P(PIPE_AXIS, DATA_AXIS),
+            ),
+            row_specs={k: P(DATA_AXIS) for k in row},
+        )
+        x, (k_new, v_new) = piped(
+            params["layers"], (cache["k"], cache["v"]), x, row
+        )
+    else:
+        x, (k_new, v_new) = lax.scan(
+            scan_body, x, (params["layers"], cache["k"], cache["v"])
+        )
     x = _rms(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     if not all_logits:

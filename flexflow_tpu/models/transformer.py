@@ -427,14 +427,16 @@ def init_kv_cache(cfg: DecoderConfig, num_slots: int, max_len: int, dtype=None):
     return cache
 
 
-def kv_cache_pspecs(cfg: DecoderConfig = None):
+def kv_cache_pspecs(cfg: DecoderConfig = None, *, pipeline: bool = False):
     # MQA (KV=1) caches replicate across TP: a size-1 head dim cannot
     # split over the model axis (the memory cost is the standard MQA
-    # serving trade; queries still shard by head).
+    # serving trade; queries still shard by head). With ``pipeline`` the
+    # layer-major leading dim shards over ``pipe``.
     kv_axis = None if (cfg is not None and cfg.num_key_value_heads == 1) else MODEL_AXIS
+    pp = PIPE_AXIS if pipeline else None
     specs = {
-        "k": P(None, DATA_AXIS, None, kv_axis, None),
-        "v": P(None, DATA_AXIS, None, kv_axis, None),
+        "k": P(pp, DATA_AXIS, None, kv_axis, None),
+        "v": P(pp, DATA_AXIS, None, kv_axis, None),
     }
     if cfg is not None and needs_pos_cache(cfg):
         specs["pos"] = P(DATA_AXIS, None)
@@ -494,9 +496,11 @@ def serve_step(
     *,
     cfg: DecoderConfig,
     all_logits: bool = False,
+    mesh=None,
 ):
     """One serving step over R request slots × C tokens; same contract as
-    ``models.llama.serve_step`` (see engine protocol in serve/engine.py)."""
+    ``models.llama.serve_step`` (see engine protocol in serve/engine.py),
+    including the stage-sharded pipeline path when ``mesh`` has pipe>1."""
     R, C = tokens.shape
     S1 = cache["k"].shape[2]
     if cache_positions is None:
@@ -528,9 +532,49 @@ def serve_step(
         )
         return h, (kc, vc)
 
-    x, (k_new, v_new) = lax.scan(
-        scan_body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    if mesh is not None and mesh.shape[PIPE_AXIS] > 1:
+        from ..parallel.pipeline import make_pipelined_serve
+
+        # Row-sharded args go through explicit specs (closures would
+        # replicate over the manual data axis — see make_pipelined_serve).
+        row = {"mask": mask, "cpos": cache_positions}
+        if rope is not None:
+            row["cos"], row["sin"] = rope
+        if bias is not None:
+            row["bias"] = bias
+
+        def stage_fn(stage_layers, caches, h, row):
+            rope_l = (row["cos"], row["sin"]) if "cos" in row else None
+            kc, vc = caches
+
+            def body(hh, xs):
+                p_l, kcl, vcl = xs
+                hh, kcl, vcl = serve_block(
+                    cfg, p_l, hh, rope_l, row.get("bias"), row["mask"],
+                    kcl, vcl, row["cpos"],
+                )
+                return hh, (kcl, vcl)
+
+            h, (kc, vc) = lax.scan(body, h, (stage_layers, kc, vc))
+            return h, (kc, vc)
+
+        piped = make_pipelined_serve(
+            mesh,
+            stage_fn,
+            params_spec=jax.tree.map(lambda _: P(PIPE_AXIS), params["layers"]),
+            cache_spec=(
+                P(PIPE_AXIS, DATA_AXIS),
+                P(PIPE_AXIS, DATA_AXIS),
+            ),
+            row_specs={k: P(DATA_AXIS) for k in row},
+        )
+        x, (k_new, v_new) = piped(
+            params["layers"], (cache["k"], cache["v"]), x, row
+        )
+    else:
+        x, (k_new, v_new) = lax.scan(
+            scan_body, x, (params["layers"], cache["k"], cache["v"])
+        )
     x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
     if not all_logits:
         x = jnp.take_along_axis(x, logits_idx[:, None, None], axis=1)

@@ -27,7 +27,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.mesh import PIPE_AXIS
+from ..core.mesh import DATA_AXIS, PIPE_AXIS
 
 
 def pipeline_forward(
@@ -87,6 +87,78 @@ def pipeline_forward(
         tick, (outputs0, jnp.zeros_like(x[0])), jnp.arange(n_ticks)
     )
     return outputs
+
+
+def make_pipelined_serve(
+    mesh: Mesh,
+    stage_fn: Callable[..., tuple],
+    *,
+    params_spec: Any,
+    cache_spec: Any,
+    row_specs: tuple = (),
+    x_spec: P = None,
+):
+    """Pipeline-parallel *serving* step over the ``pipe`` axis.
+
+    The reference pipelines inference by mapping layer ranges to stages
+    (reference ``src/runtime/inference_manager.cc:91-133``). Here each
+    stage holds its slice of the stacked layer params AND of the
+    layer-major KV cache; the batch's activations flow stage-to-stage
+    over the ICI ring via ``ppermute``. ``stage_fn(stage_layers,
+    stage_caches, h, row_args) -> (h, new_caches)`` runs one stage's
+    local layer stack, updating its local cache slice. ``row_args`` is
+    a pytree (e.g. a dict of masks/positions/rope tables) forwarded to
+    ``stage_fn`` verbatim; ``row_specs`` must mirror its structure.
+
+    Runs ``num_stages`` ticks: at tick t stage t consumes real
+    activations (earlier stages' outputs), so stage s's cache update is
+    committed only at tick s. Output is valid on the last stage at the
+    final tick, rotated to stage 0 by the ppermute, then broadcast.
+
+    Partial-manual shard_map: ``pipe`` AND ``data`` are manual (each DP
+    group serves its own request slots, so the KV-cache scatter stays
+    shard-local — the SPMD partitioner cannot, and need not, partition
+    it); Megatron TP of the per-stage weights stays under GSPMD on
+    ``model``. Per-row tensors (masks, positions, rope tables) must be
+    passed through ``row_specs``-annotated args, NOT captured by
+    closure: closures replicate over manual axes, which would mismatch
+    the slot-sharded activations.
+    """
+    num_stages = mesh.shape[PIPE_AXIS]
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    if x_spec is None:
+        x_spec = P(DATA_AXIS)
+
+    def inner(stage_layers, caches, h, row_args):
+        stage = lax.axis_index(PIPE_AXIS)
+
+        def tick(carry, t):
+            b, cs = carry
+            out, cs_new = stage_fn(stage_layers, cs, b, row_args)
+            keep = stage == t
+            cs = jax.tree.map(
+                lambda new, old: jnp.where(keep, new, old), cs_new, cs
+            )
+            b = lax.ppermute(out, PIPE_AXIS, perm)
+            return (b, cs), None
+
+        (b, caches_out), _ = lax.scan(
+            tick, (h, caches), jnp.arange(num_stages)
+        )
+        # Last stage's valid output was ppermuted onto stage 0.
+        out = lax.psum(
+            jnp.where(stage == 0, b, jnp.zeros_like(b)), PIPE_AXIS
+        )
+        return out, caches_out
+
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(params_spec, cache_spec, x_spec, row_specs),
+        out_specs=(x_spec, cache_spec),
+        axis_names=frozenset({PIPE_AXIS, DATA_AXIS}),
+        check_vma=False,
+    )
 
 
 def make_pipelined_apply(

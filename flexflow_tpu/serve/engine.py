@@ -69,7 +69,23 @@ class InferenceEngine:
         self.params = params
         self._steps: Dict[Tuple[int, bool, bool], Callable] = {}
         self._commit: Optional[Callable] = None
+        if self.pipelined:
+            pp = self.mesh.shape["pipe"]
+            L = cfg.num_hidden_layers
+            if L % pp:
+                raise ValueError(
+                    f"pipeline serving needs num_hidden_layers ({L}) "
+                    f"divisible by the pipe degree ({pp})"
+                )
         self.cache = self._alloc_cache()
+
+    @property
+    def pipelined(self) -> bool:
+        """Serve-time pipeline parallelism: stage-sharded layer stack
+        (reference inference_manager.cc:91-133 stage assignment)."""
+        from ..core.mesh import PIPE_AXIS
+
+        return self.mesh.shape.get(PIPE_AXIS, 1) > 1
 
     def _alloc_cache(self):
         """Allocate the KV cache sharded over the mesh (the model's
@@ -86,7 +102,9 @@ class InferenceEngine:
         )
         with jax.set_mesh(self.mesh):
             if any(n > 1 for n in self.mesh.shape.values()):
-                pspecs = self.model.kv_cache_pspecs(self.cfg)
+                pspecs = self.model.kv_cache_pspecs(
+                    self.cfg, pipeline=self.pipelined
+                )
                 shardings = jax.tree.map(
                     lambda p: NamedSharding(self.mesh, p),
                     pspecs,
@@ -114,6 +132,8 @@ class InferenceEngine:
             kw = dict(cfg=self.cfg, all_logits=all_logits)
             if self.serving.kernels != "xla":
                 kw["kernels"] = self.serving.kernels
+            if self.pipelined:
+                kw["mesh"] = self.mesh
             fn = functools.partial(self.model.serve_step, **kw)
 
             def step(params, cache, tokens, positions, logits_idx, mask, cpos):

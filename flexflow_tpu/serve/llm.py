@@ -120,8 +120,12 @@ class LLM:
         ``LLM.compile`` → InferenceManager.compile_model_and_allocate_buffer).
         With ``ssms`` the request manager runs the SpecInfer loop."""
         serving = serving or ServingConfig()
+        from ..core.mesh import PIPE_AXIS
+
+        pipelined = self.mesh.shape.get(PIPE_AXIS, 1) > 1
         self.params = hf_utils.device_put_sharded(
-            self.params, self.mesh, self.family.param_pspecs(self.cfg)
+            self.params, self.mesh,
+            self.family.param_pspecs(self.cfg, pipeline=pipelined),
         )
         self.engine = InferenceEngine(
             self.family, self.cfg, self.params, serving, self.mesh
@@ -130,7 +134,8 @@ class LLM:
             assert len(ssms) == 1, "one SSM supported per LLM (multi-SSM trees TBD)"
             ssm = ssms[0]
             ssm.params = hf_utils.device_put_sharded(
-                ssm.params, self.mesh, ssm.family.param_pspecs(ssm.cfg)
+                ssm.params, self.mesh,
+                ssm.family.param_pspecs(ssm.cfg, pipeline=pipelined),
             )
             ssm.engine = InferenceEngine(
                 ssm.family, ssm.cfg, ssm.params, serving, self.mesh

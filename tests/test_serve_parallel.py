@@ -1,0 +1,74 @@
+"""Multi-device serving equivalence — the reference's signature inference
+test is TP×PP output equality (reference
+``tests/inference/python_inference_tests.sh:128-131``: 2×2 vs 1×4 etc.
+must produce identical tokens). Here every (dp, tp, pp) layout on the
+virtual 8-device CPU mesh must emit exactly the single-device greedy
+tokens, through the full LLM.compile/generate stack.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.core.mesh import MachineSpec
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import ServingConfig
+from flexflow_tpu.serve.llm import LLM
+
+
+@pytest.fixture(scope="module")
+def tiny4():
+    """4 layers so pipe degrees 2 and 4 divide evenly."""
+    cfg = llama.LLaMAConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        dtype=jnp.float32,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+PROMPTS = [[3, 17, 91, 42, 7], [9, 8, 7, 6, 5, 4, 3], [100, 200]]
+N_NEW = 8
+
+
+def _generate(tiny4, spec: MachineSpec):
+    cfg, params = tiny4
+    mesh = spec.make_mesh(jax.devices()[: spec.num_devices])
+    m = LLM(llama, cfg, params, mesh=mesh)
+    m.compile(
+        ServingConfig(
+            max_requests_per_batch=4,
+            max_sequence_length=64,
+            prefill_chunk=8,
+            max_spec_tree_tokens=8,
+            cache_dtype=jnp.float32,
+        )
+    )
+    outs = m.generate(PROMPTS, max_new_tokens=N_NEW)
+    return [o.output_tokens for o in outs]
+
+
+@pytest.fixture(scope="module")
+def reference_tokens(tiny4):
+    return _generate(tiny4, MachineSpec())
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        MachineSpec(model=2),
+        MachineSpec(pipe=2),
+        MachineSpec(pipe=4),
+        MachineSpec(model=2, pipe=2),
+        MachineSpec(data=2, model=2, pipe=2),
+    ],
+    ids=["tp2", "pp2", "pp4", "tp2pp2", "dp2tp2pp2"],
+)
+def test_layout_token_equality(tiny4, reference_tokens, spec):
+    assert _generate(tiny4, spec) == reference_tokens
