@@ -36,6 +36,11 @@ class ServingConfig:
     # "xla" (default) or "pallas" — fused decode/tree-verify attention
     # kernels (serve/kernels.py) for models that support the kwarg.
     kernels: str = "xla"
+    # Steady-state decode keeps up to this many steps in flight: sampled
+    # tokens feed the next step on-device, the host fetches results one
+    # step behind (the reference's 4-deep batch-future pipeline,
+    # request_manager.cc:2310-2325).
+    dispatch_ahead: int = 4
 
     @property
     def cache_len(self) -> int:
@@ -141,6 +146,62 @@ class InferenceEngine:
 
             self._steps[key] = jax.jit(step, donate_argnums=(1,))
         return self._steps[key]
+
+    def _get_decode_step(self):
+        """Fused decode step: token select (device feedback vs host) →
+        serve_step(C=1) → per-slot sampling, one program, cache donated.
+        The sampled tokens stay on device so the next step can consume
+        them without a host round-trip (kills the per-token blocking
+        device_get the reference avoids with its future pipeline)."""
+        key_id = ("decode_fused",)
+        if key_id not in self._steps:
+            from .sampling import sample_tokens
+
+            kw = dict(cfg=self.cfg, all_logits=False)
+            if self.serving.kernels != "xla":
+                kw["kernels"] = self.serving.kernels
+            if self.pipelined:
+                kw["mesh"] = self.mesh
+            fn = functools.partial(self.model.serve_step, **kw)
+            R = self.num_slots
+
+            def step(params, cache, last_tokens, host_tokens, use_last,
+                     positions, key, greedy, temperature, topp):
+                tokens = jnp.where(
+                    use_last[:, None], last_tokens[:, None], host_tokens
+                )
+                logits, cache = fn(
+                    params, cache, tokens, positions,
+                    jnp.zeros((R,), jnp.int32), None, None,
+                )
+                toks = sample_tokens(
+                    logits, key,
+                    greedy=greedy, temperature=temperature, topp=topp,
+                )
+                return toks, cache
+
+            self._steps[key_id] = jax.jit(step, donate_argnums=(1,))
+        return self._steps[key_id]
+
+    def run_decode(self, last_tokens, host_tokens, use_last, positions,
+                   key, greedy, temperature, topp):
+        """Dispatch one fused decode step; returns the sampled tokens as
+        a DEVICE array (R,) — the caller fetches it a step later."""
+        with jax.set_mesh(self.mesh):
+            step = self._get_decode_step()
+            toks, self.cache = step(
+                self.params,
+                self.cache,
+                last_tokens,
+                jnp.asarray(host_tokens),
+                jnp.asarray(use_last),
+                jnp.asarray(positions),
+                key,
+                jnp.asarray(greedy),
+                jnp.asarray(temperature),
+                jnp.asarray(topp),
+            )
+        return toks
 
     def run(self, bc: BatchConfig, all_logits: bool = False):
         """Dispatch one step (reference ``InferenceManager::inference``,

@@ -50,6 +50,7 @@ class Request:
     status: RequestStatus = RequestStatus.PENDING
     slot: int = -1
     n_cached: int = 0                 # tokens whose K/V are in the cache
+    inflight: int = 0                 # dispatched decode steps not yet fetched
     profile: ProfileInfo = dataclasses.field(default_factory=ProfileInfo)
 
     @property
@@ -58,6 +59,10 @@ class Request:
 
 
 class RequestManager:
+    # Subclasses that keep a second engine's cache in sync (SpecInfer)
+    # must not use the LLM-only fast decode pipeline.
+    supports_fast_decode = True
+
     def __init__(
         self,
         engine: InferenceEngine,
@@ -76,6 +81,11 @@ class RequestManager:
         self._next_id = 1000000  # reference starts guids at 1000000
         self._key = jax.random.PRNGKey(seed)
         self._step_counter = 0
+        # Dispatch-ahead decode pipeline (reference's 4-deep batch-future
+        # queue, request_manager.cc:2310-2325): entries are
+        # (device_tokens, [(rid, slot), ...]) oldest-first.
+        self._inflight: List[tuple] = []
+        self._prev_dispatch_slots: set = set()
 
     # ------------------------------------------------------------------
     # registration (reference register_new_request, request_manager.cc:137)
@@ -180,20 +190,25 @@ class RequestManager:
     # ------------------------------------------------------------------
     # sampling glue
 
-    def _sample(self, logits) -> np.ndarray:
-        """Sample one token per slot from (R, V) logits using each slot's
-        GenerationConfig (mixed greedy/sampling in one program)."""
+    def _decode_head_params(self, reqs: Sequence[Request]):
+        """Per-slot decode-head arrays for ``reqs`` (greedy/temperature/
+        top-p; top-p >= 1 disables the nucleus filter)."""
         R = self.engine.num_slots
         greedy = np.ones((R,), bool)
         temp = np.ones((R,), np.float32)
-        topp = np.ones((R,), np.float32) * 2.0  # disabled
-        for rid in self.slots:
-            if rid is None:
-                continue
-            req = self.requests[rid]
+        topp = np.full((R,), 2.0, np.float32)  # disabled
+        for req in reqs:
             greedy[req.slot] = not req.gen.do_sample
             temp[req.slot] = req.gen.temperature
             topp[req.slot] = req.gen.topp if req.gen.do_sample else 2.0
+        return greedy, temp, topp
+
+    def _sample(self, logits) -> np.ndarray:
+        """Sample one token per slot from (R, V) logits using each slot's
+        GenerationConfig (mixed greedy/sampling in one program)."""
+        greedy, temp, topp = self._decode_head_params(
+            [self.requests[r] for r in self.slots if r is not None]
+        )
         self._key, sub = jax.random.split(self._key)
         toks = sample_tokens(
             logits,
@@ -227,9 +242,82 @@ class RequestManager:
         SpecInferManager overrides this to keep the SSM cache in sync."""
         return self.engine.run(bc)
 
+    # ------------------------------------------------------------------
+    # dispatch-ahead decode pipeline (reference request_manager.cc:2310)
+
+    def _dispatch_decode(self, decoding: List[Request]):
+        """Dispatch one fused decode step WITHOUT waiting for the
+        previous one: decode rows that were in the previous dispatch
+        take their input token from the on-device sampled tokens; rows
+        entering the pipeline take it from host state. Positions advance
+        deterministically, so no host sync is needed."""
+        R = self.engine.num_slots
+        scratch = self.engine.scratch_pos
+        host_tokens = np.zeros((R, 1), np.int32)
+        use_last = np.zeros((R,), bool)
+        positions = np.full((R, 1), scratch, np.int32)
+        greedy, temp, topp = self._decode_head_params(decoding)
+        snapshot = []
+        last = self._inflight[-1][0] if self._inflight else None
+        for req in decoding:
+            s = req.slot
+            positions[s, 0] = len(req.tokens) - 1 + req.inflight
+            if s in self._prev_dispatch_slots and last is not None:
+                use_last[s] = True
+            else:
+                host_tokens[s, 0] = req.tokens[-1]
+            req.inflight += 1
+            snapshot.append((req.request_id, s))
+        if last is None:
+            last = jnp.zeros((R,), jnp.int32)
+        self._key, sub = jax.random.split(self._key)
+        toks = self.engine.run_decode(
+            last, host_tokens, use_last, positions, sub, greedy, temp, topp
+        )
+        self._inflight.append((toks, snapshot))
+        self._prev_dispatch_slots = {s for _, s in snapshot}
+        self._step_counter += 1
+
+    def _flush_one(self):
+        """Fetch the oldest in-flight step's tokens and do the host
+        bookkeeping (append, EOS/max-length checks, slot release)."""
+        toks, snapshot = self._inflight.pop(0)
+        toks = np.asarray(jax.device_get(toks))
+        for rid, slot in snapshot:
+            req = self.requests.get(rid)
+            if req is None:
+                continue
+            req.inflight = max(0, req.inflight - 1)
+            if req.status is not RequestStatus.DECODING:
+                continue  # finished by an earlier flush; row is garbage
+            req.n_cached += 1
+            req.profile.llm_decoding_steps += 1
+            self._append_token(req, toks[slot])
+
+    def _flush_all(self):
+        while self._inflight:
+            self._flush_one()
+        self._prev_dispatch_slots = set()
+
+    # ------------------------------------------------------------------
+
     def step(self) -> bool:
         """One scheduling step. Returns False when no work remains."""
         self._admit_pending()
+        prefilling = self._active(RequestStatus.PREFILLING)
+        decoding = self._active(RequestStatus.DECODING)
+        if self.supports_fast_decode and decoding and not prefilling:
+            # (a queued request waiting for a slot doesn't force the
+            # sync path: it only becomes schedulable once a flush frees
+            # a slot, and the resulting PREFILLING admission is itself
+            # the sync point)
+            self._dispatch_decode(decoding)
+            depth = max(1, self.engine.serving.dispatch_ahead)
+            while len(self._inflight) >= depth:
+                self._flush_one()
+            return True
+        # Mode change (prefill joining, admissions, drain): sync point.
+        self._flush_all()
         bc = self._prepare_batch()
         if bc is None:
             return bool(self.pending)

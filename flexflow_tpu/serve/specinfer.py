@@ -122,6 +122,10 @@ class SpecInferManager(RequestManager):
     limits; both caches always hold the same committed prefix per slot.
     """
 
+    # The fused decode pipeline bypasses _run_batch and would desync the
+    # SSM cache; spec rounds have their own device-side batching anyway.
+    supports_fast_decode = False
+
     def __init__(
         self,
         llm_engine: InferenceEngine,

@@ -99,6 +99,26 @@ class TestIncrementalDecoding:
             cfg, params, [7] * 8, 4
         )
 
+    def test_dispatch_ahead_pipeline_used(self, tiny):
+        """Steady-state decode must go through the in-flight pipeline
+        (no per-token blocking device_get — reference request_manager.cc
+        :2310-2325) and still match the reference loop exactly."""
+        cfg, params = tiny
+        eng = make_engine(tiny)
+        rm = RequestManager(eng)
+        seen_depth = []
+        orig = rm._dispatch_decode
+
+        def spy(decoding):
+            orig(decoding)
+            seen_depth.append(len(rm._inflight))
+
+        rm._dispatch_decode = spy
+        prompt = [3, 17, 91]
+        out = rm.generate([prompt], max_new_tokens=12)[0]
+        assert out.output_tokens == ref_greedy(cfg, params, prompt, 12)
+        assert seen_depth and max(seen_depth) >= 2, seen_depth
+
     def test_profiling_recorded(self, tiny):
         eng = make_engine(tiny)
         rm = RequestManager(eng)
