@@ -703,6 +703,15 @@ class FFModel:
         per-op shardings and may rewrite the graph; otherwise the
         config's explicit degrees apply (plus an import-strategy file,
         the reference's ``--import-strategy``)."""
+        if self.config.quantization_type is not None or self.config.cpu_offload:
+            # The reference too applies these only to serving
+            # (file_loader.cc:651, SERVE.md offload docs). Raise rather
+            # than silently training in bf16.
+            raise NotImplementedError(
+                "quantization/offload apply to the serving path: pass "
+                "quantization=/offload= to serve.LLM.compile (training "
+                "quantization is not supported, matching the reference)"
+            )
         self.optimizer = optimizer or SGDOptimizer(lr=self.config.learning_rate)
         self.loss_type = loss_type
         self.metrics_names = tuple(metrics)

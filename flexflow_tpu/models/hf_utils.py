@@ -82,13 +82,16 @@ def load_hf_config(model_dir: str) -> Dict[str, Any]:
         return json.load(f)
 
 
-def device_put_sharded(params, mesh, pspecs):
+def device_put_sharded(params, mesh, pspecs, memory_kind=None):
     """Place a host pytree onto the mesh with the model's shardings —
-    the analog of the reference's partition-aware weight copy."""
+    the analog of the reference's partition-aware weight copy.
+    ``memory_kind="pinned_host"`` keeps params in host memory on TPU
+    (the CPU-offload path; XLA streams them per step)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
+    kw = {} if memory_kind is None else {"memory_kind": memory_kind}
     shardings = jax.tree.map(
-        lambda p: NamedSharding(mesh, p),
+        lambda p: NamedSharding(mesh, p, **kw),
         pspecs,
         is_leaf=lambda x: isinstance(x, PartitionSpec),
     )

@@ -95,6 +95,10 @@ def _norm(cfg: DecoderConfig, x, scale, bias):
 
 
 def _mm(x, w):
+    if isinstance(w, dict):  # int8/int4 weight-only quantization
+        from ..quantization import dequantize
+
+        w = dequantize(w, x.dtype)
     return jnp.matmul(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
 
 

@@ -1,0 +1,128 @@
+"""Weight-only int8/int4 quantization (+ host offload placement).
+
+TPU-native counterpart of the reference's quantized serving path
+(reference ``src/ops/kernels/decompress_kernels.cu`` int4/int8
+dequantize kernels, ``inference/file_loader.cc:651,710`` quantized
+weight loading, and the ``--4bit/8bit-quantization`` flags,
+``include/flexflow/config.h:155-157``). Design differences for TPU:
+
+* Weights quantize **per output channel** with a symmetric scale
+  (q = round(w/s), s = max|w| / qmax over the input dim), stored as a
+  ``{"q", "scale"}`` pytree node in place of the dense array. The model
+  matmul helpers dequantize inline; XLA fuses the convert+multiply into
+  the dot-operand read, so the bf16 weight never round-trips HBM — the
+  compiled analog of the reference's decompress-into-shared-memory
+  kernels.
+* int4 packs two values per byte along the input dim (low nibble =
+  even rows), biased to [0, 15] around 8.
+* Offload: instead of the reference's zero-copy-memory double
+  buffering, quantized/bf16 params can be *placed* in ``pinned_host``
+  memory (``NamedSharding.with_memory_kind``); XLA streams them over
+  PCIe per step. See ``serve/llm.py``.
+
+Quantized leaves keep the dense weight's PartitionSpec for ``q`` (the
+packed dim halves but stays divisible by any power-of-two mesh axis);
+``scale`` drops the contracted dim's axis.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+INT8_MAX = 127.0
+INT4_MAX = 7.0
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "scale" in w
+
+
+def quantize_tensor(w: jnp.ndarray, bits: int) -> Dict[str, jnp.ndarray]:
+    """Quantize a (..., in, out) weight per output channel over the
+    input dim. Returns {"q", "scale"} (+ packed int4 layout)."""
+    assert bits in (4, 8), bits
+    wf = jnp.asarray(w, jnp.float32)
+    qmax = INT8_MAX if bits == 8 else INT4_MAX
+    scale = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / qmax  # (...,1,out)
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.round(wf / scale)
+    if bits == 8:
+        q = jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    else:
+        assert w.shape[-2] % 2 == 0, (
+            f"int4 packing needs an even input dim, got {w.shape}"
+        )
+        qb = (jnp.clip(q, -INT4_MAX, INT4_MAX) + 8).astype(jnp.uint8)
+        lo = qb[..., 0::2, :]
+        hi = qb[..., 1::2, :]
+        q = (lo | (hi << 4)).astype(jnp.uint8)  # (..., in//2, out)
+    return {"q": q, "scale": scale.astype(jnp.float32)}
+
+
+def dequantize(qw: Dict[str, jnp.ndarray], dtype) -> jnp.ndarray:
+    """{"q","scale"} → dense (..., in, out) weight in ``dtype``. The bit
+    width is carried by the storage dtype: int8 = 8-bit, uint8 = packed
+    4-bit nibbles."""
+    q, scale = qw["q"], qw["scale"]
+    if q.dtype == jnp.int8:
+        deq = q.astype(jnp.float32)
+    else:
+        lo = (q & 0xF).astype(jnp.int32) - 8
+        hi = ((q >> 4) & 0xF).astype(jnp.int32) - 8
+        # Re-interleave even/odd input rows: (..., in//2, 2, out)
+        deq = jnp.stack([lo, hi], axis=-2).reshape(
+            *q.shape[:-2], q.shape[-2] * 2, q.shape[-1]
+        ).astype(jnp.float32)
+    return (deq * scale).astype(dtype)
+
+
+def _leaf_names(layers: Dict[str, Any]):
+    """Names of quantizable stacked-layer weights: 3-D matmul kernels
+    (wq/wk/wv/wo/w1..w3/w_up/w_down/w_gate) — norms/biases stay dense,
+    matching the reference which quantizes Linear weights only."""
+    return [
+        k for k, v in layers.items()
+        if k.startswith("w") and hasattr(v, "ndim") and v.ndim == 3
+    ]
+
+
+def quantize_params(params: Dict[str, Any], bits: int) -> Dict[str, Any]:
+    """Quantize a model-family param pytree's layer matmul weights."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _leaf_names(layers):
+        layers[name] = quantize_tensor(layers[name], bits)
+    out["layers"] = layers
+    return out
+
+
+def quantize_pspecs(
+    pspecs: Dict[str, Any], params: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Transform a param PartitionSpec tree to match quantized params:
+    ``q`` keeps the dense spec; ``scale`` (size-1 contracted dim) drops
+    that dim's axis."""
+    out = dict(pspecs)
+    layer_specs = dict(pspecs["layers"])
+    for name in _leaf_names_from_quantized(params["layers"]):
+        spec = layer_specs[name]
+        parts = list(spec) + [None] * (3 - len(spec))
+        scale_spec = P(parts[0], None, parts[2])
+        layer_specs[name] = {"q": spec, "scale": scale_spec}
+    out["layers"] = layer_specs
+    return out
+
+
+def _leaf_names_from_quantized(layers: Dict[str, Any]):
+    return [k for k, v in layers.items() if is_quantized(v)]
+
+
+def quantized_nbytes(params: Dict[str, Any]) -> int:
+    """Total bytes of the param pytree (for footprint assertions)."""
+    return sum(
+        x.nbytes for x in jax.tree.leaves(params) if hasattr(x, "nbytes")
+    )

@@ -72,7 +72,9 @@ class InferenceEngine:
         self.serving = serving or ServingConfig()
         self.mesh = mesh or MachineSpec().make_mesh(jax.devices()[:1])
         self.params = params
-        self._steps: Dict[Tuple[int, bool, bool], Callable] = {}
+        # Key: (chunk, all_logits, with_mask) for plain steps, or a
+        # string tag for fused variants ("decode_fused").
+        self._steps: Dict[Any, Callable] = {}
         self._commit: Optional[Callable] = None
         if self.pipelined:
             pp = self.mesh.shape["pipe"]
@@ -128,18 +130,22 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
 
+    def _serve_step_fn(self, all_logits: bool) -> Callable:
+        """model.serve_step bound to this engine's static kwargs."""
+        kw = dict(cfg=self.cfg, all_logits=all_logits)
+        if self.serving.kernels != "xla":
+            kw["kernels"] = self.serving.kernels
+        if self.pipelined:
+            kw["mesh"] = self.mesh
+        return functools.partial(self.model.serve_step, **kw)
+
     def _get_step(self, chunk: int, all_logits: bool, with_mask: bool):
         """One compiled program per static signature — the analog of the
         reference's per-InferenceMode compiled graphs (compile_inference),
         cached like Legion's replayed traces."""
         key = (chunk, all_logits, with_mask)
         if key not in self._steps:
-            kw = dict(cfg=self.cfg, all_logits=all_logits)
-            if self.serving.kernels != "xla":
-                kw["kernels"] = self.serving.kernels
-            if self.pipelined:
-                kw["mesh"] = self.mesh
-            fn = functools.partial(self.model.serve_step, **kw)
+            fn = self._serve_step_fn(all_logits)
 
             def step(params, cache, tokens, positions, logits_idx, mask, cpos):
                 return fn(params, cache, tokens, positions, logits_idx, mask, cpos)
@@ -153,16 +159,11 @@ class InferenceEngine:
         The sampled tokens stay on device so the next step can consume
         them without a host round-trip (kills the per-token blocking
         device_get the reference avoids with its future pipeline)."""
-        key_id = ("decode_fused",)
+        key_id = "decode_fused"
         if key_id not in self._steps:
             from .sampling import sample_tokens
 
-            kw = dict(cfg=self.cfg, all_logits=False)
-            if self.serving.kernels != "xla":
-                kw["kernels"] = self.serving.kernels
-            if self.pipelined:
-                kw["mesh"] = self.mesh
-            fn = functools.partial(self.model.serve_step, **kw)
+            fn = self._serve_step_fn(all_logits=False)
             R = self.num_slots
 
             def step(params, cache, last_tokens, host_tokens, use_last,

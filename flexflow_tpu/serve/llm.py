@@ -115,17 +115,36 @@ class LLM:
         spec: Optional[SpecConfig] = None,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        quantization: Optional[str] = None,  # "int8" | "int4"
+        offload: bool = False,
     ) -> None:
         """Build the inference engine(s) and request manager (reference
         ``LLM.compile`` → InferenceManager.compile_model_and_allocate_buffer).
-        With ``ssms`` the request manager runs the SpecInfer loop."""
+        With ``ssms`` the request manager runs the SpecInfer loop.
+
+        ``quantization`` converts the layer matmul weights to int8/int4
+        {"q","scale"} form at placement time (reference
+        ``file_loader.cc:651,710`` quantized loading + decompress
+        kernels); ``offload`` places params in pinned host memory on TPU
+        so XLA streams them per step (the reference's ``--offload``
+        zero-copy double buffering, config.h:155-157).
+        """
         serving = serving or ServingConfig()
         from ..core.mesh import PIPE_AXIS
+        from ..config import get_config
+        from ..core.dtypes import DataType
 
+        # ff.init(use_4bit_quantization=..., offload=...) flags apply
+        # here (the reference's FFConfig → FileDataLoader path).
+        ffc = get_config()
+        if quantization is None and ffc.quantization_type is not None:
+            quantization = {
+                DataType.INT8: "int8", DataType.INT4: "int4"
+            }[ffc.quantization_type]
+        offload = offload or ffc.cpu_offload
         pipelined = self.mesh.shape.get(PIPE_AXIS, 1) > 1
-        self.params = hf_utils.device_put_sharded(
-            self.params, self.mesh,
-            self.family.param_pspecs(self.cfg, pipeline=pipelined),
+        self.params = self._place_params(
+            self.family, self.cfg, self.params, pipelined, quantization, offload
         )
         self.engine = InferenceEngine(
             self.family, self.cfg, self.params, serving, self.mesh
@@ -133,9 +152,9 @@ class LLM:
         if ssms:
             assert len(ssms) == 1, "one SSM supported per LLM (multi-SSM trees TBD)"
             ssm = ssms[0]
-            ssm.params = hf_utils.device_put_sharded(
-                ssm.params, self.mesh,
-                ssm.family.param_pspecs(ssm.cfg, pipeline=pipelined),
+            ssm.params = self._place_params(
+                ssm.family, ssm.cfg, ssm.params, pipelined, quantization,
+                offload,
             )
             ssm.engine = InferenceEngine(
                 ssm.family, ssm.cfg, ssm.params, serving, self.mesh
@@ -151,6 +170,34 @@ class LLM:
                 eos_token_id=eos_token_id,
                 seed=seed,
             )
+
+    def _place_params(
+        self, family, cfg, params, pipelined: bool,
+        quantization: Optional[str], offload: bool,
+    ):
+        """Quantize (optionally), shard, and place params — on device,
+        or in pinned host memory when offloading on TPU."""
+        pspecs = family.param_pspecs(cfg, pipeline=pipelined)
+        if quantization is not None:
+            from .. import quantization as quant
+
+            bits = {"int8": 8, "int4": 4}[quantization]
+            params = quant.quantize_params(params, bits)
+            pspecs = quant.quantize_pspecs(pspecs, params)
+        memory_kind = None
+        if offload:
+            if jax.devices()[0].platform == "tpu":
+                memory_kind = "pinned_host"
+            else:
+                import warnings
+
+                warnings.warn(
+                    "offload=True has no effect off-TPU (params already "
+                    "live in host memory on this backend)", stacklevel=3,
+                )
+        return hf_utils.device_put_sharded(
+            params, self.mesh, pspecs, memory_kind=memory_kind
+        )
 
     def generate(
         self,
