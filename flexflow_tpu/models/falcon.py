@@ -20,6 +20,7 @@ from .transformer import (  # noqa: F401  (engine serving protocol)
     kv_cache_pspecs,
     num_params,
     param_pspecs,
+    reorder_slots,
     serve_step,
 )
 from .hf_utils import linear_w, stack, to_np

@@ -645,6 +645,16 @@ def commit_kv(
     return out
 
 
+def reorder_slots(
+    cache: Dict[str, jnp.ndarray], src: jnp.ndarray  # (R,) int32
+) -> Dict[str, jnp.ndarray]:
+    """Gather cache slots: new slot r takes slot src[r]'s lines — beam
+    search reorders hypotheses across request slots this way (the
+    reference's beam attention forks sub-request KV instead,
+    spec_inc_multihead_self_attention.cu)."""
+    return {name: buf[:, src] for name, buf in cache.items()}
+
+
 def num_params(cfg: LLaMAConfig) -> int:
     L, D, F, V = (
         cfg.num_hidden_layers,

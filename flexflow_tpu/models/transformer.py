@@ -606,6 +606,17 @@ def commit_kv(cache, src, dst):
     return out
 
 
+def reorder_slots(
+    cache: Dict[str, jnp.ndarray], src: jnp.ndarray  # (R,) int32
+) -> Dict[str, jnp.ndarray]:
+    """Gather cache slots (see models.llama.reorder_slots); the ALiBi
+    position buffer's slot dim leads instead of following the layer dim."""
+    return {
+        name: (buf[src] if name == "pos" else buf[:, src])
+        for name, buf in cache.items()
+    }
+
+
 def num_params(cfg: DecoderConfig) -> int:
     shapes = init_shapes(cfg)
     return sum(

@@ -71,6 +71,10 @@ class GenerationConfig:
     topk: int = 0  # 0 = disabled
     max_new_tokens: int = 128
     stop_token_ids: tuple = ()
+    # Beam-search decode head (reference beam_topk.cc); >1 routes
+    # generation through serve.beam.beam_generate.
+    num_beams: int = 1
+    length_penalty: float = 1.0
 
 
 @dataclasses.dataclass

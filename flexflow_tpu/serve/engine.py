@@ -223,6 +223,18 @@ class InferenceEngine:
             )
         return logits
 
+    def reorder(self, src_slots: np.ndarray):
+        """Slot permutation/gather of the whole cache (beam search
+        hypothesis reordering): new slot r holds old slot src_slots[r]."""
+        if "reorder" not in self._steps:
+            self._steps["reorder"] = jax.jit(
+                self.model.reorder_slots, donate_argnums=(0,)
+            )
+        with jax.set_mesh(self.mesh):
+            self.cache = self._steps["reorder"](
+                self.cache, jnp.asarray(src_slots, jnp.int32)
+            )
+
     def commit(self, src: np.ndarray, dst: np.ndarray):
         """Move accepted speculative cache lines to committed positions
         (src/dst (R, K); unused entries scratch→scratch)."""

@@ -150,17 +150,16 @@ class LLM:
             self.family, self.cfg, self.params, serving, self.mesh
         )
         if ssms:
-            assert len(ssms) == 1, "one SSM supported per LLM (multi-SSM trees TBD)"
-            ssm = ssms[0]
-            ssm.params = self._place_params(
-                ssm.family, ssm.cfg, ssm.params, pipelined, quantization,
-                offload,
-            )
-            ssm.engine = InferenceEngine(
-                ssm.family, ssm.cfg, ssm.params, serving, self.mesh
-            )
+            for ssm in ssms:
+                ssm.params = self._place_params(
+                    ssm.family, ssm.cfg, ssm.params, pipelined, quantization,
+                    offload,
+                )
+                ssm.engine = InferenceEngine(
+                    ssm.family, ssm.cfg, ssm.params, serving, self.mesh
+                )
             self.rm = SpecInferManager(
-                self.engine, ssm.engine, spec,
+                self.engine, [s.engine for s in ssms], spec,
                 tokenizer=self.tokenizer, eos_token_id=eos_token_id, seed=seed,
             )
         else:
@@ -207,6 +206,25 @@ class LLM:
     ) -> List[GenerationResult]:
         if self.rm is None:
             self.compile()
+        if gen is not None and gen.num_beams > 1:
+            from .beam import generate_with_beams
+
+            if gen.do_sample:
+                # Beam scoring here is deterministic log-prob ranking —
+                # fail loudly rather than silently ignore sampling knobs
+                # (same contract as SpecInferManager.register_request).
+                raise ValueError(
+                    "num_beams > 1 is greedy-scored; do_sample cannot be "
+                    "honored — use num_beams=1 for sampling"
+                )
+            if max_new_tokens is not None:
+                gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
+            if isinstance(prompts, str):
+                prompts = [prompts]
+            return generate_with_beams(
+                self.engine, prompts, gen,
+                eos_token_id=self.rm.eos_token_id, tokenizer=self.tokenizer,
+            )
         return self.rm.generate(prompts, gen, max_new_tokens)
 
 

@@ -56,17 +56,18 @@ class TokenTree:
     def __len__(self) -> int:
         return len(self.tokens)
 
-    def add(self, token: int, parent: int, logprob: float) -> Optional[int]:
+    def add(self, token: int, parent: int, logprob: float) -> Tuple[int, bool]:
         """Add a child; duplicate (parent, token) pairs are merged (the
-        analog of the reference's merge_dfs_trees dedup)."""
+        analog of the reference's merge_dfs_trees dedup). Returns
+        (node index, is_new)."""
         for i, (p, t) in enumerate(zip(self.parents, self.tokens)):
             if p == parent and t == int(token):
-                return None
+                return i, False
         self.tokens.append(int(token))
         self.parents.append(int(parent))
         self.depths.append(self.depths[parent] + 1)
         self.logprobs.append(float(logprob))
-        return len(self.tokens) - 1
+        return len(self.tokens) - 1, True
 
     def children(self, node: int) -> List[int]:
         return [i for i, p in enumerate(self.parents) if p == node]
@@ -102,6 +103,28 @@ class TokenTree:
             cur = nxt
 
 
+def merge_trees(trees: List["TokenTree"]) -> "TokenTree":
+    """Merge per-SSM token trees into one deduplicated tree — the
+    reference's ``merge_dfs_trees`` (request_manager.h:178-189): shared
+    (parent, token) branches collapse so the LLM verifies each distinct
+    continuation once, keeping the max logprob of merged duplicates."""
+    assert trees and all(
+        t.tokens[0] == trees[0].tokens[0] for t in trees
+    ), "trees must share the root (last committed) token"
+    merged = TokenTree(trees[0].tokens[0])
+    for tree in trees:
+        remap = {0: 0}
+        for i in range(1, len(tree)):
+            parent = remap[tree.parents[i]]
+            idx, is_new = merged.add(tree.tokens[i], parent, tree.logprobs[i])
+            if not is_new:
+                merged.logprobs[idx] = max(
+                    merged.logprobs[idx], tree.logprobs[i]
+                )
+            remap[i] = idx
+    return merged
+
+
 @dataclasses.dataclass
 class SpecConfig:
     """Speculation shape (reference MAX_BEAM_WIDTH=3 / MAX_BEAM_DEPTH=8,
@@ -129,27 +152,41 @@ class SpecInferManager(RequestManager):
     def __init__(
         self,
         llm_engine: InferenceEngine,
-        ssm_engine: InferenceEngine,
+        ssm_engines,  # one engine or a list (multi-SSM tree merge)
         spec: Optional[SpecConfig] = None,
         tokenizer: Any = None,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
     ):
         super().__init__(llm_engine, tokenizer, eos_token_id, seed)
-        self.ssm = ssm_engine
+        if isinstance(ssm_engines, InferenceEngine):
+            ssm_engines = [ssm_engines]
+        self.ssms: List[InferenceEngine] = list(ssm_engines)
+        assert self.ssms, "SpecInferManager needs at least one SSM"
         self.spec = spec or SpecConfig()
+        for ssm_engine in self.ssms:
+            assert (
+                ssm_engine.num_slots == llm_engine.num_slots
+                and ssm_engine.serving.cache_len == llm_engine.serving.cache_len
+            ), "LLM and SSM engines must share serving limits"
+            assert llm_engine.cfg.vocab_size == ssm_engine.cfg.vocab_size, (
+                "LLM/SSM vocab mismatch: draft tokens would be silently "
+                "clipped at the verifier's embedding"
+            )
+        # A merged multi-SSM tree is at worst the concatenation of the
+        # per-SSM trees (dedup only shrinks it).
         assert (
-            ssm_engine.num_slots == llm_engine.num_slots
-            and ssm_engine.serving.cache_len == llm_engine.serving.cache_len
-        ), "LLM and SSM engines must share serving limits"
-        assert (
-            self.spec.max_tree_tokens
-            <= llm_engine.serving.max_spec_tree_tokens
-        ), "tree larger than the cache's speculative slack region"
-        assert llm_engine.cfg.vocab_size == ssm_engine.cfg.vocab_size, (
-            "LLM/SSM vocab mismatch: draft tokens would be silently "
-            "clipped at the verifier's embedding"
-        )
+            self.max_merged_tokens <= llm_engine.serving.max_spec_tree_tokens
+        ), "merged tree larger than the cache's speculative slack region"
+
+    @property
+    def max_merged_tokens(self) -> int:
+        return 1 + len(self.ssms) * (self.spec.max_tree_tokens - 1)
+
+    @property
+    def ssm(self) -> InferenceEngine:
+        """Primary SSM (kept for single-SSM callers/tests)."""
+        return self.ssms[0]
 
     # ------------------------------------------------------------------
     # batch builders
@@ -188,10 +225,12 @@ class SpecInferManager(RequestManager):
     # ------------------------------------------------------------------
     # the SpecInfer round
 
-    def _grow_trees(self, reqs: List[Request]) -> Dict[int, TokenTree]:
-        """SSM beam expansion (reference prepare_next_batch_beam loop,
-        request_manager.cc:2397-2407): depth × (feed frontier, top-k per
-        beam, prune to beam_width by cumulative logprob)."""
+    def _grow_trees_one_ssm(
+        self, ssm: InferenceEngine, reqs: List[Request]
+    ) -> Dict[int, TokenTree]:
+        """One SSM's beam expansion (reference prepare_next_batch_beam
+        loop, request_manager.cc:2397-2407): depth × (feed frontier,
+        top-k per beam, prune to beam_width by cumulative logprob)."""
         W, D = self.spec.beam_width, self.spec.beam_depth
         trees = {r.request_id: TokenTree(r.tokens[-1]) for r in reqs}
         frontier = {r.request_id: [0] for r in reqs}
@@ -199,8 +238,8 @@ class SpecInferManager(RequestManager):
             node_lists = {
                 rid: nodes[:W] for rid, nodes in frontier.items()
             }
-            bc = self._tree_chunk_batch(self.ssm, reqs, trees, node_lists, W)
-            logits = self.ssm.run(bc, all_logits=True)  # (R, W, V)
+            bc = self._tree_chunk_batch(ssm, reqs, trees, node_lists, W)
+            logits = ssm.run(bc, all_logits=True)  # (R, W, V)
             vals, idxs = beam_topk(log_softmax(logits), W)
             vals = np.asarray(jax.device_get(vals))
             idxs = np.asarray(jax.device_get(idxs))
@@ -221,8 +260,8 @@ class SpecInferManager(RequestManager):
                 cands.sort(key=lambda t: -t[0])
                 new_frontier = []
                 for lp, tok, parent in cands[:W]:
-                    idx = tree.add(tok, parent, lp)
-                    if idx is not None:
+                    idx, is_new = tree.add(tok, parent, lp)
+                    if is_new:
                         new_frontier.append(idx)
                 frontier[rid] = new_frontier
                 req.profile.ssm_decoding_steps += 1
@@ -230,22 +269,37 @@ class SpecInferManager(RequestManager):
                 break
         return trees
 
+    def _grow_trees(self, reqs: List[Request]) -> Dict[int, TokenTree]:
+        """All SSMs speculate independently; their trees merge with
+        dedup (reference generate_spec_infer's per-SSM loop +
+        merge_dfs_trees, request_manager.cc:2397-2410)."""
+        per_ssm = [self._grow_trees_one_ssm(ssm, reqs) for ssm in self.ssms]
+        if len(per_ssm) == 1:
+            return per_ssm[0]
+        return {
+            r.request_id: merge_trees(
+                [trees[r.request_id] for trees in per_ssm]
+            )
+            for r in reqs
+        }
+
     def _verify_and_commit(
         self, reqs: List[Request], trees: Dict[int, TokenTree]
     ):
-        """LLM tree-verify step + greedy acceptance + KV commit on both
+        """LLM tree-verify step + greedy acceptance + KV commit on all
         caches (reference prepare_next_batch_verify + tree attention +
         commit_tokens)."""
-        C = self.spec.max_tree_tokens
+        C = self.max_merged_tokens
         node_lists = {
             r.request_id: list(range(len(trees[r.request_id]))) for r in reqs
         }
         bc = self._tree_chunk_batch(self.engine, reqs, trees, node_lists, C)
         logits = self.engine.run(bc, all_logits=True)  # (R, C, V)
         greedy = np.asarray(jax.device_get(_greedy(logits)))  # (R, C)
+        accepted: Dict[int, Tuple[int, List[int]]] = {}  # rid -> (slot, path tokens)
 
         R = self.engine.num_slots
-        K = self.spec.beam_depth + 1
+        K = self.spec.beam_depth + 1  # deepest acceptable path (any SSM)
         scratch = self.engine.scratch_pos
         src = np.full((R, K), scratch, np.int32)
         dst = np.full((R, K), scratch, np.int32)
@@ -262,12 +316,46 @@ class SpecInferManager(RequestManager):
             # Tokens: path nodes beyond the root are newly committed
             # outputs; the bonus token is the LLM's own next sample.
             new_tokens = [tree.tokens[n] for n in path[1:]] + [bonus]
+            # capture the slot NOW: _append_token may complete the
+            # request and free it
+            accepted[req.request_id] = (req.slot, [tree.tokens[n] for n in path])
             req.n_cached += len(path)
             for t in new_tokens:
                 if req.status is RequestStatus.DECODING:
                     self._append_token(req, t)
         self.engine.commit(src, dst)
-        self.ssm.commit(src, dst)
+        if len(self.ssms) == 1:
+            # Single SSM: the merged tree IS its own tree, so the
+            # accepted nodes sit at the same slack lines — cheap line
+            # move.
+            self.ssms[0].commit(src, dst)
+        else:
+            # Multi-SSM: each SSM's slack region is laid out by its own
+            # pre-merge tree indices, so merged-index line moves would
+            # commit the wrong lines. Recompute instead: feed the
+            # accepted tokens through every SSM at their committed
+            # positions (the reference's beam-init recompute,
+            # prepare_next_batch_init).
+            self._refeed_accepted(reqs, accepted)
+
+    def _refeed_accepted(self, reqs, accepted):
+        """Write the accepted tokens' K/V into every SSM cache by
+        running them as ordinary causal inputs at committed positions."""
+        K = self.spec.beam_depth + 1
+        R = self.engine.num_slots
+        scratch = self.engine.scratch_pos
+        bc = BatchConfig.empty(R, K, scratch)
+        for req in reqs:
+            slot, toks = accepted[req.request_id]
+            start = req.n_cached - len(toks)  # n_cached already advanced
+            bc.tokens[slot, : len(toks)] = toks
+            bc.positions[slot, : len(toks)] = np.arange(
+                start, start + len(toks)
+            )
+            bc.logits_idx[slot] = len(toks) - 1
+            bc.active[slot] = True
+        for ssm in self.ssms:
+            ssm.run(bc)
 
     # ------------------------------------------------------------------
     # scheduling
@@ -286,7 +374,8 @@ class SpecInferManager(RequestManager):
 
     def _run_batch(self, bc):
         logits = self.engine.run(bc)
-        self.ssm.run(bc)  # same tokens into the SSM cache
+        for ssm in self.ssms:
+            ssm.run(bc)  # same tokens into every SSM cache
         return logits
 
     def step(self) -> bool:

@@ -1,0 +1,103 @@
+"""Beam-search decode head — reference ``beam_topk.cc`` applied to
+plain generation. Width-1 must equal greedy; width-W must match
+HuggingFace's beam search on the converted tiny model (the same
+HF-parity bar the model zoo uses)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.core.mesh import MachineSpec
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import GenerationConfig, ServingConfig
+from flexflow_tpu.serve.llm import LLM
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+V = 256
+
+
+@pytest.fixture(scope="module")
+def pair():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = llama.LLaMAConfig.from_hf(hf_cfg.to_dict(), dtype=jnp.float32)
+    params = llama.convert_hf_state_dict(hf.state_dict(), cfg)
+    return hf, cfg, params
+
+
+def _llm(cfg, params):
+    m = LLM(llama, cfg, params, mesh=MachineSpec().make_mesh(jax.devices()[:1]))
+    m.compile(
+        ServingConfig(
+            max_requests_per_batch=8, max_sequence_length=64,
+            prefill_chunk=8, max_spec_tree_tokens=8,
+            cache_dtype=jnp.float32,
+        )
+    )
+    return m
+
+
+def test_beam1_equals_greedy(pair):
+    _, cfg, params = pair
+    m = _llm(cfg, params)
+    prompt = [3, 17, 91, 42]
+    greedy = m.generate([prompt], max_new_tokens=8)[0].output_tokens
+    beam1 = m.generate(
+        [prompt], gen=GenerationConfig(num_beams=1), max_new_tokens=8
+    )
+    # num_beams=1 routes through the normal manager; force the beam path:
+    from flexflow_tpu.serve.beam import beam_generate
+
+    out = beam_generate(
+        m.engine, prompt, GenerationConfig(num_beams=1, max_new_tokens=8)
+    )
+    assert out == greedy
+
+
+@pytest.mark.parametrize("width", [2, 3])
+def test_beam_matches_hf(pair, width):
+    hf, cfg, params = pair
+    m = _llm(cfg, params)
+    prompt = [3, 17, 91, 42, 7]
+    n_new = 8
+    with torch.no_grad():
+        hf_out = hf.generate(
+            torch.tensor([prompt]),
+            max_new_tokens=n_new,
+            num_beams=width,
+            do_sample=False,
+            early_stopping=False,
+            # no EOS in the tiny random vocab run: disable so HF decodes
+            # the full n_new and ranks by score, matching our rule
+            eos_token_id=None,
+            pad_token_id=0,
+        )[0].tolist()
+    ours = m.generate(
+        [prompt], gen=GenerationConfig(num_beams=width), max_new_tokens=n_new
+    )[0].output_tokens
+    assert ours == hf_out[len(prompt):], (ours, hf_out[len(prompt):])
+
+
+def test_beam_respects_eos(pair):
+    _, cfg, params = pair
+    m = _llm(cfg, params)
+    prompt = [5, 9, 2]
+    # find what width-2 beam emits first, then declare it EOS
+    first = m.generate(
+        [prompt], gen=GenerationConfig(num_beams=2), max_new_tokens=6
+    )[0].output_tokens[0]
+    from flexflow_tpu.serve.beam import beam_generate
+
+    out = beam_generate(
+        m.engine, prompt,
+        GenerationConfig(num_beams=2, max_new_tokens=6),
+        eos_token_id=first,
+    )
+    assert out[-1] == first and len(out) <= 6

@@ -57,20 +57,37 @@ def make_engine(model_params):
 class TestTokenTree:
     def test_dedup_and_ancestors(self):
         t = TokenTree(5)
-        a = t.add(1, 0, -0.1)
-        b = t.add(2, 0, -0.5)
-        assert t.add(1, 0, -0.2) is None  # duplicate (parent, token)
-        c = t.add(3, a, -0.3)
+        a, _ = t.add(1, 0, -0.1)
+        b, _ = t.add(2, 0, -0.5)
+        dup, is_new = t.add(1, 0, -0.2)  # duplicate (parent, token)
+        assert dup == a and not is_new
+        c, _ = t.add(3, a, -0.3)
         anc = t.ancestor_matrix()
         assert anc[c, a] and anc[c, 0] and anc[c, c]
         assert not anc[c, b] and not anc[a, b]
         assert t.depths == [0, 1, 1, 2]
 
+    def test_merge_trees_dedups_shared_branches(self):
+        from flexflow_tpu.serve.specinfer import merge_trees
+
+        t1 = TokenTree(5)
+        a1, _ = t1.add(1, 0, -0.1)
+        t1.add(3, a1, -0.3)
+        t2 = TokenTree(5)
+        a2, _ = t2.add(1, 0, -0.05)  # same branch, better logprob
+        t2.add(4, a2, -0.4)          # new continuation
+        m = merge_trees([t1, t2])
+        # root + shared "1" + "3" + "4" = 4 nodes, not 5
+        assert len(m) == 4
+        assert sorted(m.tokens[1:]) == [1, 3, 4]
+        shared = m.tokens.index(1)
+        assert m.logprobs[shared] == -0.05  # max of duplicates
+
     def test_accept_walk(self):
         t = TokenTree(5)
-        a = t.add(1, 0, 0)
+        a, _ = t.add(1, 0, 0)
         t.add(2, 0, 0)
-        c = t.add(3, a, 0)
+        c, _ = t.add(3, a, 0)
         # greedy_next per node: root->1 (match a), a->3 (match c), c->9 (bonus)
         greedy = np.zeros(len(t), np.int32)
         greedy[0], greedy[a], greedy[c] = 1, 3, 9
@@ -135,3 +152,57 @@ class TestSpecInfer:
         )
         spec = mgr.generate([prompt], max_new_tokens=9)[0]
         assert spec.output_tokens == incr.output_tokens
+
+    def test_two_ssm_tree_merge_matches_greedy(self, tiny, tiny_ssm):
+        """Two different drafts' trees merge (reference merge_dfs_trees)
+        — output must still be exactly the greedy tokens."""
+        cfg, params = tiny
+        cfg2 = llama.LLaMAConfig.tiny(dtype=jnp.float32, num_hidden_layers=1)
+        tiny_ssm2 = (cfg2, llama.init_params(jax.random.PRNGKey(31), cfg2))
+        for prompt in ([5, 9, 2], [1, 2, 3, 4, 5, 6, 7]):
+            mgr = SpecInferManager(
+                make_engine(tiny),
+                [make_engine(tiny_ssm), make_engine(tiny_ssm2)],
+                SpecConfig(beam_width=2, beam_depth=3),
+            )
+            out = mgr.generate([prompt], max_new_tokens=10)[0]
+            assert out.output_tokens == ref_greedy(cfg, params, prompt, 10), prompt
+
+    def test_two_ssm_acceptance_not_degraded(self, tiny):
+        """Adding a second (identical) draft must not LOWER acceptance:
+        if the multi-SSM commit corrupted the SSM caches, the drafts
+        would attend garbage history from round 2 on and acceptance
+        would collapse below the single-SSM baseline (output would stay
+        greedy-correct, hiding the bug)."""
+        cfg, params = tiny
+        prompt = [3, 17, 91, 42, 7]
+        single = SpecInferManager(
+            make_engine(tiny), make_engine(tiny), SpecConfig(2, 3)
+        ).generate([prompt], max_new_tokens=16)[0]
+        dual = SpecInferManager(
+            make_engine(tiny), [make_engine(tiny), make_engine(tiny)],
+            SpecConfig(2, 3),
+        ).generate([prompt], max_new_tokens=16)[0]
+        assert dual.output_tokens == ref_greedy(cfg, params, prompt, 16)
+        assert dual.profile.accepted_tokens >= single.profile.accepted_tokens
+        assert dual.profile.llm_decoding_steps <= single.profile.llm_decoding_steps
+
+    def test_two_ssm_through_llm_api(self, tiny, tiny_ssm):
+        """LLM.compile(ssms=[a, b]) no longer rejects multi-SSM."""
+        from flexflow_tpu.core.mesh import MachineSpec
+        from flexflow_tpu.serve.llm import LLM, SSM
+
+        cfg, params = tiny
+        mesh = MachineSpec().make_mesh(jax.devices()[:1])
+        m = LLM(llama, cfg, params, mesh=mesh)
+        ssm_a = SSM(llama, tiny_ssm[0], tiny_ssm[1], mesh=mesh)
+        ssm_b = SSM(llama, cfg, params, mesh=mesh)  # self-draft
+        sc = ServingConfig(
+            max_requests_per_batch=4, max_sequence_length=96,
+            prefill_chunk=8, max_spec_tree_tokens=16,
+            cache_dtype=jnp.float32,
+        )
+        m.compile(sc, ssms=[ssm_a, ssm_b], spec=SpecConfig(2, 3))
+        prompt = [3, 17, 91]
+        out = m.generate([prompt], max_new_tokens=8)[0]
+        assert out.output_tokens == ref_greedy(cfg, params, prompt, 8)
