@@ -39,14 +39,14 @@ def beam_generate(
 ) -> List[int]:
     """Beam-search one request; returns the best hypothesis' generated
     tokens. Uses slots [0, W) of the engine's cache."""
-    import time
-
     W = gen.num_beams
     R = engine.num_slots
     assert 1 <= W <= R, f"num_beams {W} exceeds {R} cache slots"
     sc = engine.serving
     scratch = engine.scratch_pos
     prompt = list(prompt)
+    if not prompt:
+        raise ValueError("empty prompt")
     max_total = sc.max_sequence_length
     if len(prompt) >= max_total:
         prompt = prompt[: max_total - 1]

@@ -49,10 +49,12 @@ def test_beam1_equals_greedy(pair):
     m = _llm(cfg, params)
     prompt = [3, 17, 91, 42]
     greedy = m.generate([prompt], max_new_tokens=8)[0].output_tokens
+    # num_beams=1 routes through the normal manager — same tokens
     beam1 = m.generate(
         [prompt], gen=GenerationConfig(num_beams=1), max_new_tokens=8
-    )
-    # num_beams=1 routes through the normal manager; force the beam path:
+    )[0].output_tokens
+    assert beam1 == greedy
+    # the beam algorithm itself at W=1 also degenerates to greedy
     from flexflow_tpu.serve.beam import beam_generate
 
     out = beam_generate(
