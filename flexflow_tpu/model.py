@@ -909,6 +909,43 @@ class FFModel:
             return self._fwd(self.params, self.model_state, inputs)
 
     # ------------------------------------------------------------------
+    # checkpoint / resume (orbax; beyond the reference — SURVEY.md §5
+    # asks for async sharded checkpointing where the reference has only
+    # host get_tensor/set_tensor)
+
+    def _train_state(self) -> Dict[str, Any]:
+        assert self.params is not None, "call compile() first"
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "model_state": self.model_state,
+            "step": np.asarray(self._step_count, np.int64),
+        }
+
+    def save_checkpoint(self, directory: str, *, wait: bool = False) -> None:
+        """Async-save params + optimizer state + model state + step."""
+        from .checkpoint import save_train_state
+
+        save_train_state(
+            directory, self._step_count, self._train_state(), wait=wait
+        )
+
+    def restore_checkpoint(
+        self, directory: str, step: Optional[int] = None
+    ) -> None:
+        """Restore into a compiled model (shardings come from the live
+        state, so each process loads only its own shards)."""
+        from .checkpoint import restore_train_state
+
+        restored = restore_train_state(
+            directory, self._train_state(), step=step
+        )
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+        self.model_state = restored["model_state"]
+        self._step_count = int(restored["step"])
+
+    # ------------------------------------------------------------------
     # weight access (reference ParallelTensorBase::get_tensor/set_tensor)
 
     def get_weights(self, layer_name: str):
