@@ -1,0 +1,54 @@
+"""MNIST-style MLP training — the reference's canonical smoke example
+(reference ``examples/python/native/mnist_mlp.py`` +
+``scripts/mnist_mlp_run.sh``). The container has no network egress, so
+the data is a synthetic MNIST-shaped classification set; swap in real
+MNIST arrays to reproduce the reference run exactly.
+
+Run: python examples/mnist_mlp.py [--devices N]
+"""
+import argparse
+
+import numpy as np
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """784-dim, 10 classes, linearly-separable-ish clusters."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    protos = rng.normal(size=(10, 784)).astype(np.float32)
+    x = protos[y] + 0.3 * rng.normal(size=(n, 784)).astype(np.float32)
+    return x.astype(np.float32), y
+
+
+def main(num_devices=1, epochs=2, batch_size=64, profiling=False):
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(
+        batch_size=batch_size, epochs=epochs, num_devices=num_devices,
+        profiling=profiling,
+    )
+    model = ff.FFModel(cfg)
+    t = model.create_tensor((batch_size, 784), name="x")
+    t = model.dense(t, 512, activation="relu")
+    t = model.dense(t, 512, activation="relu")
+    t = model.dense(t, 10)
+    t = model.softmax(t)
+    model.compile(
+        optimizer=ff.SGDOptimizer(lr=0.05),
+        loss_type="sparse_categorical_crossentropy",
+        metrics=("accuracy",),
+    )
+    x, y = synthetic_mnist()
+    perf = model.fit(x, y)
+    final = model.evaluate(x, y)
+    print("final:", final)
+    return final
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--profiling", action="store_true")
+    a = p.parse_args()
+    main(a.devices, a.epochs, profiling=a.profiling)

@@ -1,0 +1,43 @@
+"""Mixture-of-experts training with expert parallelism — the
+reference's MoE example (reference ``examples/cpp/mixture_of_experts/
+moe.cc:100-130``: top_k gate → group_by → experts → aggregate with a
+load-balance term).
+
+Run: python examples/moe_train.py [--devices N] [--ep N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(num_devices=1, ep=1, epochs=2):
+    import flexflow_tpu as ff
+
+    bs = 32 * max(1, num_devices // max(1, ep))
+    cfg = ff.FFConfig(
+        batch_size=bs, epochs=epochs, num_devices=num_devices,
+        expert_parallelism_degree=ep,
+    )
+    model = ff.FFModel(cfg)
+    t = model.create_tensor((bs, 32), name="x")
+    t = model.moe(t, num_experts=4 * max(1, ep), top_k=2, expert_hidden=64)
+    t = model.dense(t, 8)
+    t = model.softmax(t)
+    model.compile(optimizer=ff.AdamOptimizer(lr=0.003))
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 8, size=1024).astype(np.int32)
+    protos = rng.normal(size=(8, 32)).astype(np.float32)
+    x = (protos[y] + 0.2 * rng.normal(size=(1024, 32))).astype(np.float32)
+    model.fit(x, y)
+    final = model.evaluate(x, y)
+    print("final:", final)
+    return final
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1)
+    a = p.parse_args()
+    main(a.devices, a.ep)

@@ -1,0 +1,37 @@
+"""Unity-style auto-parallel compile — let the search pick the mesh
+degrees and per-op shardings instead of specifying them (the
+reference's headline Train capability, ``TRAIN.md:1-67``).
+
+Run: python examples/unity_search.py [--devices N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(num_devices=4):
+    import flexflow_tpu as ff
+
+    bs = 8 * num_devices
+    cfg = ff.FFConfig(batch_size=bs, epochs=1, num_devices=num_devices)
+    model = ff.FFModel(cfg)
+    t = model.create_tensor((bs, 64), name="x")
+    for _ in range(3):
+        t = model.dense(t, 256, activation="relu")
+    t = model.dense(t, 8)
+    t = model.softmax(t)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.05), auto_parallel=True)
+    print("searched strategy:", getattr(model, "_search_report", None))
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 8, size=4 * bs).astype(np.int32)
+    x = rng.normal(size=(4 * bs, 64)).astype(np.float32)
+    model.fit(x, y)
+    return model
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=4)
+    a = p.parse_args()
+    main(a.devices)

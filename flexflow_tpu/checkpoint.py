@@ -26,16 +26,28 @@ import jax
 import numpy as np
 
 
+# One long-lived manager per directory: closing a manager joins its
+# background write (orbax CheckpointManager.close() calls
+# wait_until_finished()), which would make every save synchronous and
+# rebuild thread pools per call.
+_managers: Dict[str, Any] = {}
+
+
 def _manager(directory: str, max_to_keep: Optional[int] = 3):
     import orbax.checkpoint as ocp
 
-    return ocp.CheckpointManager(
-        os.path.abspath(directory),
-        options=ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
-            enable_async_checkpointing=True,
-        ),
-    )
+    directory = os.path.abspath(directory)
+    mgr = _managers.get(directory)
+    if mgr is None:
+        mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=True,
+            ),
+        )
+        _managers[directory] = mgr
+    return mgr
 
 
 def save_train_state(
@@ -45,21 +57,27 @@ def save_train_state(
     *,
     wait: bool = False,
 ) -> None:
-    """Save a train-state pytree (async unless ``wait``)."""
+    """Save a train-state pytree (async unless ``wait``: the write runs
+    in orbax's background thread while training continues)."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory)
     mgr.save(int(step), args=ocp.args.StandardSave(state))
     if wait:
         mgr.wait_until_finished()
-    mgr.close()
+
+
+def wait_until_finished(directory: str) -> None:
+    """Join any in-flight async save for ``directory``."""
+    mgr = _managers.get(os.path.abspath(directory))
+    if mgr is not None:
+        mgr.wait_until_finished()
 
 
 def latest_step(directory: str) -> Optional[int]:
-    mgr = _manager(directory)
-    step = mgr.latest_step()
-    mgr.close()
-    return step
+    if not os.path.isdir(directory):  # don't create dirs on a read query
+        return None
+    return _manager(directory).latest_step()
 
 
 def restore_train_state(
@@ -73,14 +91,15 @@ def restore_train_state(
     model — each host loads only its own shards)."""
     import orbax.checkpoint as ocp
 
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(f"no checkpoint directory: {directory}")
     mgr = _manager(directory)
     if step is None:
         step = mgr.latest_step()
-        assert step is not None, f"no checkpoint found under {directory}"
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {directory}")
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    restored = mgr.restore(int(step), args=ocp.args.StandardRestore(abstract))
-    mgr.close()
-    return restored
+    return mgr.restore(int(step), args=ocp.args.StandardRestore(abstract))
 
 
 def save_params(directory: str, params: Dict[str, Any], *, wait: bool = True):

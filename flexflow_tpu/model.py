@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -846,6 +847,11 @@ class FFModel:
         steps = n // bs
         rng = np.random.default_rng(self.seed)
         perf = PerfMetrics()
+        profiling = self.config.profiling
+        if profiling:
+            from .profiling import StepTimes
+
+            self.step_times = StepTimes()
         with jax.set_mesh(self.mesh):
             for epoch in range(epochs):
                 order = rng.permutation(n) if shuffle else np.arange(n)
@@ -857,6 +863,7 @@ class FFModel:
                     step_rng = jax.random.PRNGKey(
                         self.seed * 1000003 + self._step_count
                     )
+                    t0 = time.perf_counter() if profiling else 0.0
                     (
                         self.params,
                         self.opt_state,
@@ -873,8 +880,16 @@ class FFModel:
                     )
                     self._step_count += 1
                     perf.update(jax.device_get(loss), jax.device_get(mvals))
+                    if profiling:
+                        # device_get above synced the step; wall time
+                        # includes host feed — the number a user can act
+                        # on (reference --profiling prints per-op times)
+                        self.step_times.record(time.perf_counter() - t0)
                 if verbose:
-                    print(f"epoch {epoch}: {perf.report()}")
+                    msg = f"epoch {epoch}: {perf.report()}"
+                    if profiling:
+                        msg += f" | {self.step_times.report()}"
+                    print(msg)
         return perf
 
     def evaluate(
@@ -907,6 +922,21 @@ class FFModel:
             inputs = {self._input_names()[0]: inputs}
         with jax.set_mesh(self.mesh):
             return self._fwd(self.params, self.model_state, inputs)
+
+    # ------------------------------------------------------------------
+    # profiling (reference --profiling per-op timing + Legion Prof)
+
+    def profile_ops(self, iters: int = 5) -> Dict[str, float]:
+        """Per-op on-device forward times in ms (see profiling.profile_ops)."""
+        from .profiling import profile_ops
+
+        return profile_ops(self, iters=iters)
+
+    def profile_trace(self, logdir: str):
+        """jax.profiler capture context: ``with model.profile_trace(d): fit()``."""
+        from .profiling import trace
+
+        return trace(logdir)
 
     # ------------------------------------------------------------------
     # checkpoint / resume (orbax; beyond the reference — SURVEY.md §5

@@ -1,0 +1,91 @@
+"""Profiling / observability.
+
+TPU-native counterpart of the reference's profiling hooks (reference
+``--profiling`` per-op cudaEvent timing printed from kernels,
+``src/ops/kernels/linear_kernels.cu:131-164``; per-request ProfileInfo;
+Legion Prof): per-step wall timing with device sync, per-op on-device
+timing via the search simulator's measured mode, and a
+``jax.profiler`` trace context for xprof-style captures.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class StepTimes:
+    """Per-step wall times of a training/serving loop."""
+
+    times_ms: List[float] = dataclasses.field(default_factory=list)
+
+    def record(self, dt_s: float) -> None:
+        self.times_ms.append(dt_s * 1e3)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.times_ms:
+            return {}
+        a = np.asarray(self.times_ms)
+        return {
+            "steps": len(a),
+            "mean_ms": round(float(a.mean()), 3),
+            "p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p90_ms": round(float(np.percentile(a, 90)), 3),
+            "max_ms": round(float(a.max()), 3),
+        }
+
+    def report(self) -> str:
+        s = self.summary()
+        if not s:
+            return "no steps recorded"
+        return (
+            f"{s['steps']} steps: mean {s['mean_ms']}ms, "
+            f"p50 {s['p50_ms']}ms, p90 {s['p90_ms']}ms, max {s['max_ms']}ms"
+        )
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace capture (view with xprof/tensorboard) — the
+    TPU analog of Legion Prof's ``-lg:prof`` captures."""
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def profile_ops(model, iters: int = 5) -> Dict[str, float]:
+    """Per-op on-device forward timing of a compiled FFModel's graph —
+    the reference's per-op kernel timing under ``--profiling``. Reuses
+    the Unity simulator's measured mode (one jitted program per op, so
+    numbers exclude XLA's whole-graph fusion; treat as relative cost)."""
+    from .core.mesh import MachineSpec
+    from .search.machine_model import TPUChip, TPUTopology
+    from .search.simulator import CostModel
+
+    cm = CostModel(
+        topo=TPUTopology(chip=TPUChip.v5e()), machine=MachineSpec()
+    )
+    out: Dict[str, float] = {}
+    skipped = []
+    for i, node in enumerate(model.graph.topo_order()):
+        if node.op_type in ("input", "weight"):
+            continue
+        try:
+            secs = cm.measure_op(model.graph, node, "REP", iters=iters)
+        except Exception as e:  # ops without a standalone forward
+            skipped.append(f"{node.name or node.op_type}: {e}")
+            continue
+        out[f"{node.name or node.op_type}#{i}"] = round(secs * 1e3, 4)
+    if skipped:
+        import warnings
+
+        warnings.warn(
+            f"profile_ops skipped {len(skipped)} op(s): "
+            + "; ".join(skipped[:3]),
+            stacklevel=2,
+        )
+    return out

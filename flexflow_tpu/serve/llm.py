@@ -176,6 +176,16 @@ class LLM:
     ):
         """Quantize (optionally), shard, and place params — on device,
         or in pinned host memory when offloading on TPU."""
+        if pipelined:
+            from ..core.mesh import PIPE_AXIS
+
+            pp = self.mesh.shape[PIPE_AXIS]
+            if cfg.num_hidden_layers % pp:
+                raise ValueError(
+                    f"pipeline serving needs num_hidden_layers "
+                    f"({cfg.num_hidden_layers}) divisible by the pipe "
+                    f"degree ({pp})"
+                )
         pspecs = family.param_pspecs(cfg, pipeline=pipelined)
         if quantization is not None:
             from .. import quantization as quant

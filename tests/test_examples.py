@@ -1,0 +1,45 @@
+"""The examples double as end-to-end smoke tests (the reference runs
+its examples in tests/training_tests.sh the same way) — all on the
+virtual 8-device CPU mesh."""
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+
+def test_mnist_mlp_single_device():
+    import mnist_mlp
+
+    final = mnist_mlp.main(num_devices=1, epochs=2)
+    assert final["accuracy"] > 0.9
+
+
+def test_mnist_mlp_8dev_profiling(capsys):
+    import mnist_mlp
+
+    final = mnist_mlp.main(num_devices=8, epochs=1, profiling=True)
+    assert final["accuracy"] > 0.8
+    assert "p90" in capsys.readouterr().out  # profiling summary printed
+
+
+def test_llama_serve_example():
+    import llama_serve
+
+    outs = llama_serve.main(tp=2, pp=2)
+    assert outs and all(o.output_tokens for o in outs)
+
+
+def test_moe_train_expert_parallel():
+    import moe_train
+
+    final = moe_train.main(num_devices=8, ep=2, epochs=1)
+    assert final["accuracy"] > 0.5
+
+
+def test_unity_search_example():
+    import unity_search
+
+    model = unity_search.main(num_devices=4)
+    assert model.params is not None

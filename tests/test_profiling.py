@@ -1,0 +1,56 @@
+"""Profiling mode — reference ``--profiling`` per-op timing +
+Legion-Prof-style traces (SURVEY.md §5)."""
+import os
+
+import numpy as np
+
+import flexflow_tpu as ff
+
+
+def _compiled_model(profiling=False):
+    cfg = ff.FFConfig(batch_size=16, epochs=1, num_devices=1,
+                      profiling=profiling)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((16, 8), name="x")
+    t = m.dense(t, 16, activation="relu")
+    t = m.dense(t, 4)
+    t = m.softmax(t)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05))
+    return m
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    return (
+        rng.normal(size=(64, 8)).astype(np.float32),
+        rng.integers(0, 4, size=64).astype(np.int32),
+    )
+
+
+def test_step_times_recorded():
+    m = _compiled_model(profiling=True)
+    x, y = _data()
+    m.fit(x, y, verbose=False)
+    s = m.step_times.summary()
+    assert s["steps"] == 4 and s["mean_ms"] > 0
+    assert "p90" in m.step_times.report()
+
+
+def test_profile_ops_returns_per_op_times():
+    m = _compiled_model()
+    times = m.profile_ops(iters=2)
+    assert times, "no ops measured"
+    assert all(v >= 0 for v in times.values())
+    assert any("dense" in k for k in times)
+
+
+def test_profile_trace_writes_capture(tmp_path):
+    m = _compiled_model()
+    x, y = _data()
+    logdir = str(tmp_path / "trace")
+    with m.profile_trace(logdir):
+        m.fit(x, y, verbose=False)
+    found = []
+    for root, _, files in os.walk(logdir):
+        found += files
+    assert found, "jax.profiler wrote no trace files"
