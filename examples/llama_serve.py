@@ -42,6 +42,7 @@ def main(model_dir=None, tp=1, pp=1, quantization=None):
     )
 
     # --- incremental decoding ---
+    base_params = m.params  # compile may quantize in place; drafts slice raw
     m.compile(sc, quantization=quantization)
     outs = m.generate(prompts, max_new_tokens=16)
     for o in outs:
@@ -60,11 +61,12 @@ def main(model_dir=None, tp=1, pp=1, quantization=None):
     # the draft's layer stack also shards over the pipe axis
     k = max(pp, pp * (m.cfg.num_hidden_layers // (4 * pp)))
     dcfg = dataclasses.replace(m.cfg, num_hidden_layers=k)
-    dparams = dict(m.params)
-    dparams["layers"] = {n: v[:k] for n, v in m.params["layers"].items()}
+    dparams = dict(base_params)
+    dparams["layers"] = {n: v[:k] for n, v in base_params["layers"].items()}
     ssm = SSM(m.family, dcfg, dparams, mesh=mesh)
-    m2 = LLM(m.family, m.cfg, m.params, mesh=mesh, tokenizer=m.tokenizer)
-    m2.compile(sc, ssms=[ssm], spec=SpecConfig(beam_width=2, beam_depth=3))
+    m2 = LLM(m.family, m.cfg, base_params, mesh=mesh, tokenizer=m.tokenizer)
+    m2.compile(sc, ssms=[ssm], spec=SpecConfig(beam_width=2, beam_depth=3),
+               quantization=quantization)
     outs2 = m2.generate(prompts, max_new_tokens=16)
     for o, o2 in zip(outs, outs2):
         assert o.output_tokens == o2.output_tokens, "spec must equal greedy"

@@ -77,7 +77,11 @@ def wait_until_finished(directory: str) -> None:
 def latest_step(directory: str) -> Optional[int]:
     if not os.path.isdir(directory):  # don't create dirs on a read query
         return None
-    return _manager(directory).latest_step()
+    mgr = _manager(directory)
+    # the cached manager's step list is in-memory; re-scan so saves by
+    # ANOTHER process (trainer vs evaluator) are visible
+    mgr.reload()
+    return mgr.latest_step()
 
 
 def restore_train_state(
@@ -95,6 +99,7 @@ def restore_train_state(
         raise FileNotFoundError(f"no checkpoint directory: {directory}")
     mgr = _manager(directory)
     if step is None:
+        mgr.reload()  # see saves from other processes
         step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {directory}")

@@ -1,0 +1,137 @@
+"""Multi-host runtime — the TPU-native replacement for the reference's
+GASNet/UCX + MPI launch path (reference ``MULTI-NODE.md``,
+``CMakeLists.txt:80-90``, ``tests/multinode_helpers/mpi_wrapper*.sh``).
+
+JAX is single-program multi-controller across hosts: every process runs
+the same script, ``initialize()`` wires them into one runtime via the
+coordination service, and ``jax.devices()`` then returns the GLOBAL
+device list — a ``MachineSpec.make_mesh()`` over it spans all hosts,
+with GSPMD compiling cross-host collectives onto ICI within a slice and
+DCN across slices (the ``data`` axis is outermost in
+``core.mesh.AXIS_ORDER`` precisely so DP gradient reductions ride DCN).
+
+Launch (the mpirun analog): one process per host, e.g.
+
+    JAX_COORDINATOR=host0:9955 NPROC=4 PID=$i python train.py
+
+    import flexflow_tpu.distributed as dist
+    dist.initialize()               # env-driven, or pass args explicitly
+    model = ff.FFModel(ff.FFConfig(num_devices=jax.device_count()))
+
+Single-box multi-node emulation (the reference's mpi_wrapper2.sh) works
+on CPU: N processes × JAX_PLATFORMS=cpu each with a virtual device
+count — see tests/test_distributed.py.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Any] = None,
+) -> None:
+    """Join the multi-process runtime (idempotent). Arguments default
+    from env (JAX_COORDINATOR / NPROC / PID) and, on cloud TPU VMs,
+    from the TPU metadata that jax.distributed reads natively."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR")
+    if num_processes is None and os.environ.get("NPROC"):
+        num_processes = int(os.environ["NPROC"])
+    if process_id is None and os.environ.get("PID"):
+        process_id = int(os.environ["PID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def global_batch(arrays: Dict[str, np.ndarray], mesh, pspecs) -> Dict[str, Any]:
+    """Build globally-sharded arrays from host-local data. Every process
+    passes its LOCAL slice of the batch (the reference's per-rank
+    dataloader shard); shapes must tile the global batch."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    out = {}
+    for k, v in arrays.items():
+        spec = pspecs[k] if isinstance(pspecs, dict) else pspecs
+        sharding = NamedSharding(mesh, spec)
+        out[k] = jax.make_array_from_process_local_data(sharding, v)
+    return out
+
+
+def hybrid_mesh(spec, dcn_axes=("data",)):
+    """Mesh for multi-slice topologies: the ``dcn_axes`` map onto slice
+    (process-group) boundaries so their collectives ride DCN, while the
+    remaining axes stay within a slice on ICI (the layout the cost
+    model's ``TPUTopology.dcn_axes`` assumes). Uses
+    ``mesh_utils.create_hybrid_device_mesh``; single-process falls back
+    to ``spec.make_mesh()``."""
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    from .core.mesh import AXIS_ORDER
+
+    if jax.process_count() == 1:
+        return spec.make_mesh()
+    sizes = spec.axis_sizes()
+    n_slices = jax.process_count()
+    dcn_shape, ici_shape = [], []
+    remaining = n_slices
+    for a in AXIS_ORDER:
+        if a in dcn_axes and remaining > 1:
+            d = min(sizes[a], remaining)
+            assert sizes[a] % d == 0 and remaining % d == 0, (
+                f"axis {a} (size {sizes[a]}) must absorb a divisor of the "
+                f"remaining {remaining} slices; got {d}"
+            )
+            dcn_shape.append(d)
+            ici_shape.append(sizes[a] // d)
+            remaining //= d
+        else:
+            dcn_shape.append(1)
+            ici_shape.append(sizes[a])
+    assert remaining == 1, (
+        f"dcn_axes {dcn_axes} too small to cover {n_slices} slices"
+    )
+    # granule = slice only when the devices actually span >1 slice
+    # (multi-slice TPU); single-slice pods and CPU emulation group by
+    # process instead.
+    devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    devs = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_shape),
+        tuple(dcn_shape),
+        devices=devices,
+        process_is_granule=len(slice_ids) <= 1,
+    )
+    return Mesh(devs, AXIS_ORDER)
+
+
+def process_local_slice(n: int) -> slice:
+    """This process's contiguous shard of a length-n leading dim."""
+    if n % jax.process_count():
+        raise ValueError(
+            f"leading dim {n} not divisible by {jax.process_count()} "
+            f"processes — pad or drop the tail explicitly"
+        )
+    per = n // jax.process_count()
+    start = per * jax.process_index()
+    return slice(start, start + per)

@@ -1,0 +1,62 @@
+"""Worker for the 2-process multi-host emulation test (the single-box
+analog of the reference's mpi_wrapper2.sh ranks). Each process gets 2
+virtual CPU devices; together they form a 4-device data-parallel mesh.
+Prints per-epoch losses as one JSON line for the parent to compare."""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import numpy as np
+
+    import flexflow_tpu as ff
+    import flexflow_tpu.distributed as dist
+
+    dist.initialize()  # env-driven: JAX_COORDINATOR / NPROC / PID
+    assert jax.process_count() == int(os.environ["NPROC"])
+    assert jax.device_count() == 4, jax.devices()
+
+    cfg = ff.FFConfig(batch_size=32, epochs=3, num_devices=4, seed=11)
+    model = ff.FFModel(cfg)
+    t = model.create_tensor((32, 16), name="x")
+    t = model.dense(t, 32, activation="relu")
+    t = model.dense(t, 4)
+    t = model.softmax(t)
+    model.compile(optimizer=ff.SGDOptimizer(lr=0.05))
+
+    rng = np.random.default_rng(5)
+    y = rng.integers(0, 4, size=128).astype(np.int32)
+    centers = rng.normal(size=(4, 16)) * 3
+    x = (centers[y] + rng.normal(size=(128, 16))).astype(np.float32)
+
+    losses = []
+    for _ in range(3):
+        perf = model.fit(x, y, epochs=1, shuffle=False, verbose=False)
+        losses.append(float(perf.averages()["loss"]))
+
+    # DCN-aware mesh: the data axis must absorb the process (slice)
+    # boundary so DP reductions ride DCN
+    from flexflow_tpu.core.mesh import MachineSpec
+
+    hm = dist.hybrid_mesh(MachineSpec(data=4), dcn_axes=("data",))
+    assert dict(hm.shape)["data"] == 4, hm.shape
+    col = hm.devices.reshape(2, 2, -1)  # (slice, per-slice data, rest)
+    assert all(
+        len({d.process_index for d in row.ravel()}) == 1 for row in col
+    ), "hybrid mesh rows must not straddle processes"
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()
