@@ -46,6 +46,9 @@ class FFConfig:
     enable_sample_parallel: bool = True
     enable_parameter_parallel: bool = True
     enable_attribute_parallel: bool = True
+    # Calibrate the search cost model with on-device op timings
+    # (reference inner_measure_operator_cost, model.cu:38).
+    search_measured: bool = False
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
 

@@ -92,7 +92,11 @@ def hybrid_mesh(spec, dcn_axes=("data",)):
     if jax.process_count() == 1:
         return spec.make_mesh()
     sizes = spec.axis_sizes()
-    n_slices = jax.process_count()
+    # granule = slice on true multi-slice TPUs (several hosts may share
+    # one slice); otherwise each process is its own DCN granule
+    devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    n_slices = len(slice_ids) if len(slice_ids) > 1 else jax.process_count()
     dcn_shape, ici_shape = [], []
     remaining = n_slices
     for a in AXIS_ORDER:
@@ -111,11 +115,6 @@ def hybrid_mesh(spec, dcn_axes=("data",)):
     assert remaining == 1, (
         f"dcn_axes {dcn_axes} too small to cover {n_slices} slices"
     )
-    # granule = slice only when the devices actually span >1 slice
-    # (multi-slice TPU); single-slice pods and CPU emulation group by
-    # process instead.
-    devices = jax.devices()
-    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
     devs = mesh_utils.create_hybrid_device_mesh(
         tuple(ici_shape),
         tuple(dcn_shape),

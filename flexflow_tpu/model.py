@@ -84,6 +84,8 @@ class FFModel:
         # sharding overrides installed by the parallelize pass
         self._param_pspecs: Optional[Dict[str, Any]] = None
         self._search_report = None
+        # per-node activation constraints (SAMPLE/ATTR searched states)
+        self._act_constraints: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
     # graph construction
@@ -574,6 +576,16 @@ class FFModel:
             outs = op.forward(
                 params.get(node.name, {}), in_vals, self._node_attrs(node), ctx
             )
+            spec = self._act_constraints.get(node.name)
+            if spec is not None:
+                # searched SAMPLE/ATTR states: GSPMD can't infer these
+                # from weight shardings, so pin the output layout
+                outs = tuple(
+                    jax.lax.with_sharding_constraint(o, spec)
+                    if hasattr(o, "ndim") and o.ndim >= len(spec)
+                    else o
+                    for o in outs
+                )
             for i, o in enumerate(outs):
                 vals[(node.id, i)] = o
         out_ref = upto if upto is not None else TensorRef(target, 0)
@@ -651,12 +663,21 @@ class FFModel:
                 training=(comp_mode == TRAINING),
                 budget=budget,
                 alpha=cfgf.search_alpha,
+                measured=cfgf.search_measured,
+                enable_sample=cfgf.enable_sample_parallel,
+                enable_attribute=cfgf.enable_attribute_parallel,
+                # a user-fixed expert degree was already carved out of
+                # the searched device count — don't enumerate it again
+                allow_expert=cfgf.expert_parallelism_degree == 1,
             )
             rewritten = graph2 is not self.graph
             self.graph = graph2
             self._search_report = report
         strategy.stamp(self.graph)
         self._param_pspecs = strategy.weight_pspecs(self.graph)
+        self._act_constraints = strategy.activation_constraints(self.graph)
+        if strategy.machine.expert > 1:
+            cfgf.expert_parallelism_degree = strategy.machine.expert
         cfgf.tensor_parallelism_degree = strategy.machine.model
         cfgf.data_parallelism_degree = (
             cfgf.num_devices

@@ -24,9 +24,12 @@ from .strategy import OpShardingChoice, ParallelStrategy
 from .simulator import CostModel, estimate_graph_cost
 from .substitutions import SUBSTITUTIONS, apply_substitutions, Substitution
 from .placement import placement_dp
+from .planner import PlanReport, plan_decoder_mesh
 from .unity import optimize, mcmc_optimize
 
 __all__ = [
+    "PlanReport",
+    "plan_decoder_mesh",
     "TPUChip",
     "TPUTopology",
     "CollectiveModel",

@@ -42,7 +42,12 @@ def placement_dp(
     back: Dict[int, Dict[str, Dict[int, str]]] = {}
 
     for node in graph.nodes:
-        states = candidate_states(node, machine)
+        states = candidate_states(
+            node,
+            machine,
+            enable_sample=cost_model.enable_sample,
+            enable_attribute=cost_model.enable_attribute,
+        )
         dp[node.id] = {}
         back[node.id] = {}
         for s in states:

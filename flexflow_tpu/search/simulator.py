@@ -78,13 +78,38 @@ _TP_STATES = {
 }
 _ANY = ("REP", "DP")
 
+# Ops whose batch dim can split past the data axis (sample parallelism)
+# and whose dim-1 attribute can split over model (attribute parallelism)
+# — weight-free / elementwise-ish ops where replicated weights make the
+# extra split free (reference enable_sample/attribute_parallel,
+# config.h:160-162).
+_SAMPLE_OK = {
+    "element_unary", "element_binary", "dropout", "softmax", "flat",
+    "reshape", "concat", "split", "pool2d", "batch_norm", "layer_norm",
+    "rms_norm", "cast", "transpose", "reduce",
+}
 
-def candidate_states(node: OpNode, machine: MachineSpec) -> Tuple[str, ...]:
+
+def candidate_states(
+    node: OpNode,
+    machine: MachineSpec,
+    *,
+    enable_sample: bool = True,
+    enable_attribute: bool = True,
+) -> Tuple[str, ...]:
     if node.op_type == "input":
         return ("DP",) if machine.data > 1 else ("REP",)
-    if machine.model > 1 and node.op_type in _TP_STATES:
-        return _ANY + _TP_STATES[node.op_type]
-    return _ANY
+    states = _ANY
+    if machine.model > 1:
+        if node.op_type in _TP_STATES:
+            states = states + _TP_STATES[node.op_type]
+        if node.op_type in _SAMPLE_OK:
+            if enable_sample:
+                states = states + ("SAMPLE",)
+            rank = len(node.out_specs[0].shape) if node.out_specs else 2
+            if enable_attribute and rank >= 3:
+                states = states + ("ATTR",)
+    return states
 
 
 @dataclasses.dataclass
@@ -94,6 +119,9 @@ class CostModel:
     training: bool = True
     # measured-mode memo: (op_type, attrs, shapes, state) -> seconds
     measured: Optional[Dict] = None
+    # reference --enable-sample/attribute-parallel (config.h:160-162)
+    enable_sample: bool = True
+    enable_attribute: bool = True
 
     def __post_init__(self):
         self.coll = CollectiveModel(self.topo)
@@ -117,17 +145,48 @@ class CostModel:
             bytes_moved *= 2.0
         # work divides over the axes this state shards
         div = 1
-        if state in ("DP", "TP_COL", "TP_ROW"):
+        if state in ("DP", "TP_COL", "TP_ROW", "SAMPLE", "ATTR"):
             div *= self.machine.data
-        if state in ("TP_COL", "TP_ROW"):
+        if state in ("TP_COL", "TP_ROW", "SAMPLE", "ATTR"):
             div *= self.machine.model
-        key = None
-        if self.measured is not None:
-            key = (node.op_type, node.attrs, tuple(s.shape for s in in_specs), state)
-            if key in self.measured:
-                return self.measured[key]
+        # expert parallelism: MoE expert compute splits over the expert
+        # axis (reference experts_start_idx/num_experts range sharding)
+        if self.machine.expert > 1 and node.op_type in (
+            "moe", "experts", "group_by", "aggregate"
+        ):
+            div *= self.machine.expert
+        if self.measured:
+            mult = 3.0 if self.training else 1.0
+            shapes = tuple(s.shape for s in in_specs)
+            # exact state measurement wins; else scale the measured
+            # unsharded forward (reference inner_measure_operator_cost
+            # memo) by the shard division and fwd+bwd multiplier
+            state_key = (node.op_type, node.attrs, shapes, state)
+            if state_key in self.measured:
+                return self.measured[state_key] * mult
+            base_key = (node.op_type, node.attrs, shapes, "REP")
+            if base_key in self.measured:
+                return self.measured[base_key] * mult / div
         t = compute_time(self.topo.chip, flops / div, bytes_moved / div)
         return t
+
+    def calibrate(self, graph: Graph, iters: int = 3) -> int:
+        """Measure every op's unsharded forward on the current device
+        (memoized across calls) so op_cost scales real times instead of
+        roofline estimates — the reference's measured simulator mode.
+        Returns the number of ops calibrated."""
+        if self.measured is None:
+            self.measured = {}
+        n = 0
+        for node in graph.nodes:
+            if node.op_type == "input":
+                continue
+            try:
+                self.measure_op(graph, node, "REP", iters=iters)
+                n += 1
+            except Exception:
+                continue
+        return n
 
     def reshard_cost(
         self, graph: Graph, edge_spec, producer_state: str, consumer_state: str
@@ -135,7 +194,20 @@ class CostModel:
         """Collective cost of moving one activation between two op
         sharding states (the priced equivalents of the reference's
         Repartition/Combine/Replicate/Reduction/AllReduce nodes)."""
-        rule = _RESHARD.get((producer_state, consumer_state))
+        if producer_state == consumer_state:
+            rule = _RESHARD.get((producer_state, consumer_state))
+        elif (producer_state, consumer_state) in _RESHARD:
+            rule = _RESHARD[(producer_state, consumer_state)]
+        else:
+            # SAMPLE/ATTR transitions: a batch/attribute repartition over
+            # the model axis — priced as an all-to-all-sized gather of
+            # the per-shard activation (GSPMD materialises a collective
+            # whenever the model-axis layout changes).
+            moves = {"SAMPLE", "ATTR", "TP_COL"}
+            if producer_state in moves or consumer_state in moves:
+                rule = ("model_resplit",)
+            else:
+                rule = None
         if rule is None:
             return 0.0
         act_bytes = _nbytes(edge_spec)
@@ -149,6 +221,13 @@ class CostModel:
         if kind == "all_gather_batch":
             return self.coll.all_gather(
                 act_bytes * self.machine.data, self.machine.data, DATA_AXIS
+            )
+        if kind == "model_resplit":
+            # per-shard slice exchanged across the model axis
+            return self.coll.all_gather(
+                act_bytes / max(1, self.machine.model),
+                self.machine.model,
+                MODEL_AXIS,
             )
         return 0.0
 

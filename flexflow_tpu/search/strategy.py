@@ -39,8 +39,12 @@ from jax.sharding import PartitionSpec as P
 from ..core.graph import Graph
 from ..core.mesh import DATA_AXIS, MODEL_AXIS, MachineSpec
 
-# The per-op sharding state space.
-STATES = ("REP", "DP", "TP_COL", "TP_ROW")
+# The per-op sharding state space. SAMPLE/ATTR are the reference's
+# extra search dims beyond DP/TP (enable_sample_parallel /
+# enable_attribute_parallel, reference config.h:160-162): SAMPLE splits
+# the batch over BOTH mesh axes (weights replicated), ATTR splits a
+# non-batch activation dim (spatial/sequence) over the model axis.
+STATES = ("REP", "DP", "TP_COL", "TP_ROW", "SAMPLE", "ATTR")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,14 +110,33 @@ class ParallelStrategy:
                 d["tp_shard"] = self._tp_kind(node.op_type, state)
                 node.attrs = tuple(sorted(d.items()))
 
-    def activation_pspec(self, node_id: int) -> P:
+    def activation_pspec(self, node_id: int, rank: int = 2) -> P:
         state = self.choices.get(node_id, "DP")
         data = DATA_AXIS if self.machine.data > 1 else None
-        if state == "TP_COL":
-            return P(data, MODEL_AXIS)  # features sharded
+        pad = (None,) * max(0, rank - 2)
+        if state == "TP_COL":  # features (last dim) sharded
+            return P(data, *pad, MODEL_AXIS)
         if state in ("DP", "TP_ROW"):
             return P(data)
+        if state == "SAMPLE":  # batch over both axes
+            both = tuple(a for a in (data, MODEL_AXIS) if a)
+            return P(both if len(both) > 1 else MODEL_AXIS)
+        if state == "ATTR":  # first attribute dim (dim 1) over model
+            return P(data, MODEL_AXIS, *((None,) * max(0, rank - 2)))
         return P()
+
+    def activation_constraints(self, graph: Graph) -> Dict[str, P]:
+        """Per-node-name output constraints for states GSPMD cannot
+        infer from weight shardings alone (SAMPLE/ATTR) — applied by
+        FFModel.run_graph (the executable form of the reference's
+        sample/attribute-parallel MachineViews)."""
+        out: Dict[str, P] = {}
+        for node in graph.nodes:
+            state = self.choices.get(node.id)
+            if state in ("SAMPLE", "ATTR") and node.out_specs:
+                rank = len(node.out_specs[0].shape)
+                out[node.name] = self.activation_pspec(node.id, rank)
+        return out
 
     # ------------------------------------------------------------------
     # (de)serialization — reference --export-strategy/--import-strategy

@@ -29,21 +29,31 @@ from .strategy import ParallelStrategy
 from .substitutions import SUBSTITUTIONS, apply_substitutions
 
 
-def mesh_candidates(num_devices: int, max_model: int = 8) -> List[MachineSpec]:
-    """Factor the device count over (data, model) axis degrees — the
-    search's machine-grid enumeration. Pipeline/seq/expert degrees are
-    driven by explicit config for now (the reference likewise fixes
-    inference PP outside the search)."""
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def mesh_candidates(
+    num_devices: int, max_model: int = 8, *, expert: bool = False
+) -> List[MachineSpec]:
+    """Factor the device count over (data, model[, expert]) axis degrees
+    — the search's machine-grid enumeration (all factorizations, not
+    just powers of two). Expert degrees join the grid when the graph
+    contains MoE ops; pipeline/seq degrees are planned by
+    :mod:`.planner` for stacked-layer models (the reference likewise
+    fixes inference PP outside its search)."""
     out = []
-    d = 1
-    while d <= num_devices:
-        if num_devices % d == 0:
-            model = num_devices // d
-            if model <= max_model or model == num_devices:
-                out.append(MachineSpec(data=d, model=model))
-        d *= 2
-    if not any(m.model == 1 for m in out):
-        out.append(MachineSpec(data=num_devices, model=1))
+    for model in _divisors(num_devices):
+        if model > max_model and model != num_devices:
+            continue
+        rest = num_devices // model
+        if expert:
+            for e in _divisors(rest):
+                out.append(
+                    MachineSpec(data=rest // e, model=model, expert=e)
+                )
+        else:
+            out.append(MachineSpec(data=rest, model=model))
     return out
 
 
@@ -64,16 +74,42 @@ def optimize(
     budget: int = 32,
     alpha: float = 1.05,
     machines: Optional[Iterable[MachineSpec]] = None,
+    measured: bool = False,
+    enable_sample: bool = True,
+    enable_attribute: bool = True,
+    allow_expert: bool = True,
 ) -> Tuple[Graph, ParallelStrategy, SearchReport]:
     """Joint substitution + sharding search. Returns the rewritten graph,
-    the winning strategy, and a report."""
+    the winning strategy, and a report. With ``measured`` the cost model
+    calibrates per-op times on the current device first (the reference's
+    on-device ``inner_measure_operator_cost``, model.cu:38).
+    ``allow_expert=False`` keeps MoE expert degrees out of the grid
+    (when the config fixed the expert degree outside the search)."""
     topo = topo or TPUTopology(chip=TPUChip.v5e(), num_chips=num_devices)
-    machines = list(machines) if machines is not None else mesh_candidates(num_devices)
+    has_moe = any(
+        n.op_type in ("moe", "experts", "group_by") for n in graph.nodes
+    )
+    machines = (
+        list(machines)
+        if machines is not None
+        else mesh_candidates(num_devices, expert=has_moe and allow_expert)
+    )
+
+    # calibrate ONCE — on-device timings are machine-spec independent
+    shared_measured = None
+    if measured:
+        cm0 = CostModel(topo=topo, machine=MachineSpec(), training=training)
+        cm0.calibrate(graph)
+        shared_measured = cm0.measured
 
     best: Optional[Tuple[float, Graph, ParallelStrategy, List[str]]] = None
     evaluated = 0
     for machine in machines:
-        cm = CostModel(topo=topo, machine=machine, training=training)
+        cm = CostModel(
+            topo=topo, machine=machine, training=training,
+            enable_sample=enable_sample, enable_attribute=enable_attribute,
+            measured=shared_measured,
+        )
 
         def cost_fn(g: Graph) -> float:
             return placement_dp(g, cm).estimated_step_time
@@ -119,7 +155,12 @@ def mcmc_optimize(
     best_choices, best_cost = dict(choices), cur
     for _ in range(iters):
         node = rng.choice(nodes)
-        states = candidate_states(node, machine)
+        states = candidate_states(
+            node,
+            machine,
+            enable_sample=cost_model.enable_sample,
+            enable_attribute=cost_model.enable_attribute,
+        )
         new_state = rng.choice(states)
         old_state = choices.get(node.id, "DP")
         if new_state == old_state:
