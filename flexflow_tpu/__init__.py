@@ -15,6 +15,7 @@ from .core import (
     TensorRef,
 )
 from .model import FFModel, Tensor, TRAINING, INFERENCE
+from .data import SingleDataLoader
 from .optimizers import SGDOptimizer, AdamOptimizer
 from . import losses, metrics, initializers
 from . import keras, frontends  # noqa: F401  (import frontends)

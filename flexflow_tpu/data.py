@@ -1,0 +1,125 @@
+"""SingleDataLoader — prefetching batch feed.
+
+Mirrors the reference's ``SingleDataLoader`` (reference
+``src/dataloader/dataloader.cc``, ``dataloader.h:34-125``: full dataset
+in zero-copy memory, per-batch index tasks copy slices to each shard
+ahead of compute). Here the batch assembly (shuffle + gather) runs on a
+native C++ worker thread with a bounded ready-queue
+(``native/dataloader.cpp``), so the host never assembles a batch on the
+step's critical path; a pure-Python fallback covers toolchain-less
+environments. ``FFModel.fit`` accepts a loader in place of (x, y).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .native import load_library
+
+
+class SingleDataLoader:
+    """Iterates (x, y) batches forever; ``batches_per_epoch`` bounds one
+    epoch. X must be float32 (N, F...), y int32 (N,)."""
+
+    def __init__(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        prefetch_depth: int = 2,
+        native: Optional[bool] = None,
+    ):
+        assert len(x) == len(y), (len(x), len(y))
+        self._feat_shape = x.shape[1:]
+        self.x = np.ascontiguousarray(
+            x.reshape(len(x), -1), dtype=np.float32
+        )
+        self.y = np.ascontiguousarray(y, dtype=np.int32)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self._h = None
+        self._lib = None
+        if native is not False:
+            self._lib = load_library("ffdata")
+        if self._lib is not None:
+            lib = self._lib
+            lib.ffdl_create.restype = ctypes.c_void_p
+            lib.ffdl_create.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.ffdl_next.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p
+            ]
+            lib.ffdl_batches_per_epoch.restype = ctypes.c_int64
+            lib.ffdl_batches_per_epoch.argtypes = [ctypes.c_void_p]
+            lib.ffdl_destroy.argtypes = [ctypes.c_void_p]
+            self._h = lib.ffdl_create(
+                self.x.ctypes.data_as(ctypes.c_void_p),
+                self.y.ctypes.data_as(ctypes.c_void_p),
+                len(self.y),
+                self.x.shape[1],
+                batch_size,
+                prefetch_depth,
+                seed,
+                1 if shuffle else 0,
+                0,
+            )
+        else:
+            # pure-Python fallback (no prefetch thread)
+            self._rng_epoch = 0
+            self._cursor = 0
+            self._order = self._perm(0)
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    @property
+    def batches_per_epoch(self) -> int:
+        n, b = len(self.y), self.batch_size
+        return (n + b - 1) // b
+
+    def _perm(self, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(len(self.y))
+        return np.random.default_rng(self.seed + epoch).permutation(len(self.y))
+
+    def next_batch(self) -> Tuple[np.ndarray, np.ndarray]:
+        b, f = self.batch_size, self.x.shape[1]
+        if self._h is not None:
+            out_x = np.empty((b, f), np.float32)
+            out_y = np.empty((b,), np.int32)
+            self._lib.ffdl_next(
+                self._h,
+                out_x.ctypes.data_as(ctypes.c_void_p),
+                out_y.ctypes.data_as(ctypes.c_void_p),
+            )
+        else:
+            n = len(self.y)
+            if self._cursor >= self.batches_per_epoch * b:
+                self._rng_epoch += 1
+                self._cursor = 0
+                self._order = self._perm(self._rng_epoch)
+            idx = [
+                self._order[(self._cursor + i) % n] for i in range(b)
+            ]
+            out_x, out_y = self.x[idx], self.y[idx]
+            self._cursor += b
+        return out_x.reshape((b,) + self._feat_shape), out_y
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        while True:
+            yield self.next_batch()
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None and self._lib is not None:
+            self._lib.ffdl_destroy(self._h)
+            self._h = None

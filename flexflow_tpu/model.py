@@ -86,6 +86,8 @@ class FFModel:
         self._search_report = None
         # per-node activation constraints (SAMPLE/ATTR searched states)
         self._act_constraints: Dict[str, Any] = {}
+        self._compile_args: Optional[Dict[str, Any]] = None
+        self._recompile_state = None
 
     # ------------------------------------------------------------------
     # graph construction
@@ -734,6 +736,15 @@ class FFModel:
                 "quantization=/offload= to serve.LLM.compile (training "
                 "quantization is not supported, matching the reference)"
             )
+        self._compile_args = dict(
+            optimizer=optimizer, loss_type=loss_type, metrics=metrics,
+            comp_mode=comp_mode,
+            # the output Tensor's node ref goes stale once a search (or
+            # a recompile alter) rewrites the graph; a recompile always
+            # re-resolves to the final node instead
+            output=None,
+            auto_parallel=auto_parallel,
+        )
         self.optimizer = optimizer or SGDOptimizer(lr=self.config.learning_rate)
         self.loss_type = loss_type
         self.metrics_names = tuple(metrics)
@@ -850,37 +861,66 @@ class FFModel:
 
     def fit(
         self,
-        x: Union[np.ndarray, Dict[str, np.ndarray]],
-        y: np.ndarray,
+        x: Union[np.ndarray, Dict[str, np.ndarray], "Any"],
+        y: Optional[np.ndarray] = None,
         batch_size: Optional[int] = None,
         epochs: Optional[int] = None,
         shuffle: bool = True,
         verbose: bool = True,
     ) -> PerfMetrics:
-        """Training loop (reference ``FFModel.fit``, flexflow_cffi.py:3537)."""
+        """Training loop (reference ``FFModel.fit``, flexflow_cffi.py:3537).
+        ``x`` may be a :class:`flexflow_tpu.data.SingleDataLoader` (the
+        native prefetching feed) instead of arrays."""
         assert self._train_step is not None, "call compile() first"
-        bs = batch_size or self.config.batch_size
+        from .data import SingleDataLoader
+
+        if isinstance(x, SingleDataLoader):
+            # the loader owns batching/shuffling — conflicting args are
+            # a caller error, not something to silently ignore
+            assert y is None and batch_size is None, (
+                "a SingleDataLoader carries its own labels, batch size "
+                "and shuffle settings; don't pass y/batch_size with one"
+            )
+            loader = x
+            steps = loader.batches_per_epoch
+            name = self._input_names()[0]
+
+            def epoch_batches(_epoch):
+                for _ in range(steps):
+                    xb, yb = loader.next_batch()
+                    yield {name: xb}, yb
+
+        else:
+            assert y is not None, "fit(x, y) requires labels (or a loader)"
+            bs = batch_size or self.config.batch_size
+            names = self._input_names()
+            if not isinstance(x, dict):
+                x = {names[0]: x}
+            n = len(y)
+            steps = n // bs
+            rng = np.random.default_rng(self.seed)
+
+            def epoch_batches(_epoch):
+                order = rng.permutation(n) if shuffle else np.arange(n)
+                for s in range(steps):
+                    idx = order[s * bs : (s + 1) * bs]
+                    yield {k: v[idx] for k, v in x.items()}, y[idx]
+
         epochs = epochs or self.config.epochs
-        names = self._input_names()
-        if not isinstance(x, dict):
-            x = {names[0]: x}
-        n = len(y)
-        steps = n // bs
-        rng = np.random.default_rng(self.seed)
         perf = PerfMetrics()
         profiling = self.config.profiling
         if profiling:
             from .profiling import StepTimes
 
             self.step_times = StepTimes()
-        with jax.set_mesh(self.mesh):
-            for epoch in range(epochs):
-                order = rng.permutation(n) if shuffle else np.arange(n)
-                perf = PerfMetrics()
-                for s in range(steps):
-                    idx = order[s * bs : (s + 1) * bs]
-                    batch = self._shard_batch({k: v[idx] for k, v in x.items()})
-                    yb = self._shard_batch({"y": y[idx]})["y"]
+        for epoch in range(epochs):
+            perf = PerfMetrics()
+            for xb, yb in epoch_batches(epoch):
+                # per-step mesh context: a recompile triggered by
+                # recompile_on_condition may install a NEW mesh mid-epoch
+                with jax.set_mesh(self.mesh):
+                    batch = self._shard_batch(xb)
+                    yb_dev = self._shard_batch({"y": yb})["y"]
                     step_rng = jax.random.PRNGKey(
                         self.seed * 1000003 + self._step_count
                     )
@@ -897,20 +937,21 @@ class FFModel:
                         self.model_state,
                         step_rng,
                         batch,
-                        yb,
+                        yb_dev,
                     )
                     self._step_count += 1
                     perf.update(jax.device_get(loss), jax.device_get(mvals))
-                    if profiling:
-                        # device_get above synced the step; wall time
-                        # includes host feed — the number a user can act
-                        # on (reference --profiling prints per-op times)
-                        self.step_times.record(time.perf_counter() - t0)
-                if verbose:
-                    msg = f"epoch {epoch}: {perf.report()}"
-                    if profiling:
-                        msg += f" | {self.step_times.report()}"
-                    print(msg)
+                self._maybe_recompile()
+                if profiling:
+                    # device_get above synced the step; wall time
+                    # includes host feed — the number a user can act
+                    # on (reference --profiling prints per-op times)
+                    self.step_times.record(time.perf_counter() - t0)
+            if verbose:
+                msg = f"epoch {epoch}: {perf.report()}"
+                if profiling:
+                    msg += f" | {self.step_times.report()}"
+                print(msg)
         return perf
 
     def evaluate(
@@ -943,6 +984,56 @@ class FFModel:
             inputs = {self._input_names()[0]: inputs}
         with jax.set_mesh(self.mesh):
             return self._fwd(self.params, self.model_state, inputs)
+
+    # ------------------------------------------------------------------
+    # recompile-on-condition (reference RecompileState, recompile.h:26-41
+    # + FFModel::recompile_on_condition, model.cc:2789 — the MoE example
+    # uses it to rebalance experts mid-training)
+
+    def recompile_on_condition(self, trigger, alter) -> None:
+        """Register a per-step condition: when ``trigger(model)`` returns
+        True, ``alter(model)`` may mutate the graph/config and the model
+        recompiles in place. Parameters of unchanged layers (same name
+        and shapes) carry over; new/resized layers re-initialize, and
+        optimizer state resets (the reference rebuilds task launchers the
+        same way)."""
+        from .recompile import RecompileState
+
+        self._recompile_state = RecompileState(trigger=trigger, alter=alter)
+
+    def _maybe_recompile(self) -> bool:
+        state = getattr(self, "_recompile_state", None)
+        if state is None or not state.trigger(self):
+            return False
+        state.alter(self)
+        old_params = self.params
+        assert self._compile_args is not None
+        self.compile(**self._compile_args)
+        # carry over parameters whose layer name + leaf shapes survived
+        for name, leaves in (old_params or {}).items():
+            if name not in self.params:
+                continue
+            try:
+                new = self.params[name]
+                if jax.tree.structure(new) == jax.tree.structure(leaves) and all(
+                    a.shape == b.shape
+                    for a, b in zip(jax.tree.leaves(new), jax.tree.leaves(leaves))
+                ):
+                    self.params[name] = jax.tree.map(
+                        lambda old, cur: jax.device_put(old, cur.sharding),
+                        leaves,
+                        new,
+                    )
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"recompile: layer {name!r} could not carry its "
+                    f"weights over ({e}); it re-initialized", stacklevel=2,
+                )
+                continue
+        state.recompilations += 1
+        return True
 
     # ------------------------------------------------------------------
     # profiling (reference --profiling per-op timing + Legion Prof)

@@ -1,0 +1,54 @@
+"""Native (C++) runtime components + on-demand builder.
+
+The reference's runtime around the compute path is C++ (dataloader,
+tokenizer, C API — SURVEY.md §2.1); the TPU framework keeps that split:
+JAX/XLA/Pallas own the compute, these C++ components own the host-side
+runtime hot paths, bound via ctypes (no pybind11 in this image).
+
+Libraries build lazily with g++ into ``_build/`` next to the sources
+and are cached by source mtime.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "_build")
+
+_SOURCES = {
+    "ffdata": ("dataloader.cpp", ["-pthread"]),
+    "fftok": ("gpt_tokenizer.cpp", []),
+}
+
+_loaded = {}
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Build (if stale) and dlopen a native component; None when no
+    toolchain is available (callers fall back to pure Python)."""
+    if name in _loaded:
+        return _loaded[name]
+    src_name, extra = _SOURCES[name]
+    src = os.path.join(_DIR, src_name)
+    out = os.path.join(_BUILD, f"lib{name}.so")
+    try:
+        if (
+            not os.path.exists(out)
+            or os.path.getmtime(out) < os.path.getmtime(src)
+        ):
+            os.makedirs(_BUILD, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", *extra,
+                 src, "-o", out],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        lib = ctypes.CDLL(out)
+    except (OSError, subprocess.CalledProcessError):
+        lib = None
+    _loaded[name] = lib
+    return lib

@@ -1,0 +1,296 @@
+// Native GPT-2-style byte-level BPE tokenizer — the analog of the
+// reference's C++ tokenizer (reference src/runtime/gpt_tokenizer.cc;
+// its main serving path uses the external tokenizers-cpp dep). Flat C
+// ABI for ctypes, self-contained (a minimal JSON-object parser for the
+// {"token": id} vocab format, no third-party deps).
+//
+// Byte-level BPE: text bytes map through the GPT-2 byte->unicode table,
+// words split into (optional-space + letter/digit/other runs), each
+// word merges greedily by lowest merge rank, tokens look up vocab ids.
+// Decode inverts: ids -> token strings -> bytes -> utf8.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 gpt_tokenizer.cpp
+//        -o libfftok.so   (flexflow_tpu/tokenizer.py does this on demand)
+#include <algorithm>
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// GPT-2 bytes_to_unicode: printable bytes map to themselves; the rest
+// map to 256+k codepoints, so every byte has a visible unicode char.
+std::map<uint8_t, std::string> byte_encoder() {
+  std::vector<int> bs;
+  for (int b = '!'; b <= '~'; b++) bs.push_back(b);
+  for (int b = 0xA1; b <= 0xAC; b++) bs.push_back(b);
+  for (int b = 0xAE; b <= 0xFF; b++) bs.push_back(b);
+  std::vector<int> cs = bs;
+  int n = 0;
+  for (int b = 0; b < 256; b++) {
+    if (std::find(bs.begin(), bs.end(), b) == bs.end()) {
+      bs.push_back(b);
+      cs.push_back(256 + n++);
+    }
+  }
+  auto utf8 = [](int cp) {
+    std::string s;
+    if (cp < 0x80) {
+      s += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      s += static_cast<char>(0xC0 | (cp >> 6));
+      s += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      s += static_cast<char>(0xE0 | (cp >> 12));
+      s += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      s += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+    return s;
+  };
+  std::map<uint8_t, std::string> enc;
+  for (size_t i = 0; i < bs.size(); i++) {
+    enc[static_cast<uint8_t>(bs[i])] = utf8(cs[i]);
+  }
+  return enc;
+}
+
+struct Tokenizer {
+  std::unordered_map<std::string, int32_t> vocab;
+  std::unordered_map<int32_t, std::string> inv_vocab;
+  std::unordered_map<std::string, int> ranks;  // "a b" -> rank
+  std::map<uint8_t, std::string> benc;
+  std::unordered_map<std::string, uint8_t> bdec;
+
+  std::vector<std::string> bpe(const std::string &word_units_joined,
+                               const std::vector<std::string> &units) const {
+    std::vector<std::string> parts = units;
+    while (parts.size() > 1) {
+      int best_rank = INT32_MAX;
+      size_t best_i = 0;
+      for (size_t i = 0; i + 1 < parts.size(); i++) {
+        auto it = ranks.find(parts[i] + " " + parts[i + 1]);
+        if (it != ranks.end() && it->second < best_rank) {
+          best_rank = it->second;
+          best_i = i;
+        }
+      }
+      if (best_rank == INT32_MAX) break;
+      std::vector<std::string> merged;
+      for (size_t i = 0; i < parts.size();) {
+        if (i == best_i) {
+          merged.push_back(parts[i] + parts[i + 1]);
+          i += 2;
+        } else {
+          merged.push_back(parts[i]);
+          i += 1;
+        }
+      }
+      parts.swap(merged);
+    }
+    return parts;
+  }
+};
+
+enum CharClass { kLetter, kDigit, kOther, kSpace };
+
+CharClass classify(uint8_t c) {
+  if ((c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || c >= 0x80)
+    return kLetter;  // multibyte utf8 treated as letters
+  if (c >= '0' && c <= '9') return kDigit;
+  if (c == ' ' || c == '\t' || c == '\n' || c == '\r') return kSpace;
+  return kOther;
+}
+
+// Split raw bytes into GPT-2-ish words: a run of same-class bytes,
+// optionally claiming one preceding space. A whitespace run of length
+// k followed by a word keeps its last space as the word prefix and
+// emits the first k-1 spaces as their own word (the \s+(?!\S) rule).
+std::vector<std::string> split_words(const std::string &text) {
+  std::vector<std::string> words;
+  size_t i = 0;
+  while (i < text.size()) {
+    if (classify(text[i]) == kSpace) {
+      size_t j = i;
+      while (j < text.size() && classify(text[j]) == kSpace) j++;
+      size_t extra = (j < text.size()) ? (j - i - 1) : (j - i);
+      if (extra > 0) words.push_back(text.substr(i, extra));
+      i += extra;
+      if (i >= text.size()) break;
+      size_t start = i;  // the single claimed leading space
+      i++;
+      CharClass cls = classify(text[i]);
+      size_t k = i;
+      while (k < text.size() && classify(text[k]) == cls) k++;
+      words.push_back(text.substr(start, k - start));
+      i = k;
+    } else {
+      CharClass cls = classify(text[i]);
+      size_t k = i;
+      while (k < text.size() && classify(text[k]) == cls) k++;
+      words.push_back(text.substr(i, k - i));
+      i = k;
+    }
+  }
+  return words;
+}
+
+// Minimal parser for a flat {"escaped string": int, ...} JSON object.
+bool parse_vocab_json(const std::string &path,
+                      std::unordered_map<std::string, int32_t> &out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string s = ss.str();
+  size_t i = 0;
+  auto skip_ws = [&] {
+    while (i < s.size() && (s[i] == ' ' || s[i] == '\n' || s[i] == '\t' ||
+                            s[i] == '\r' || s[i] == ','))
+      i++;
+  };
+  skip_ws();
+  if (i >= s.size() || s[i] != '{') return false;
+  i++;
+  while (true) {
+    skip_ws();
+    if (i < s.size() && s[i] == '}') return true;
+    if (i >= s.size() || s[i] != '"') return false;
+    i++;
+    std::string key;
+    while (i < s.size() && s[i] != '"') {
+      if (s[i] == '\\' && i + 1 < s.size()) {
+        char c = s[i + 1];
+        if (c == 'u' && i + 5 < s.size()) {
+          int cp = std::stoi(s.substr(i + 2, 4), nullptr, 16);
+          if (cp < 0x80) {
+            key += static_cast<char>(cp);
+          } else if (cp < 0x800) {
+            key += static_cast<char>(0xC0 | (cp >> 6));
+            key += static_cast<char>(0x80 | (cp & 0x3F));
+          } else {
+            key += static_cast<char>(0xE0 | (cp >> 12));
+            key += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+            key += static_cast<char>(0x80 | (cp & 0x3F));
+          }
+          i += 6;
+        } else {
+          if (c == 'n') key += '\n';
+          else if (c == 't') key += '\t';
+          else if (c == 'r') key += '\r';
+          else key += c;  // \" \\ \/
+          i += 2;
+        }
+      } else {
+        key += s[i++];
+      }
+    }
+    i++;  // closing quote
+    skip_ws();
+    if (i >= s.size() || s[i] != ':') return false;
+    i++;
+    skip_ws();
+    size_t j = i;
+    while (j < s.size() && (isdigit(s[j]) || s[j] == '-')) j++;
+    out[key] = static_cast<int32_t>(std::stol(s.substr(i, j - i)));
+    i = j;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void *fftok_create(const char *vocab_json, const char *merges_txt) {
+  auto *t = new Tokenizer;
+  t->benc = byte_encoder();
+  for (auto &kv : t->benc) t->bdec[kv.second] = kv.first;
+  if (!parse_vocab_json(vocab_json, t->vocab)) {
+    delete t;
+    return nullptr;
+  }
+  for (auto &kv : t->vocab) t->inv_vocab[kv.second] = kv.first;
+  std::ifstream mf(merges_txt);
+  if (!mf) {
+    delete t;
+    return nullptr;
+  }
+  std::string line;
+  int rank = 0;
+  while (std::getline(mf, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    t->ranks[line] = rank++;
+  }
+  return t;
+}
+
+int64_t fftok_vocab_size(void *h) {
+  return static_cast<Tokenizer *>(h)->vocab.size();
+}
+
+// Encode utf-8 text into ids; returns count (<= max_len, truncating).
+int64_t fftok_encode(void *h, const char *text, int32_t *out, int64_t max_len) {
+  auto *t = static_cast<Tokenizer *>(h);
+  int64_t n = 0;
+  for (const std::string &word : split_words(text)) {
+    // word bytes -> unicode units
+    std::vector<std::string> units;
+    for (unsigned char c : word) units.push_back(t->benc[c]);
+    if (units.empty()) continue;
+    for (const std::string &tok : t->bpe(word, units)) {
+      auto it = t->vocab.find(tok);
+      if (it == t->vocab.end()) {
+        // unknown merges fall back to per-unit ids
+        for (size_t k = 0; k < tok.size();) {
+          size_t len = 1;
+          unsigned char c = tok[k];
+          if (c >= 0xF0) len = 4;
+          else if (c >= 0xE0) len = 3;
+          else if (c >= 0xC0) len = 2;
+          auto u = t->vocab.find(tok.substr(k, len));
+          if (u != t->vocab.end() && n < max_len) out[n++] = u->second;
+          k += len;
+        }
+        continue;
+      }
+      if (n >= max_len) return n;
+      out[n++] = it->second;
+    }
+  }
+  return n;
+}
+
+// Decode ids into utf-8; returns byte length written (<= buf_len).
+int64_t fftok_decode(void *h, const int32_t *ids, int64_t n, char *buf,
+                     int64_t buf_len) {
+  auto *t = static_cast<Tokenizer *>(h);
+  std::string units;
+  for (int64_t i = 0; i < n; i++) {
+    auto it = t->inv_vocab.find(ids[i]);
+    if (it != t->inv_vocab.end()) units += it->second;
+  }
+  // unicode units -> raw bytes
+  std::string out;
+  for (size_t k = 0; k < units.size();) {
+    size_t len = 1;
+    unsigned char c = units[k];
+    if (c >= 0xF0) len = 4;
+    else if (c >= 0xE0) len = 3;
+    else if (c >= 0xC0) len = 2;
+    auto u = t->bdec.find(units.substr(k, len));
+    if (u != t->bdec.end()) out += static_cast<char>(u->second);
+    k += len;
+  }
+  int64_t m = std::min<int64_t>(out.size(), buf_len);
+  std::memcpy(buf, out.data(), m);
+  return m;
+}
+
+void fftok_destroy(void *h) { delete static_cast<Tokenizer *>(h); }
+
+}  // extern "C"

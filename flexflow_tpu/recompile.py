@@ -1,0 +1,22 @@
+"""Recompile-on-condition — the reference's only dynamic-adaptation
+hook (reference ``include/flexflow/recompile.h:26-41`` RecompileState +
+``FFModel::recompile_on_condition``, model.cc:2789; used by the MoE
+example to rebalance experts mid-training, moe.cc:65-99).
+
+TPU-native meaning: "recompile" = re-lower the (possibly altered) graph
+to fresh jitted step functions. XLA caches compilations by shape, so an
+alter that doesn't change shapes is nearly free; one that does pays one
+compile. Parameters of unchanged layers carry over across the
+recompile (see FFModel._maybe_recompile).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+
+@dataclasses.dataclass
+class RecompileState:
+    trigger: Callable  # (FFModel) -> bool, checked once per train step
+    alter: Callable    # (FFModel) -> None, mutates graph/config
+    recompilations: int = 0

@@ -1,0 +1,72 @@
+"""Native GPT-2-style BPE tokenizer binding.
+
+The reference ships a C++ BPE tokenizer (reference
+``src/runtime/gpt_tokenizer.cc``) used by its tests, with the main
+serving path on the external tokenizers-cpp dependency; the serving
+stack here delegates to HF AutoTokenizer the same way
+(serve/llm.py). This module binds our own C++ implementation
+(``native/gpt_tokenizer.cpp``) for HF-free environments — it reads the
+standard GPT-2 artifact pair (vocab.json + merges.txt).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List
+
+from .native import load_library
+
+
+class GPTTokenizer:
+    def __init__(self, vocab_json: str, merges_txt: str):
+        lib = load_library("fftok")
+        if lib is None:
+            raise RuntimeError("native tokenizer unavailable (no g++?)")
+        lib.fftok_create.restype = ctypes.c_void_p
+        lib.fftok_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.fftok_encode.restype = ctypes.c_int64
+        lib.fftok_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64
+        ]
+        lib.fftok_decode.restype = ctypes.c_int64
+        lib.fftok_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.fftok_vocab_size.restype = ctypes.c_int64
+        lib.fftok_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.fftok_destroy.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._h = lib.fftok_create(
+            vocab_json.encode(), merges_txt.encode()
+        )
+        if not self._h:
+            raise ValueError(
+                f"failed to load tokenizer from {vocab_json} / {merges_txt}"
+            )
+
+    @property
+    def vocab_size(self) -> int:
+        return self._lib.fftok_vocab_size(self._h)
+
+    def encode(self, text: str) -> List[int]:
+        data = text.encode("utf-8")
+        cap = max(16, 2 * len(data))
+        out = (ctypes.c_int32 * cap)()
+        n = self._lib.fftok_encode(self._h, data, out, cap)
+        return list(out[:n])
+
+    def decode(self, ids: List[int]) -> str:
+        n = len(ids)
+        arr = (ctypes.c_int32 * n)(*[int(i) for i in ids])
+        cap = max(64, 16 * n)
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            m = self._lib.fftok_decode(self._h, arr, n, buf, cap)
+            if m < cap:  # m == cap means the C side clamped: grow
+                return buf.raw[:m].decode("utf-8", errors="replace")
+            cap *= 4
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.fftok_destroy(self._h)
+            self._h = None

@@ -1,0 +1,161 @@
+"""Native C++ runtime components: the prefetching SingleDataLoader
+(reference src/dataloader/dataloader.cc) and the GPT-2 byte-level BPE
+tokenizer (reference src/runtime/gpt_tokenizer.cc), both bound via
+ctypes with parity checks against Python/HF references."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import flexflow_tpu as ff
+from flexflow_tpu.data import SingleDataLoader
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(100, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=100).astype(np.int32)
+    return x, y
+
+
+class TestSingleDataLoader:
+    def test_native_backend_used(self, data):
+        x, y = data
+        dl = SingleDataLoader(x, y, 16, shuffle=False)
+        assert dl.native, "g++ is in the image; the C++ path must build"
+
+    def test_sequential_batches_match_source(self, data):
+        x, y = data
+        dl = SingleDataLoader(x, y, 10, shuffle=False)
+        assert dl.batches_per_epoch == 10
+        for s in range(10):
+            xb, yb = dl.next_batch()
+            np.testing.assert_array_equal(xb, x[s * 10 : (s + 1) * 10])
+            np.testing.assert_array_equal(yb, y[s * 10 : (s + 1) * 10])
+        # epoch 2 wraps deterministically
+        xb, yb = dl.next_batch()
+        np.testing.assert_array_equal(xb, x[:10])
+
+    def test_partial_tail_wraps(self, data):
+        x, y = data
+        dl = SingleDataLoader(x, y, 30, shuffle=False)  # 100 = 3*30 + 10
+        assert dl.batches_per_epoch == 4
+        for _ in range(3):
+            dl.next_batch()
+        xb, yb = dl.next_batch()  # rows 90..99 then wrap 0..19
+        np.testing.assert_array_equal(xb[:10], x[90:])
+        np.testing.assert_array_equal(xb[10:], x[:20])
+
+    def test_shuffle_covers_every_row_each_epoch(self, data):
+        x, y = data
+        dl = SingleDataLoader(x, y, 20, shuffle=True, seed=3)
+        seen = []
+        for _ in range(5):
+            xb, _ = dl.next_batch()
+            seen.append(xb)
+        seen = np.concatenate(seen)
+        # every source row appears exactly once (match by unique floats)
+        assert sorted(seen[:, 0].tolist()) == sorted(x[:, 0].tolist())
+
+    def test_prefetch_runs_ahead(self, data):
+        x, y = data
+        dl = SingleDataLoader(x, y, 10, shuffle=False, prefetch_depth=3)
+        time.sleep(0.2)  # worker fills the queue while we sleep
+        import ctypes
+
+        dl._lib.ffdl_ready.restype = ctypes.c_int64
+        dl._lib.ffdl_ready.argtypes = [ctypes.c_void_p]
+        assert dl._lib.ffdl_ready(dl._h) >= 2
+
+    def test_fit_accepts_loader(self, data):
+        x, y = data
+        cfg = ff.FFConfig(batch_size=20, epochs=2, num_devices=1)
+        m = ff.FFModel(cfg)
+        t = m.create_tensor((20, 8), name="x")
+        t = m.dense(t, 16, activation="relu")
+        t = m.dense(t, 4)
+        t = m.softmax(t)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.05))
+        perf = m.fit(
+            SingleDataLoader(x, y, 20, shuffle=False), verbose=False
+        )
+        assert np.isfinite(perf.averages()["loss"])
+
+    def test_python_fallback_matches_native(self, data):
+        x, y = data
+        nat = SingleDataLoader(x, y, 10, shuffle=False)
+        py = SingleDataLoader(x, y, 10, shuffle=False, native=False)
+        assert not py.native
+        for _ in range(12):  # across the epoch wrap
+            nx, ny = nat.next_batch()
+            px, py_ = py.next_batch()
+            np.testing.assert_array_equal(nx, px)
+            np.testing.assert_array_equal(ny, py_)
+
+
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gpt2_files(tmp_path_factory):
+    """Tiny GPT-2-format vocab.json + merges.txt covering 'hello'."""
+    transformers = pytest.importorskip("transformers")
+    from transformers.models.gpt2.tokenization_gpt2 import bytes_to_unicode
+
+    d = tmp_path_factory.mktemp("tok")
+    units = list(bytes_to_unicode().values())
+    vocab = {u: i for i, u in enumerate(units)}
+    # space-prefixed merges first so (Ġ,h) outranks (h,e) and " hello"
+    # becomes one Ġhello token, like real GPT-2 merge tables arrange
+    merges = [
+        "Ġ h", "Ġh e", "Ġhe l", "Ġhel l", "Ġhell o",
+        "h e", "he l", "hel l", "hell o",
+        "1 2",
+    ]
+    extra = ["he", "hel", "hell", "hello",
+             "Ġh", "Ġhe", "Ġhel", "Ġhell", "Ġhello", "12"]
+    for t in extra:
+        vocab[t] = len(vocab)
+    vocab_path = os.path.join(d, "vocab.json")
+    merges_path = os.path.join(d, "merges.txt")
+    with open(vocab_path, "w") as f:
+        json.dump(vocab, f)
+    with open(merges_path, "w") as f:
+        f.write("#version: 0.2\n" + "\n".join(merges) + "\n")
+    return vocab_path, merges_path, vocab
+
+
+class TestGPTTokenizer:
+    def test_merges_and_roundtrip(self, gpt2_files):
+        from flexflow_tpu.tokenizer import GPTTokenizer
+
+        vocab_path, merges_path, vocab = gpt2_files
+        tok = GPTTokenizer(vocab_path, merges_path)
+        assert tok.vocab_size == len(vocab)
+        ids = tok.encode("hello hello")
+        assert ids == [vocab["hello"], vocab["Ġhello"]]
+        assert tok.decode(ids) == "hello hello"
+        # digits merge; mixed word splits at the letter/digit boundary
+        assert tok.encode("hello12") == [vocab["hello"], vocab["12"]]
+
+    def test_matches_hf_gpt2_tokenizer(self, gpt2_files):
+        transformers = pytest.importorskip("transformers")
+        from flexflow_tpu.tokenizer import GPTTokenizer
+
+        vocab_path, merges_path, _ = gpt2_files
+        try:
+            hf = transformers.GPT2TokenizerFast(
+                vocab_file=vocab_path, merges_file=merges_path
+            )
+        except Exception as e:  # no tokenizers backend
+            pytest.skip(f"HF fast tokenizer unavailable: {e}")
+        tok = GPTTokenizer(vocab_path, merges_path)
+        for text in [
+            "hello", " hello", "hello hello", "hello12",
+            "hello, hello!", "x hello  hello",
+        ]:
+            assert tok.encode(text) == hf.encode(text), text
+            assert tok.decode(tok.encode(text)) == text, text
