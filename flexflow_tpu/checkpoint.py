@@ -104,7 +104,15 @@ def restore_train_state(
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {directory}")
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    return mgr.restore(int(step), args=ocp.args.StandardRestore(abstract))
+    try:
+        return mgr.restore(int(step), args=ocp.args.StandardRestore(abstract))
+    except Exception as e:
+        raise type(e)(
+            f"{e}\n(checkpoint pytree structure must match the current "
+            f"model + optimizer — e.g. optimizer state now carries an "
+            f"'lr' scalar; checkpoints saved by older builds need "
+            f"migration)"
+        ) from e
 
 
 def save_params(directory: str, params: Dict[str, Any], *, wait: bool = True):

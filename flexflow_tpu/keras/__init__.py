@@ -25,10 +25,12 @@ from .layers import (
 )
 from .models import Model, Sequential
 from .optimizers import SGD, Adam
+from . import callbacks, datasets, preprocessing  # noqa: F401
 
 __all__ = [
     "Input", "Dense", "Conv2D", "MaxPooling2D", "AveragePooling2D",
     "Flatten", "Dropout", "Activation", "Embedding", "Concatenate", "Add",
     "BatchNormalization", "LayerNormalization",
     "Model", "Sequential", "SGD", "Adam",
+    "callbacks", "datasets", "preprocessing",
 ]

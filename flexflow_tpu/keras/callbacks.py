@@ -1,0 +1,127 @@
+"""Keras callbacks (reference ``python/flexflow/keras/callbacks.py``:
+Callback base, LearningRateScheduler, VerifyMetrics,
+EpochVerifyMetrics) plus the standard EarlyStopping and History."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params: Dict[str, Any] = {}
+        self.validation_data = None
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+
+class History(Callback):
+    """Records per-epoch logs; ``fit`` returns it (keras convention)."""
+
+    def on_train_begin(self, logs=None):
+        self.epoch: List[int] = []
+        self.history: Dict[str, List[float]] = {}
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.epoch.append(epoch)
+        for k, v in (logs or {}).items():
+            self.history.setdefault(k, []).append(v)
+
+
+class LearningRateScheduler(Callback):
+    """reference LearningRateScheduler: ``schedule(epoch) -> lr``. The
+    LR is a device scalar in the optimizer state, so no recompile."""
+
+    def __init__(self, schedule):
+        super().__init__()
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        lr = self.schedule(epoch)
+        if not isinstance(lr, (float, np.floating)):
+            raise ValueError('the "schedule" function should return float')
+        self.model.ffmodel.set_learning_rate(float(lr))
+
+
+class VerifyMetrics(Callback):
+    """reference VerifyMetrics: assert final accuracy above a bar."""
+
+    def __init__(self, accuracy: float):
+        super().__init__()
+        self.accuracy = getattr(accuracy, "value", accuracy)
+
+    def on_train_end(self, logs=None):
+        acc = (logs or {}).get("accuracy", 0.0)
+        assert acc >= self.accuracy, (
+            f"accuracy {acc:.4f} below the verification bar {self.accuracy}"
+        )
+
+
+class EpochVerifyMetrics(Callback):
+    """reference EpochVerifyMetrics: stop early once accuracy clears the
+    bar (early_stop=True)."""
+
+    def __init__(self, accuracy: float, early_stop: bool = True):
+        super().__init__()
+        self.accuracy = getattr(accuracy, "value", accuracy)
+        self.early_stop = early_stop
+
+    def on_epoch_end(self, epoch, logs=None):
+        acc = (logs or {}).get("accuracy", 0.0)
+        if self.early_stop and acc >= self.accuracy:
+            self.model.stop_training = True
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving."""
+
+    def __init__(self, monitor: str = "loss", min_delta: float = 0.0,
+                 patience: int = 0, mode: str = "auto"):
+        super().__init__()
+        self.monitor = monitor
+        self.min_delta = abs(min_delta)
+        self.patience = patience
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.best = -np.inf if self.mode == "max" else np.inf
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        improved = (
+            cur > self.best + self.min_delta
+            if self.mode == "max"
+            else cur < self.best - self.min_delta
+        )
+        if improved:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.model.stop_training = True

@@ -1,0 +1,11 @@
+"""Keras-style dataset loaders (reference
+``python/flexflow/keras/datasets/``: mnist, cifar10, reuters).
+
+This environment has no network egress, so each loader reads the
+standard cached artifact from a local path (``~/.keras/datasets`` or
+``path=``) when present — the exact files keras would have downloaded —
+and otherwise falls back to a deterministic synthetic set with the real
+shapes/dtypes so examples and tests run anywhere. The return contract
+matches tf.keras: ``(x_train, y_train), (x_test, y_test)``.
+"""
+from . import cifar10, mnist, reuters  # noqa: F401

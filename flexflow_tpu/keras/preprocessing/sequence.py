@@ -1,0 +1,82 @@
+"""Sequence preprocessing (reference keras ``preprocessing/sequence.py``
+API: pad_sequences, make_sampling_table, skipgrams)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def pad_sequences(
+    sequences: Sequence[Sequence[int]],
+    maxlen: Optional[int] = None,
+    dtype="int32",
+    padding: str = "pre",
+    truncating: str = "pre",
+    value: float = 0.0,
+) -> np.ndarray:
+    """tf.keras-compatible padding/truncation to (N, maxlen)."""
+    lengths = [len(s) for s in sequences]
+    if maxlen is None:
+        maxlen = max(lengths) if lengths else 0
+    out = np.full((len(sequences), maxlen), value, dtype=dtype)
+    for i, seq in enumerate(sequences):
+        seq = list(seq)
+        if len(seq) > maxlen:
+            seq = seq[-maxlen:] if truncating == "pre" else seq[:maxlen]
+        if not seq:
+            continue
+        if padding == "pre":
+            out[i, -len(seq):] = seq
+        else:
+            out[i, : len(seq)] = seq
+    return out
+
+
+def make_sampling_table(size: int, sampling_factor: float = 1e-5) -> np.ndarray:
+    """Word-rank -> keep-probability table (word2vec subsampling), same
+    formula as keras_preprocessing."""
+    gamma = 0.577
+    rank = np.arange(size)
+    rank[0] = 1
+    inv_fq = rank * (np.log(rank) + gamma) + 0.5 - 1.0 / (12.0 * rank)
+    f = sampling_factor * inv_fq
+    return np.minimum(1.0, f / np.sqrt(f))
+
+
+def skipgrams(
+    sequence: Sequence[int],
+    vocabulary_size: int,
+    window_size: int = 4,
+    negative_samples: float = 1.0,
+    shuffle: bool = True,
+    seed: Optional[int] = None,
+):
+    """(word, context) skip-gram pairs with negative sampling."""
+    rng = np.random.default_rng(seed)
+    couples: List[List[int]] = []
+    labels: List[int] = []
+    for i, wi in enumerate(sequence):
+        if not wi:
+            continue
+        lo = max(0, i - window_size)
+        hi = min(len(sequence), i + window_size + 1)
+        for j in range(lo, hi):
+            if j == i or not sequence[j]:
+                continue
+            couples.append([wi, sequence[j]])
+            labels.append(1)
+    if negative_samples > 0:
+        n_neg = int(len(labels) * negative_samples)
+        words = [c[0] for c in couples]
+        rng.shuffle(words)
+        for k in range(n_neg):
+            couples.append(
+                [words[k % len(words)], int(rng.integers(1, vocabulary_size))]
+            )
+            labels.append(0)
+    if shuffle:
+        order = rng.permutation(len(couples))
+        couples = [couples[i] for i in order]
+        labels = [labels[i] for i in order]
+    return couples, labels

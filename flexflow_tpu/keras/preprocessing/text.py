@@ -1,0 +1,92 @@
+"""Text preprocessing (reference keras ``preprocessing/text.py`` API:
+Tokenizer with fit_on_texts / texts_to_sequences / texts_to_matrix)."""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_FILTERS = '!"#$%&()*+,-./:;<=>?@[\\]^_`{|}~\t\n'
+
+
+def text_to_word_sequence(
+    text: str, filters: str = _FILTERS, lower: bool = True, split: str = " "
+) -> List[str]:
+    if lower:
+        text = text.lower()
+    table = str.maketrans({c: split for c in filters})
+    return [w for w in text.translate(table).split(split) if w]
+
+
+class Tokenizer:
+    """Word-index tokenizer: index 0 reserved, 1 = OOV when set."""
+
+    def __init__(self, num_words: Optional[int] = None,
+                 filters: str = _FILTERS, lower: bool = True,
+                 split: str = " ", oov_token: Optional[str] = None):
+        self.num_words = num_words
+        self.filters = filters
+        self.lower = lower
+        self.split = split
+        self.oov_token = oov_token
+        self.word_counts: collections.OrderedDict = collections.OrderedDict()
+        self.word_index: Dict[str, int] = {}
+        self.index_word: Dict[int, str] = {}
+        self.document_count = 0
+
+    def fit_on_texts(self, texts: Sequence[str]) -> None:
+        for text in texts:
+            self.document_count += 1
+            for w in text_to_word_sequence(
+                text, self.filters, self.lower, self.split
+            ):
+                self.word_counts[w] = self.word_counts.get(w, 0) + 1
+        sorted_words = [
+            w for w, _ in sorted(
+                self.word_counts.items(), key=lambda kv: -kv[1]
+            )
+        ]
+        if self.oov_token is not None:
+            sorted_words = [self.oov_token] + sorted_words
+        self.word_index = {w: i + 1 for i, w in enumerate(sorted_words)}
+        self.index_word = {i: w for w, i in self.word_index.items()}
+
+    def texts_to_sequences(self, texts: Sequence[str]) -> List[List[int]]:
+        oov = self.word_index.get(self.oov_token) if self.oov_token else None
+        out = []
+        for text in texts:
+            seq = []
+            for w in text_to_word_sequence(
+                text, self.filters, self.lower, self.split
+            ):
+                idx = self.word_index.get(w)
+                if idx is None:
+                    if oov is not None:
+                        seq.append(oov)
+                    continue
+                if self.num_words and idx >= self.num_words:
+                    if oov is not None:
+                        seq.append(oov)
+                    continue
+                seq.append(idx)
+            out.append(seq)
+        return out
+
+    def texts_to_matrix(self, texts: Sequence[str], mode: str = "binary"):
+        n = self.num_words or (len(self.word_index) + 1)
+        m = np.zeros((len(texts), n), np.float32)
+        for i, seq in enumerate(self.texts_to_sequences(texts)):
+            if not seq:
+                continue
+            counts = collections.Counter(seq)
+            for idx, c in counts.items():
+                if mode == "binary":
+                    m[i, idx] = 1.0
+                elif mode == "count":
+                    m[i, idx] = c
+                elif mode == "freq":
+                    m[i, idx] = c / len(seq)
+                else:
+                    raise ValueError(mode)
+        return m

@@ -898,7 +898,10 @@ class FFModel:
                 x = {names[0]: x}
             n = len(y)
             steps = n // bs
-            rng = np.random.default_rng(self.seed)
+            # seed with the step counter so repeated fit() calls (keras'
+            # per-epoch loop, checkpoint resume) continue the shuffle
+            # sequence instead of replaying the first permutation
+            rng = np.random.default_rng(self.seed + self._step_count)
 
             def epoch_batches(_epoch):
                 order = rng.permutation(n) if shuffle else np.arange(n)
@@ -1007,8 +1010,14 @@ class FFModel:
             return False
         state.alter(self)
         old_params = self.params
+        old_lr = (self.opt_state or {}).get("lr")
         assert self._compile_args is not None
         self.compile(**self._compile_args)
+        if old_lr is not None and "lr" in self.opt_state:
+            # a scheduler-set LR survives the recompile
+            self.opt_state["lr"] = jax.device_put(
+                old_lr, self.opt_state["lr"].sharding
+            )
         # carry over parameters whose layer name + leaf shapes survived
         for name, leaves in (old_params or {}).items():
             if name not in self.params:
@@ -1089,6 +1098,17 @@ class FFModel:
 
     # ------------------------------------------------------------------
     # weight access (reference ParallelTensorBase::get_tensor/set_tensor)
+
+    def set_learning_rate(self, lr: float) -> None:
+        """Change the LR in place (device scalar in opt_state — no
+        recompile; the reference's ``Optimizer::set_learning_rate``)."""
+        assert self.opt_state is not None and "lr" in self.opt_state, (
+            "call compile() first"
+        )
+        cur = self.opt_state["lr"]
+        self.opt_state["lr"] = jax.device_put(
+            jnp.asarray(lr, jnp.float32), cur.sharding
+        )
 
     def get_weights(self, layer_name: str):
         return jax.device_get(self.params[layer_name])

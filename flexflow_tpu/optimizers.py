@@ -19,6 +19,11 @@ import jax.numpy as jnp
 
 
 class Optimizer:
+    """The learning rate lives in ``opt_state["lr"]`` (a device scalar),
+    not baked into the compiled step — so schedulers
+    (keras.callbacks.LearningRateScheduler, the reference
+    ``Optimizer::set_learning_rate``) change it without a re-jit."""
+
     def init(self, params) -> Any:
         raise NotImplementedError
 
@@ -38,17 +43,19 @@ class SGDOptimizer(Optimizer):
     weight_decay: float = 0.0
 
     def init(self, params):
-        if self.momentum == 0.0:
-            return {}
-        return {"v": jax.tree.map(jnp.zeros_like, params)}
+        state = {"lr": jnp.asarray(self.lr, jnp.float32)}
+        if self.momentum != 0.0:
+            state["v"] = jax.tree.map(jnp.zeros_like, params)
+        return state
 
     def update(self, grads, opt_state, params):
         wd = self.weight_decay
+        lr = opt_state["lr"]
 
         if self.momentum == 0.0:
             def upd(p, g):
                 g = g + wd * p if wd else g
-                return (p - self.lr * g).astype(p.dtype)
+                return (p - lr * g).astype(p.dtype)
 
             return jax.tree.map(upd, params, grads), opt_state
 
@@ -56,12 +63,12 @@ class SGDOptimizer(Optimizer):
             g = g + wd * p if wd else g
             v_new = self.momentum * v + g
             step = g + self.momentum * v_new if self.nesterov else v_new
-            return (p - self.lr * step).astype(p.dtype), v_new
+            return (p - lr * step).astype(p.dtype), v_new
 
         flat = jax.tree.map(upd, params, grads, opt_state["v"])
         new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
         new_v = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
-        return new_params, {"v": new_v}
+        return new_params, {"lr": opt_state["lr"], "v": new_v}
 
 
 @dataclasses.dataclass
@@ -78,6 +85,7 @@ class AdamOptimizer(Optimizer):
     def init(self, params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
         return {
+            "lr": jnp.asarray(self.lr, jnp.float32),
             "m": jax.tree.map(zeros, params),
             "v": jax.tree.map(zeros, params),
             "step": jnp.zeros((), jnp.int32),
@@ -88,7 +96,7 @@ class AdamOptimizer(Optimizer):
         b1, b2 = self.beta1, self.beta2
         # Bias-corrected step size (reference optimizer.cc next_* updates).
         alpha_t = (
-            self.lr
+            opt_state["lr"]
             * jnp.sqrt(1.0 - jnp.power(b2, step.astype(jnp.float32)))
             / (1.0 - jnp.power(b1, step.astype(jnp.float32)))
         )
@@ -109,4 +117,6 @@ class AdamOptimizer(Optimizer):
         pick = lambda i: jax.tree.map(
             lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple)
         )
-        return pick(0), {"m": pick(1), "v": pick(2), "step": step}
+        return pick(0), {
+            "lr": opt_state["lr"], "m": pick(1), "v": pick(2), "step": step,
+        }
