@@ -1,0 +1,193 @@
+"""CLI driver — ``python -m flexflow_tpu <cmd>``.
+
+The reference ships C++ app drivers plus a ``flexflow_python``
+interpreter launcher (reference ``inference/incr_decoding``,
+``inference/spec_infer/spec_infer.cc:260``, ``python/flexflow/core/
+flexflow_python``, flags parsed by ``FFConfig::parse_args``
+model.cc:4049-4200). The TPU framework's equivalents:
+
+  train        MLP training smoke (the mnist_mlp example)
+  serve        incremental decoding or SpecInfer over an HF checkpoint
+               directory (or a tiny random model when omitted)
+  search       Unity auto-parallel compile + strategy/dot export
+  bench        the headline benchmark (bench.py)
+
+Reference-style degree flags are accepted with either one or two
+leading dashes (-tensor-parallelism-degree / --tensor-parallelism-degree).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _degree_args(p: argparse.ArgumentParser):
+    for flag, dest in [
+        ("tensor-parallelism-degree", "tp"),
+        ("pipeline-parallelism-degree", "pp"),
+        ("data-parallelism-degree", "dp"),
+        ("expert-parallelism-degree", "ep"),
+        ("sequence-parallelism-degree", "sp"),
+    ]:
+        p.add_argument(
+            f"--{flag}", f"-{flag}", dest=dest, type=int, default=1
+        )
+
+
+def _load_repo_module(relpath: str, name: str):
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, relpath)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def cmd_train(args):
+    mnist_mlp = _load_repo_module("examples/mnist_mlp.py", "mnist_mlp")
+    mnist_mlp.main(num_devices=args.devices, epochs=args.epochs,
+                   profiling=args.profiling)
+
+
+def cmd_serve(args):
+    import jax
+
+    from .core.mesh import MachineSpec
+    from .serve import GenerationConfig, ServingConfig, SpecConfig
+    from .serve.llm import LLM, SSM
+
+    n = args.tp * args.pp * args.ep * args.sp * max(1, args.dp)
+    mesh = MachineSpec.from_degrees(
+        n, tensor=args.tp, pipeline=args.pp, expert=args.ep,
+        sequence=args.sp,
+    ).make_mesh(jax.devices()[:n])
+    if args.model_dir:
+        llm = LLM.from_pretrained(args.model_dir, mesh=mesh)
+    else:
+        import jax.numpy as jnp
+
+        from .models import llama
+
+        cfg = llama.LLaMAConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=344,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=4, max_position_embeddings=512,
+            dtype=jnp.float32,
+        )
+        llm = LLM(llama, cfg, mesh=mesh)
+    sc = ServingConfig(
+        max_requests_per_batch=args.max_requests_per_batch,
+        max_sequence_length=args.max_sequence_length,
+        kernels="pallas" if args.pallas else "xla",
+    )
+    ssms = []
+    spec = None
+    if args.ssm_dir or args.spec:
+        if args.ssm_dir:
+            ssms = [SSM.from_pretrained(args.ssm_dir, mesh=mesh)]
+        else:  # layer-skip self-draft
+            import dataclasses
+
+            k = max(args.pp, llm.cfg.num_hidden_layers // 4)
+            dcfg = dataclasses.replace(llm.cfg, num_hidden_layers=k)
+            dparams = dict(llm.params)
+            dparams["layers"] = {
+                nme: v[:k] for nme, v in llm.params["layers"].items()
+            }
+            ssms = [SSM(llm.family, dcfg, dparams, mesh=mesh)]
+        spec = SpecConfig(beam_width=2, beam_depth=4)
+    llm.compile(sc, ssms=ssms, spec=spec,
+                quantization=args.quantization, offload=args.offload)
+    prompts = args.prompt or [[3, 17, 91, 42, 7]]
+    gen = GenerationConfig(num_beams=args.num_beams)
+    outs = llm.generate(
+        prompts,
+        gen=gen if args.num_beams > 1 else None,
+        max_new_tokens=args.max_new_tokens,
+    )
+    for o in outs:
+        p = o.profile
+        print(o.output_text or o.output_tokens)
+        print(
+            f"  [steps={p.llm_decoding_steps} accepted={p.accepted_tokens} "
+            f"latency={p.latency_s:.2f}s]"
+        )
+
+
+def cmd_search(args):
+    import numpy as np
+
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(
+        batch_size=8 * args.devices, num_devices=args.devices,
+        search_budget=args.budget, search_measured=args.measured,
+        export_strategy_file=args.export_strategy,
+    )
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((cfg.batch_size, 64), name="x")
+    for _ in range(args.layers):
+        t = m.dense(t, args.hidden, activation="relu")
+    t = m.dense(t, 8)
+    t = m.softmax(t)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05), auto_parallel=True)
+    print("strategy:", m._search_report.machine)
+    print("predicted step:", f"{m._search_report.best_cost*1e3:.3f} ms")
+    if args.export_dot:
+        m.export_dot(args.export_dot)
+        print("dot written to", args.export_dot)
+
+
+def cmd_bench(args):
+    _load_repo_module("bench.py", "bench").main()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="flexflow_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="MLP training smoke run")
+    t.add_argument("--devices", type=int, default=1)
+    t.add_argument("--epochs", type=int, default=2)
+    t.add_argument("--profiling", action="store_true")
+    t.set_defaults(fn=cmd_train)
+
+    s = sub.add_parser("serve", help="incremental / speculative serving")
+    s.add_argument("--model-dir", default=None)
+    s.add_argument("--ssm-dir", default=None)
+    s.add_argument("--spec", action="store_true",
+                   help="SpecInfer with a layer-skip self-draft")
+    s.add_argument("--prompt", action="append", default=None)
+    s.add_argument("--max-new-tokens", type=int, default=32)
+    s.add_argument("--max-requests-per-batch", type=int, default=4)
+    s.add_argument("--max-sequence-length", type=int, default=512)
+    s.add_argument("--num-beams", type=int, default=1)
+    s.add_argument("--quantization", choices=["int8", "int4"], default=None)
+    s.add_argument("--offload", action="store_true")
+    s.add_argument("--pallas", action="store_true")
+    _degree_args(s)
+    s.set_defaults(fn=cmd_serve)
+
+    q = sub.add_parser("search", help="Unity auto-parallel compile")
+    q.add_argument("--devices", type=int, default=4)
+    q.add_argument("--layers", type=int, default=3)
+    q.add_argument("--hidden", type=int, default=256)
+    q.add_argument("--budget", type=int, default=32)
+    q.add_argument("--measured", action="store_true")
+    q.add_argument("--export-strategy", default=None)
+    q.add_argument("--export-dot", default=None)
+    q.set_defaults(fn=cmd_search)
+
+    b = sub.add_parser("bench", help="headline benchmark (one JSON line)")
+    b.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

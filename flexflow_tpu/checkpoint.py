@@ -106,13 +106,15 @@ def restore_train_state(
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
     try:
         return mgr.restore(int(step), args=ocp.args.StandardRestore(abstract))
-    except Exception as e:
-        raise type(e)(
-            f"{e}\n(checkpoint pytree structure must match the current "
-            f"model + optimizer — e.g. optimizer state now carries an "
-            f"'lr' scalar; checkpoints saved by older builds need "
-            f"migration)"
-        ) from e
+    except (ValueError, KeyError, TypeError) as e:
+        # structure mismatches surface as these; add the likely cause
+        # without clobbering the original exception type/args
+        e.add_note(
+            "(checkpoint pytree structure must match the current model "
+            "+ optimizer — e.g. optimizer state carries an 'lr' scalar "
+            "since r3; checkpoints saved by older builds need migration)"
+        )
+        raise
 
 
 def save_params(directory: str, params: Dict[str, Any], *, wait: bool = True):

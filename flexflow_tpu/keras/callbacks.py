@@ -123,5 +123,5 @@ class EarlyStopping(Callback):
             self.wait = 0
         else:
             self.wait += 1
-            if self.wait > self.patience:
+            if self.wait >= self.patience:  # tf.keras semantics
                 self.model.stop_training = True

@@ -1,0 +1,64 @@
+"""Leveled category loggers — the analog of the reference's Legion
+logger categories (``log_measure`` operator.h:14, ``log_dp`` graph.h:27,
+``log_req_mgr``, ``log_xfers`` …) with ``-level cat=verbosity`` control.
+
+Usage::
+
+    from flexflow_tpu.logging_utils import get_logger
+    log = get_logger("search")
+    log.debug("evaluated %d candidates", n)
+
+Verbosity comes from ``FF_LOG`` (e.g. ``FF_LOG=search=debug,serve=info``
+or ``FF_LOG=debug`` for everything), mirroring the reference's
+``-level`` flags.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict
+
+_CONFIGURED = False
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def _parse_ff_log() -> Dict[str, int]:
+    spec = os.environ.get("FF_LOG", "")
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            cat, lvl = part.split("=", 1)
+            out[cat.strip()] = _LEVELS.get(lvl.strip().lower(), logging.INFO)
+        else:
+            out["*"] = _LEVELS.get(part.lower(), logging.INFO)
+    return out
+
+
+def get_logger(category: str) -> logging.Logger:
+    """Category logger ``flexflow_tpu.<category>`` honoring FF_LOG."""
+    global _CONFIGURED
+    logger = logging.getLogger(f"flexflow_tpu.{category}")
+    if not _CONFIGURED:
+        root = logging.getLogger("flexflow_tpu")
+        if not root.handlers:
+            h = logging.StreamHandler()
+            h.setFormatter(
+                logging.Formatter("[%(name)s %(levelname).1s] %(message)s")
+            )
+            root.addHandler(h)
+        root.setLevel(logging.WARNING)
+        _CONFIGURED = True
+    levels = _parse_ff_log()
+    if category in levels:
+        logger.setLevel(levels[category])
+    elif "*" in levels:
+        logger.setLevel(levels["*"])
+    return logger

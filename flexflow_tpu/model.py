@@ -676,6 +676,7 @@ class FFModel:
             self.graph = graph2
             self._search_report = report
         strategy.stamp(self.graph)
+        self._strategy = strategy
         self._param_pspecs = strategy.weight_pspecs(self.graph)
         self._act_constraints = strategy.activation_constraints(self.graph)
         if strategy.machine.expert > 1:
@@ -1098,6 +1099,19 @@ class FFModel:
 
     # ------------------------------------------------------------------
     # weight access (reference ParallelTensorBase::get_tensor/set_tensor)
+
+    def export_dot(self, path: str, strategy=None) -> None:
+        """Write the (strategy-colored, when available) computation graph
+        as graphviz dot — reference ``--export-strategy-computation-
+        graph-file`` (config.h:173-175)."""
+        strategy = strategy or getattr(self, "_strategy", None)
+        text = (
+            strategy.to_dot(self.graph)
+            if strategy is not None
+            else self.graph.to_dot()
+        )
+        with open(path, "w") as f:
+            f.write(text)
 
     def set_learning_rate(self, lr: float) -> None:
         """Change the LR in place (device scalar in opt_state — no

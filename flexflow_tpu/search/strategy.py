@@ -138,6 +138,29 @@ class ParallelStrategy:
                 out[node.name] = self.activation_pspec(node.id, rank)
         return out
 
+    def to_dot(self, graph: Graph) -> str:
+        """Strategy-colored PCG dot export (reference
+        ``--export-strategy-computation-graph-file``, config.h:173-175 +
+        tools/substitutions_to_dot)."""
+        colors = {
+            "REP": "gray80", "DP": "lightblue", "TP_COL": "salmon",
+            "TP_ROW": "orange", "SAMPLE": "palegreen", "ATTR": "plum",
+        }
+        lines = ["digraph strategy {", "  node [style=filled];"]
+        for n in graph.nodes:
+            # same default every execution path uses (weight_pspecs /
+            # activation_pspec): an unassigned node runs data-parallel
+            state = self.choices.get(n.id, "DP")
+            c = colors.get(state, "white")
+            lines.append(
+                f'  n{n.id} [label="{n.name}\\n{n.op_type} [{state}]" '
+                f'fillcolor="{c}"];'
+            )
+            for r in n.inputs:
+                lines.append(f"  n{r.node_id} -> n{n.id};")
+        lines.append("}")
+        return "\n".join(lines)
+
     # ------------------------------------------------------------------
     # (de)serialization — reference --export-strategy/--import-strategy
 
