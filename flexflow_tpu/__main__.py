@@ -92,7 +92,10 @@ def cmd_serve(args):
         else:  # layer-skip self-draft
             import dataclasses
 
+            # round up to a multiple of pp so the draft stack also
+            # shards over the pipe axis
             k = max(args.pp, llm.cfg.num_hidden_layers // 4)
+            k = ((k + args.pp - 1) // args.pp) * args.pp
             dcfg = dataclasses.replace(llm.cfg, num_hidden_layers=k)
             dparams = dict(llm.params)
             dparams["layers"] = {

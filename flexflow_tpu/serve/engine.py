@@ -204,6 +204,98 @@ class InferenceEngine:
             )
         return toks
 
+    def _get_speculate(self, W: int, D: int):
+        """Whole-tree SSM speculation as ONE compiled program: a scan
+        over beam depths, each feeding the W-wide frontier through
+        serve_step (tree-mask mode), expanding top-W-of-(W*V) children
+        with cumulative logprobs, and writing K/V at the device-computed
+        slack lines (prefix + 1 + d*W + w). Replaces the host round-trip
+        per depth the reference pays once per beam step too
+        (prepare_next_batch_beam); the host fetches the finished tree in
+        a single transfer."""
+        key_id = ("speculate", W, D)
+        if key_id not in self._steps:
+            fn = self._serve_step_fn(all_logits=True)
+            from .sampling import log_softmax
+
+            R = self.num_slots
+            S1 = self.serving.cache_len + 1
+            scratch = self.scratch_pos
+            NEG = -1e30
+
+            def speculate(params, cache, root_tokens, prefix, active):
+                key_pos = jnp.arange(S1, dtype=jnp.int32)
+                # frontier state, beam dim = W; only w0 live at depth 0
+                w_iota = jnp.arange(W, dtype=jnp.int32)
+                f_tok = jnp.where(
+                    (w_iota == 0)[None, :], root_tokens[:, None], 0
+                ).astype(jnp.int32)
+                f_valid = (w_iota == 0)[None, :] & active[:, None]
+                f_cum = jnp.where(f_valid, 0.0, NEG).astype(jnp.float32)
+                f_line = jnp.where(
+                    f_valid, prefix[:, None], scratch
+                ).astype(jnp.int32)
+                committed = key_pos[None, :] < prefix[:, None]  # (R, S1)
+                f_mask = (
+                    committed[:, None, :]
+                    | (key_pos[None, None, :] == f_line[:, :, None])
+                ) & f_valid[:, :, None]
+
+                def body(carry, d):
+                    cache, f_tok, f_cum, f_valid, f_mask, f_line = carry
+                    pos = jnp.where(
+                        f_valid, prefix[:, None] + d, scratch
+                    ).astype(jnp.int32)
+                    logits, cache = fn(
+                        params, cache, f_tok, pos,
+                        jnp.zeros((R,), jnp.int32), f_mask, f_line,
+                    )  # (R, W, V)
+                    V = logits.shape[-1]
+                    logp = log_softmax(logits) + f_cum[:, :, None]
+                    logp = jnp.where(f_valid[:, :, None], logp, NEG)
+                    vals, flat = jax.lax.top_k(logp.reshape(R, W * V), W)
+                    parent = (flat // V).astype(jnp.int32)
+                    token = (flat % V).astype(jnp.int32)
+                    child_valid = (vals > NEG / 2) & active[:, None]
+                    new_line = jnp.where(
+                        child_valid,
+                        prefix[:, None] + 1 + d * W + w_iota[None, :],
+                        scratch,
+                    ).astype(jnp.int32)
+                    parent_mask = jnp.take_along_axis(
+                        f_mask, parent[:, :, None], axis=1
+                    )
+                    new_mask = (
+                        parent_mask
+                        | (key_pos[None, None, :] == new_line[:, :, None])
+                    ) & child_valid[:, :, None]
+                    carry = (cache, token, vals, child_valid, new_mask, new_line)
+                    return carry, (token, parent, vals)
+
+                init = (cache, f_tok, f_cum, f_valid, f_mask, f_line)
+                (cache, *_), (toks, parents, logps) = jax.lax.scan(
+                    body, init, jnp.arange(D, dtype=jnp.int32)
+                )
+                return toks, parents, logps, cache  # each (D, R, W)
+
+            self._steps[key_id] = jax.jit(speculate, donate_argnums=(1,))
+        return self._steps[key_id]
+
+    def run_speculate(self, root_tokens, prefix, active, W: int, D: int):
+        """Dispatch one whole speculation round; returns device arrays
+        (tokens, parents, logps) each (D, R, W). The cache advances in
+        place with every tree node's K/V at its slack line."""
+        with jax.set_mesh(self.mesh):
+            step = self._get_speculate(W, D)
+            toks, parents, logps, self.cache = step(
+                self.params,
+                self.cache,
+                jnp.asarray(root_tokens, jnp.int32),
+                jnp.asarray(prefix, jnp.int32),
+                jnp.asarray(active),
+            )
+        return toks, parents, logps
+
     def run(self, bc: BatchConfig, all_logits: bool = False):
         """Dispatch one step (reference ``InferenceManager::inference``,
         inference_manager.cc:334). Returns logits on device; the cache is

@@ -35,7 +35,6 @@ import numpy as np
 from .batch_config import BatchConfig, GenerationConfig
 from .engine import InferenceEngine
 from .request_manager import Request, RequestManager, RequestStatus
-from .sampling import beam_topk, log_softmax
 
 
 @jax.jit
@@ -229,44 +228,45 @@ class SpecInferManager(RequestManager):
         self, ssm: InferenceEngine, reqs: List[Request]
     ) -> Dict[int, TokenTree]:
         """One SSM's beam expansion (reference prepare_next_batch_beam
-        loop, request_manager.cc:2397-2407): depth × (feed frontier,
-        top-k per beam, prune to beam_width by cumulative logprob)."""
+        loop, request_manager.cc:2397-2407), executed as a single
+        device-side program: the whole depth × top-W expansion runs in
+        one compiled scan (engine.run_speculate) and the host fetches
+        the finished tree in one transfer — no per-depth round trips.
+
+        Trees are built WITHOUT (parent, token) dedup so node index i
+        stays identical to the cache slack line prefix+i the device
+        wrote (duplicates merely occupy verify slots the tree budget
+        already reserves)."""
         W, D = self.spec.beam_width, self.spec.beam_depth
-        trees = {r.request_id: TokenTree(r.tokens[-1]) for r in reqs}
-        frontier = {r.request_id: [0] for r in reqs}
-        for depth in range(D):
-            node_lists = {
-                rid: nodes[:W] for rid, nodes in frontier.items()
-            }
-            bc = self._tree_chunk_batch(ssm, reqs, trees, node_lists, W)
-            logits = ssm.run(bc, all_logits=True)  # (R, W, V)
-            vals, idxs = beam_topk(log_softmax(logits), W)
-            vals = np.asarray(jax.device_get(vals))
-            idxs = np.asarray(jax.device_get(idxs))
-            for req in reqs:
-                rid = req.request_id
-                tree = trees[rid]
-                cands = []
-                for c, node in enumerate(node_lists[rid]):
-                    base = tree.logprobs[node]
-                    for k in range(W):
-                        cands.append(
-                            (
-                                base + float(vals[req.slot, c, k]),
-                                int(idxs[req.slot, c, k]),
-                                node,
-                            )
-                        )
-                cands.sort(key=lambda t: -t[0])
-                new_frontier = []
-                for lp, tok, parent in cands[:W]:
-                    idx, is_new = tree.add(tok, parent, lp)
-                    if is_new:
-                        new_frontier.append(idx)
-                frontier[rid] = new_frontier
-                req.profile.ssm_decoding_steps += 1
-            if all(not f for f in frontier.values()):
-                break
+        R = self.engine.num_slots
+        root = np.zeros((R,), np.int32)
+        prefix = np.full((R,), self.engine.scratch_pos, np.int32)
+        active = np.zeros((R,), bool)
+        for req in reqs:
+            root[req.slot] = req.tokens[-1]
+            prefix[req.slot] = req.n_cached
+            active[req.slot] = True
+        toks, parents, logps = jax.device_get(
+            ssm.run_speculate(root, prefix, active, W, D)
+        )  # one transfer; each (D, R, W)
+        toks, parents, logps = (
+            np.asarray(toks), np.asarray(parents), np.asarray(logps)
+        )
+
+        trees: Dict[int, TokenTree] = {}
+        for req in reqs:
+            s = req.slot
+            tree = TokenTree(int(root[s]))
+            for d in range(D):
+                for w in range(W):
+                    tree.tokens.append(int(toks[d, s, w]))
+                    tree.parents.append(
+                        0 if d == 0 else 1 + (d - 1) * W + int(parents[d, s, w])
+                    )
+                    tree.depths.append(d + 1)
+                    tree.logprobs.append(float(logps[d, s, w]))
+            trees[req.request_id] = tree
+            req.profile.ssm_decoding_steps += D
         return trees
 
     def _grow_trees(self, reqs: List[Request]) -> Dict[int, TokenTree]:
