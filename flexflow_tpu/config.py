@@ -51,6 +51,8 @@ class FFConfig:
     search_measured: bool = False
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
+    # extra declarative rewrite rules (reference --substitution-json)
+    substitution_json_file: Optional[str] = None
 
     # --- perf knobs (reference --fusion/--offload/--4bit-quantization) ---
     fusion: bool = True

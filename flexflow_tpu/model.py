@@ -659,6 +659,13 @@ class FFModel:
                 f"pipe*expert*seq degrees = {fixed}"
             )
             budget = cfgf.search_budget if cfgf.search_budget > 0 else 32
+            extra_rules = None
+            if cfgf.substitution_json_file:
+                from .search.substitutions import load_substitutions_json
+
+                extra_rules = load_substitutions_json(
+                    cfgf.substitution_json_file
+                )
             graph2, strategy, report = unity.optimize(
                 self.graph,
                 cfgf.num_devices // fixed,
@@ -671,6 +678,7 @@ class FFModel:
                 # a user-fixed expert degree was already carved out of
                 # the searched device count — don't enumerate it again
                 allow_expert=cfgf.expert_parallelism_degree == 1,
+                extra_rules=extra_rules,
             )
             rewritten = graph2 is not self.graph
             self.graph = graph2
@@ -1099,6 +1107,71 @@ class FFModel:
 
     # ------------------------------------------------------------------
     # weight access (reference ParallelTensorBase::get_tensor/set_tensor)
+
+    def validate_search(self, iters: int = 5) -> Dict[str, float]:
+        """Compare the Unity search's predicted step time against the
+        real compiled step on the current devices (the closing of the
+        simulator-fidelity loop the reference gets from re-measuring
+        with ``inner_measure_operator_cost``). Returns predicted /
+        measured seconds and their ratio."""
+        assert self._train_step is not None, "call compile() first"
+        assert self._search_report is not None, (
+            "validate_search needs an auto_parallel compile"
+        )
+        bs = self.config.batch_size
+        rng = np.random.default_rng(0)
+        x = {}
+        for i in self.input_nodes:
+            node = self.graph.nodes[i]
+            spec = node.out_specs[0]
+            if "int" in str(spec.dtype):
+                x[node.name] = rng.integers(
+                    0, 8, size=spec.shape
+                ).astype(np.int32)
+            else:
+                x[node.name] = rng.normal(size=spec.shape).astype(np.float32)
+        out_id = self._output_ref.node_id if self._output_ref else -1
+        n_out = self.graph.nodes[out_id].out_specs[0].shape[-1]
+        loss_type = (self._compile_args or {}).get(
+            "loss_type", "sparse_categorical_crossentropy"
+        )
+        if loss_type.startswith("sparse"):
+            y = rng.integers(0, max(2, n_out), size=bs).astype(np.int32)
+        else:  # dense targets (categorical CE / MSE)
+            y = rng.normal(size=(bs, n_out)).astype(np.float32)
+        import time as _time
+
+        # snapshot: timing runs real (donated) optimizer steps on noise;
+        # the trained state must survive this diagnostic untouched
+        live = (self.params, self.opt_state, self.model_state)
+        snap = jax.device_get(live)
+        shardings = jax.tree.map(lambda a: a.sharding, live)
+        with jax.set_mesh(self.mesh):
+            batch = self._shard_batch(x)
+            yb = self._shard_batch({"y": y})["y"]
+            key = jax.random.PRNGKey(0)
+            params, opt, st = live
+            # warm
+            params, opt, st, loss, _ = self._train_step(
+                params, opt, st, key, batch, yb
+            )
+            jax.block_until_ready(loss)
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                params, opt, st, loss, _ = self._train_step(
+                    params, opt, st, key, batch, yb
+                )
+            jax.block_until_ready(loss)
+            measured = (_time.perf_counter() - t0) / iters
+            self.params, self.opt_state, self.model_state = jax.tree.map(
+                jax.device_put, snap, shardings
+            )
+        predicted = float(self._search_report.best_cost)
+        return {
+            "predicted_s": predicted,
+            "measured_s": measured,
+            "ratio": predicted / max(measured, 1e-12),
+        }
 
     def export_dot(self, path: str, strategy=None) -> None:
         """Write the (strategy-colored, when available) computation graph

@@ -229,13 +229,125 @@ def _merge_cast_chain(graph: Graph) -> Optional[Graph]:
     return None
 
 
+# ---------------------------------------------------------------------------
+# Declarative JSON rules (reference --substitution-json +
+# substitution_loader.cc + substitutions/graph_subst_3_v2.json). A rule
+# matches a single-consumer producer→consumer CHAIN of ops by op_type +
+# attr conditions and either drops the chain (redirecting to its input)
+# or replaces it with one op whose attrs may copy matched values
+# ("$i.key" = element i's attr `key`). Conditions support constants and
+# {"$eq": "i.key"} cross-element equality.
+
+
+def _chain_matches(graph: Graph, last: OpNode, pattern: List[Dict]):
+    """Walk input[0] edges upward from ``last`` matching the pattern
+    (ordered producer..consumer). Returns the matched node chain or
+    None; intermediate nodes must have exactly one consumer."""
+    chain: List[OpNode] = [last]
+    node = last
+    for _ in range(len(pattern) - 1):
+        if len(node.inputs) != 1:
+            return None
+        node = graph.node(node.inputs[0].node_id)
+        if len(_consumers(graph, node.id)) != 1:
+            return None
+        chain.append(node)
+    chain.reverse()  # producer first, like the pattern
+    for spec, node in zip(pattern, chain):
+        if node.op_type != spec["op"]:
+            return None
+    # attr conditions once the ops line up
+    for i, spec in enumerate(pattern):
+        attrs = chain[i].attrs_dict
+        for key, cond in (spec.get("attrs") or {}).items():
+            if isinstance(cond, dict) and "$eq" in cond:
+                j, _, other = cond["$eq"].partition(".")
+                if attrs.get(key) != chain[int(j)].attrs_dict.get(other):
+                    return None
+            else:
+                val = attrs.get(key)
+                if isinstance(val, tuple):
+                    val = list(val)
+                if val != cond:
+                    return None
+    return chain
+
+
+def _resolve_attrs(template: Dict, chain: List[OpNode]) -> Dict:
+    out = {}
+    for key, val in template.items():
+        if isinstance(val, str) and val.startswith("$"):
+            i, _, name = val[1:].partition(".")
+            val = chain[int(i)].attrs_dict.get(name)
+        if isinstance(val, list):
+            val = tuple(val)
+        out[key] = val
+    return out
+
+
+def make_json_rule(spec: Dict) -> Substitution:
+    pattern = spec["pattern"]
+    action = spec["action"]
+
+    def apply_fn(graph: Graph) -> Optional[Graph]:
+        for node in graph.nodes:
+            if node.op_type != pattern[-1]["op"]:
+                continue
+            chain = _chain_matches(graph, node, pattern)
+            if chain is None:
+                continue
+            head_input = chain[0].inputs[0] if chain[0].inputs else None
+            if action["kind"] == "drop":
+                if head_input is None:
+                    continue
+                return rebuild(
+                    graph,
+                    drop={n.id for n in chain},
+                    replace_node={},
+                    redirect={TensorRef(chain[-1].id, 0): head_input},
+                )
+            if action["kind"] == "replace":
+                attrs = _resolve_attrs(action.get("attrs", {}), chain)
+                return rebuild(
+                    graph,
+                    drop={n.id for n in chain[:-1]},
+                    replace_node={
+                        chain[-1].id: (
+                            action["op"], attrs, chain[0].inputs
+                        )
+                    },
+                    redirect={},
+                )
+            raise ValueError(f"unknown action kind {action['kind']!r}")
+        return None
+
+    return Substitution(spec["name"], apply_fn)
+
+
+def load_substitutions_json(path: str) -> List[Substitution]:
+    """Load declarative rules (the reference's ``--substitution-json``
+    import, substitution_loader.cc)."""
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    return [make_json_rule(spec) for spec in doc["rules"]]
+
+
+def default_json_rules() -> List[Substitution]:
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "substitutions.json")
+    return load_substitutions_json(path) if os.path.exists(path) else []
+
+
 SUBSTITUTIONS: List[Substitution] = [
     Substitution("fuse_dense_activation", _fuse_dense_activation),
     Substitution("merge_sibling_dense", _merge_sibling_dense),
     Substitution("drop_identity_reshape", _drop_identity_reshape),
     Substitution("drop_inverse_transpose", _drop_inverse_transpose),
     Substitution("merge_cast_chain", _merge_cast_chain),
-]
+] + default_json_rules()
 
 
 # ---------------------------------------------------------------------------

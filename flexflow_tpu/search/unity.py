@@ -78,6 +78,7 @@ def optimize(
     enable_sample: bool = True,
     enable_attribute: bool = True,
     allow_expert: bool = True,
+    extra_rules: Optional[List] = None,
 ) -> Tuple[Graph, ParallelStrategy, SearchReport]:
     """Joint substitution + sharding search. Returns the rewritten graph,
     the winning strategy, and a report. With ``measured`` the cost model
@@ -114,8 +115,9 @@ def optimize(
         def cost_fn(g: Graph) -> float:
             return placement_dp(g, cm).estimated_step_time
 
+        rules = SUBSTITUTIONS + list(extra_rules or [])
         g2, cost2, trace = apply_substitutions(
-            graph, cost_fn, budget=budget, alpha=alpha
+            graph, cost_fn, budget=budget, alpha=alpha, rules=rules
         )
         strat = placement_dp(g2, cm)
         evaluated += 1

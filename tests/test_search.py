@@ -329,3 +329,108 @@ def test_planner_spec_runs_in_make_train_step():
         )
         _, _, loss = step(params, opt, toks)
         assert np.isfinite(float(loss))
+
+
+def test_validate_search_predicted_vs_measured():
+    """Close the simulator-fidelity loop: after an auto_parallel compile
+    the search's predicted step time can be checked against the real
+    compiled step (the bench mode VERDICT r2 item 6 asked for)."""
+    cfg = ff.FFConfig(batch_size=32, num_devices=4)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((32, 64), name="x")
+    t = m.dense(t, 128, activation="relu")
+    t = m.dense(t, 8)
+    t = m.softmax(t)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05), auto_parallel=True)
+    before = jax.device_get(m.params)
+    rep = m.validate_search(iters=2)
+    assert rep["predicted_s"] > 0 and rep["measured_s"] > 0
+    assert np.isfinite(rep["ratio"])
+    # the diagnostic must not perturb the model state
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        before, jax.device_get(m.params),
+    )
+
+
+class TestJsonSubstitutions:
+    """Declarative JSON rules — the reference's --substitution-json
+    import (substitution_loader.cc + graph_subst_3_v2.json)."""
+
+    def _apply(self, m, name):
+        from flexflow_tpu.search.substitutions import SUBSTITUTIONS
+
+        rule = next(r for r in SUBSTITUTIONS if r.name == name)
+        return rule.apply(m.graph)
+
+    def test_merge_consecutive_reshape(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=4, num_devices=1))
+        t = m.create_tensor((4, 12), name="x")
+        t = m.reshape(t, (4, 3, 4))
+        t = m.reshape(t, (4, 6, 2))
+        t = m.flat(t)
+        params = m.init_params(jax.random.PRNGKey(0))
+        x = np.random.default_rng(0).normal(size=(4, 12)).astype(np.float32)
+        before = _run(m, params, x)
+        g2 = self._apply(m, "merge_consecutive_reshape")
+        assert g2 is not None
+        assert [n.op_type for n in g2.nodes].count("reshape") == 1
+        m.graph = g2
+        np.testing.assert_allclose(_run(m, params, x), before, rtol=1e-6)
+
+    def test_drop_zero_dropout_and_double_reverse(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=4, num_devices=1))
+        t = m.create_tensor((4, 8), name="x")
+        t = m.dropout(t, rate=0.0)
+        t = m.reverse(t, axis=1)
+        t = m.reverse(t, axis=1)
+        t = m.dense(t, 3)
+        params = m.init_params(jax.random.PRNGKey(1))
+        x = np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32)
+        before = _run(m, params, x)
+        g2 = self._apply(m, "drop_zero_dropout")
+        assert g2 is not None and all(
+            n.op_type != "dropout" for n in g2.nodes
+        )
+        m.graph = g2
+        g3 = self._apply(m, "drop_double_reverse")
+        assert g3 is not None and all(
+            n.op_type != "reverse" for n in g3.nodes
+        )
+        m.graph = g3
+        np.testing.assert_allclose(_run(m, params, x), before, rtol=1e-6)
+
+    def test_mismatched_reverse_axes_not_dropped(self):
+        m = ff.FFModel(ff.FFConfig(batch_size=4, num_devices=1))
+        t = m.create_tensor((4, 8), name="x")
+        t = m.reverse(t, axis=0)
+        t = m.reverse(t, axis=1)
+        assert self._apply(m, "drop_double_reverse") is None
+
+    def test_custom_json_file_via_config(self, tmp_path):
+        import json as _json
+
+        rules = {
+            "rules": [{
+                "name": "drop_identity_scale",
+                "pattern": [{"op": "element_unary",
+                             "attrs": {"op": "scalar_multiply",
+                                       "scalar": 1.0}}],
+                "action": {"kind": "drop"},
+            }]
+        }
+        p = tmp_path / "subst.json"
+        p.write_text(_json.dumps(rules))
+        from flexflow_tpu.search.substitutions import load_substitutions_json
+
+        loaded = load_substitutions_json(str(p))
+        assert [r.name for r in loaded] == ["drop_identity_scale"]
+        m = ff.FFModel(ff.FFConfig(batch_size=4, num_devices=1))
+        t = m.create_tensor((4, 8), name="x")
+        t = m.scalar_multiply(t, 1.0)
+        t = m.dense(t, 3)
+        g2 = loaded[0].apply(m.graph)
+        assert g2 is not None
+        assert all(
+            n.attrs_dict.get("op") != "scalar_multiply" for n in g2.nodes
+        )
