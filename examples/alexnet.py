@@ -1,0 +1,72 @@
+"""AlexNet data-parallel training — the reference's canonical CNN app
+(reference ``examples/cpp/AlexNet/alexnet.cc:40-90`` builds the same
+conv/pool/dense stack layer by layer through the FFModel API).
+
+Defaults reproduce the reference geometry (3x229x229 inputs); the
+``image_size``/``width_mult`` knobs scale it down so the same script
+doubles as a fast smoke test on the virtual CPU mesh.
+
+Run: python examples/alexnet.py [--devices N] [--image-size 229]
+"""
+import argparse
+
+import numpy as np
+
+
+def synthetic_images(n, image_size, num_classes, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, num_classes, size=n).astype(np.int32)
+    # per-class prototype images + noise (separable, like the MNIST demo)
+    protos = rng.normal(size=(num_classes, 3, image_size, image_size))
+    x = protos[y] + 0.4 * rng.normal(size=(n, 3, image_size, image_size))
+    return x.astype(np.float32), y
+
+
+def build(model, batch_size, image_size=229, num_classes=10, width_mult=1.0):
+    """The reference stack (alexnet.cc): 5 convs, 3 pools, 3 denses."""
+    w = lambda c: max(4, int(c * width_mult))
+    t = model.create_tensor((batch_size, 3, image_size, image_size), name="x")
+    t = model.conv2d(t, w(64), 11, 11, 4, 4, 2, 2, activation="relu")
+    t = model.pool2d(t, 3, 3, 2, 2)
+    t = model.conv2d(t, w(192), 5, 5, 1, 1, 2, 2, activation="relu")
+    t = model.pool2d(t, 3, 3, 2, 2)
+    t = model.conv2d(t, w(384), 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.conv2d(t, w(256), 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.conv2d(t, w(256), 3, 3, 1, 1, 1, 1, activation="relu")
+    t = model.pool2d(t, 3, 3, 2, 2)
+    t = model.flat(t)
+    t = model.dense(t, w(4096), activation="relu")
+    t = model.dense(t, w(4096), activation="relu")
+    t = model.dense(t, num_classes)
+    return model.softmax(t)
+
+
+def main(num_devices=1, epochs=2, batch_size=32, image_size=64,
+         width_mult=0.125, num_classes=10, n_samples=256):
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(
+        batch_size=batch_size, epochs=epochs, num_devices=num_devices
+    )
+    model = ff.FFModel(cfg)
+    build(model, batch_size, image_size, num_classes, width_mult)
+    model.compile(
+        optimizer=ff.AdamOptimizer(lr=1e-3),
+        loss_type="sparse_categorical_crossentropy",
+        metrics=("accuracy",),
+    )
+    x, y = synthetic_images(n_samples, image_size, num_classes)
+    model.fit(x, y)
+    final = model.evaluate(x, y)
+    print("final:", final)
+    return final
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--width-mult", type=float, default=0.125)
+    a = p.parse_args()
+    main(a.devices, a.epochs, image_size=a.image_size, width_mult=a.width_mult)

@@ -1,0 +1,72 @@
+"""ResNet training — residual CNN app (reference
+``examples/cpp/ResNet/resnet.cc:41-90``: BottleneckBlock built from
+conv2d/batch_norm + element-binary add through the FFModel API; the
+resnext50 app is the same pattern with grouped convs).
+
+Run: python examples/resnet.py [--devices N]
+"""
+import argparse
+
+import numpy as np
+
+
+def basic_block(model, t, channels, stride=1):
+    """conv-bn-conv-bn + skip (1x1-conv projection when shape changes),
+    then ReLU — the reference block with the cheaper 2-conv variant."""
+    skip = t
+    out = model.conv2d(t, channels, 3, 3, stride, stride, 1, 1)
+    out = model.batch_norm(out, relu=True)
+    out = model.conv2d(out, channels, 3, 3, 1, 1, 1, 1)
+    out = model.batch_norm(out, relu=False)
+    if stride != 1 or t.shape[1] != channels:
+        skip = model.conv2d(t, channels, 1, 1, stride, stride, 0, 0)
+        skip = model.batch_norm(skip, relu=False)
+    out = model.add(out, skip)
+    return model.relu(out)
+
+
+def build(model, batch_size, image_size=32, num_classes=10,
+          stages=(1, 1, 1), base_width=16):
+    t = model.create_tensor((batch_size, 3, image_size, image_size), name="x")
+    t = model.conv2d(t, base_width, 3, 3, 1, 1, 1, 1, activation="relu")
+    ch = base_width
+    for i, blocks in enumerate(stages):
+        for b in range(blocks):
+            stride = 2 if (i > 0 and b == 0) else 1
+            t = basic_block(model, t, ch, stride)
+        ch *= 2
+    t = model.mean(t, axes=(2, 3))  # global average pool
+    t = model.dense(t, num_classes)
+    return model.softmax(t)
+
+
+def main(num_devices=1, epochs=2, batch_size=32, image_size=16,
+         stages=(1, 1), base_width=8, n_samples=256):
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(
+        batch_size=batch_size, epochs=epochs, num_devices=num_devices
+    )
+    model = ff.FFModel(cfg)
+    build(model, batch_size, image_size, stages=stages, base_width=base_width)
+    model.compile(
+        optimizer=ff.SGDOptimizer(lr=0.02, momentum=0.9),
+        loss_type="sparse_categorical_crossentropy",
+        metrics=("accuracy",),
+    )
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, size=n_samples).astype(np.int32)
+    x = rng.normal(size=(n_samples, 3, image_size, image_size)).astype(np.float32)
+    x += y[:, None, None, None].astype(np.float32) / 10
+    model.fit(x, y)
+    final = model.evaluate(x, y)
+    print("final:", final)
+    return final
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=2)
+    a = p.parse_args()
+    main(a.devices, a.epochs)

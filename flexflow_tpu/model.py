@@ -1146,26 +1146,32 @@ class FFModel:
         live = (self.params, self.opt_state, self.model_state)
         snap = jax.device_get(live)
         shardings = jax.tree.map(lambda a: a.sharding, live)
-        with jax.set_mesh(self.mesh):
-            batch = self._shard_batch(x)
-            yb = self._shard_batch({"y": y})["y"]
-            key = jax.random.PRNGKey(0)
-            params, opt, st = live
-            # warm
-            params, opt, st, loss, _ = self._train_step(
-                params, opt, st, key, batch, yb
-            )
-            jax.block_until_ready(loss)
-            t0 = _time.perf_counter()
-            for _ in range(iters):
+        try:
+            with jax.set_mesh(self.mesh):
+                batch = self._shard_batch(x)
+                yb = self._shard_batch({"y": y})["y"]
+                key = jax.random.PRNGKey(0)
+                params, opt, st = live
+                # warm
                 params, opt, st, loss, _ = self._train_step(
                     params, opt, st, key, batch, yb
                 )
-            jax.block_until_ready(loss)
-            measured = (_time.perf_counter() - t0) / iters
-            self.params, self.opt_state, self.model_state = jax.tree.map(
-                jax.device_put, snap, shardings
-            )
+                jax.block_until_ready(loss)
+                t0 = _time.perf_counter()
+                for _ in range(iters):
+                    params, opt, st, loss, _ = self._train_step(
+                        params, opt, st, key, batch, yb
+                    )
+                jax.block_until_ready(loss)
+                measured = (_time.perf_counter() - t0) / iters
+        finally:
+            # the first warm step donated the live buffers — restore even
+            # when the timing loop dies, or every later fit() hits
+            # "Array has been deleted"
+            with jax.set_mesh(self.mesh):
+                self.params, self.opt_state, self.model_state = jax.tree.map(
+                    jax.device_put, snap, shardings
+                )
         predicted = float(self._search_report.best_cost)
         return {
             "predicted_s": predicted,

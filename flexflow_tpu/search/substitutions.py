@@ -278,7 +278,12 @@ def _resolve_attrs(template: Dict, chain: List[OpNode]) -> Dict:
     for key, val in template.items():
         if isinstance(val, str) and val.startswith("$"):
             i, _, name = val[1:].partition(".")
-            val = chain[int(i)].attrs_dict.get(name)
+            if name not in chain[int(i)].attrs_dict:
+                raise ValueError(
+                    f"substitution attr reference {val!r} names no attr "
+                    f"on matched op {chain[int(i)].op_type!r}"
+                )
+            val = chain[int(i)].attrs_dict[name]
         if isinstance(val, list):
             val = tuple(val)
         out[key] = val
@@ -288,6 +293,23 @@ def _resolve_attrs(template: Dict, chain: List[OpNode]) -> Dict:
 def make_json_rule(spec: Dict) -> Substitution:
     pattern = spec["pattern"]
     action = spec["action"]
+    # reject malformed rules at load time — a typo'd kind must not sit
+    # silently inert (or abort the search mid-run) after a match
+    if not pattern:
+        raise ValueError(f"rule {spec.get('name')!r}: empty pattern")
+    if action.get("kind") not in ("drop", "replace"):
+        raise ValueError(
+            f"rule {spec.get('name')!r}: unknown action kind "
+            f"{action.get('kind')!r} (expected 'drop' or 'replace')"
+        )
+    if action["kind"] == "replace":
+        if "op" not in action:
+            raise ValueError(
+                f"rule {spec.get('name')!r}: replace action needs an 'op'"
+            )
+        from ..ops.registry import get_op
+
+        get_op(action["op"])  # unknown target op fails at load, not apply
 
     def apply_fn(graph: Graph) -> Optional[Graph]:
         for node in graph.nodes:
@@ -300,14 +322,40 @@ def make_json_rule(spec: Dict) -> Substitution:
             if action["kind"] == "drop":
                 if head_input is None:
                     continue
+                # a dropped chain must be an identity: single-input head
+                # whose source spec equals the chain's output spec —
+                # otherwise consumers would silently re-infer from a
+                # different shape (the reference's substitution loader
+                # validates rule legality the same way)
+                if len(chain[0].inputs) != 1:
+                    continue
+                src = graph.out_spec(head_input)
+                if src.shape != node.out_specs[0].shape or (
+                    src.dtype != node.out_specs[0].dtype
+                ):
+                    continue
                 return rebuild(
                     graph,
                     drop={n.id for n in chain},
                     replace_node={},
                     redirect={TensorRef(chain[-1].id, 0): head_input},
                 )
-            if action["kind"] == "replace":
+            else:  # "replace" (kinds validated at load time)
                 attrs = _resolve_attrs(action.get("attrs", {}), chain)
+                # same legality guard as drop: the replacement op must
+                # reproduce the matched chain's output spec, or downstream
+                # consumers would silently re-infer from a different shape
+                from ..ops.registry import get_op
+
+                in_specs = [graph.out_spec(r) for r in chain[0].inputs]
+                try:
+                    new_specs = get_op(action["op"]).infer(in_specs, attrs)
+                except Exception:
+                    continue
+                if tuple((s.shape, s.dtype) for s in new_specs) != tuple(
+                    (s.shape, s.dtype) for s in node.out_specs
+                ):
+                    continue
                 return rebuild(
                     graph,
                     drop={n.id for n in chain[:-1]},
@@ -318,7 +366,6 @@ def make_json_rule(spec: Dict) -> Substitution:
                     },
                     redirect={},
                 )
-            raise ValueError(f"unknown action kind {action['kind']!r}")
         return None
 
     return Substitution(spec["name"], apply_fn)
@@ -338,7 +385,18 @@ def default_json_rules() -> List[Substitution]:
     import os
 
     path = os.path.join(os.path.dirname(__file__), "substitutions.json")
-    return load_substitutions_json(path) if os.path.exists(path) else []
+    if not os.path.exists(path):
+        return []
+    try:
+        return load_substitutions_json(path)
+    except Exception as e:  # pragma: no cover - corrupt install
+        # this runs at package import: a corrupt bundled rules file must
+        # degrade the search to built-in rules, not break every
+        # ``import flexflow_tpu`` (serving users never touch the search)
+        import warnings
+
+        warnings.warn(f"ignoring bundled substitution rules ({e})")
+        return []
 
 
 SUBSTITUTIONS: List[Substitution] = [

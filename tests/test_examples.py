@@ -43,3 +43,31 @@ def test_unity_search_example():
 
     model = unity_search.main(num_devices=4)
     assert model.params is not None
+
+
+def test_alexnet_example():
+    import alexnet
+
+    final = alexnet.main(num_devices=1, epochs=4, image_size=64, n_samples=128)
+    assert final["accuracy"] > 0.5
+
+
+def test_resnet_example_8dev():
+    import resnet
+
+    final = resnet.main(num_devices=8, epochs=2, n_samples=128)
+    assert final["accuracy"] > 0.15  # above 10-class chance
+
+
+def test_dlrm_example():
+    import dlrm
+
+    final = dlrm.main(num_devices=2, epochs=2, n_samples=256)
+    assert final["accuracy"] > 0.6
+
+
+def test_transformer_example():
+    import transformer
+
+    final = transformer.main(num_devices=1, epochs=3, n_samples=128)
+    assert final["accuracy"] > 0.5

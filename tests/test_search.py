@@ -425,12 +425,39 @@ class TestJsonSubstitutions:
 
         loaded = load_substitutions_json(str(p))
         assert [r.name for r in loaded] == ["drop_identity_scale"]
-        m = ff.FFModel(ff.FFConfig(batch_size=4, num_devices=1))
+        # the full wiring: FFConfig.substitution_json_file → compile
+        # (auto_parallel) → unity.optimize(extra_rules=…) must actually
+        # apply the custom rule, not just parse the file
+        m = ff.FFModel(ff.FFConfig(
+            batch_size=4, num_devices=1, substitution_json_file=str(p),
+        ))
         t = m.create_tensor((4, 8), name="x")
         t = m.scalar_multiply(t, 1.0)
         t = m.dense(t, 3)
-        g2 = loaded[0].apply(m.graph)
-        assert g2 is not None
+        t = m.softmax(t)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.1), auto_parallel=True)
+        assert "drop_identity_scale" in m._search_report.substitutions_applied
         assert all(
-            n.attrs_dict.get("op") != "scalar_multiply" for n in g2.nodes
+            n.attrs_dict.get("op") != "scalar_multiply"
+            for n in m.graph.nodes
         )
+
+    def test_json_drop_guard_refuses_non_identity(self, tmp_path):
+        import json as _json
+
+        rules = {
+            "rules": [{
+                "name": "bogus_drop_dense",
+                "pattern": [{"op": "dense"}],
+                "action": {"kind": "drop"},
+            }]
+        }
+        p = tmp_path / "bad.json"
+        p.write_text(_json.dumps(rules))
+        from flexflow_tpu.search.substitutions import load_substitutions_json
+
+        (rule,) = load_substitutions_json(str(p))
+        m = ff.FFModel(ff.FFConfig(batch_size=4, num_devices=1))
+        t = m.create_tensor((4, 8), name="x")
+        t = m.dense(t, 3)  # shape-changing: dropping it would corrupt
+        assert rule.apply(m.graph) is None
