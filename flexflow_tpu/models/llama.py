@@ -258,6 +258,27 @@ def causal_mask(S: int) -> jnp.ndarray:
     return jnp.tril(jnp.ones((S, S), bool))
 
 
+def make_flash_attention(block_q: int = 128, block_k: int = 128):
+    """Causal flash-attention attn_fn (Pallas kernel with custom VJP,
+    ops/flash_attention.py): scores stream through VMEM instead of
+    materialising the (B, H, S, S) tensor the XLA path writes to HBM."""
+    from ..ops.flash_attention import flash_attention
+
+    def attn_fn(cfg, q, k, v, mask):
+        # mask is None by construction (forward() skips building it when
+        # an attn_fn is supplied); causality is computed in-kernel
+        H, KV = cfg.num_attention_heads, cfg.num_key_value_heads
+        if KV != H:
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        return flash_attention(
+            q, k, v, causal=True, block_q=block_q, block_k=block_k
+        )
+
+    return attn_fn
+
+
 def make_sp_attention(mesh, impl: str = "ring"):
     """Build a sequence-parallel attention override for :func:`block`
     (ring ppermute or Ulysses all-to-all over the ``seq`` axis — the
@@ -339,6 +360,7 @@ def make_train_step(
     num_microbatches: int = 1,
     remat: bool = True,
     shard_activations: bool = True,
+    attention: str = "xla",  # "xla" | "flash" (Pallas, ops/flash_attention)
 ):
     """Build (init_fn, step_fn) jitted over ``mesh`` with the full
     dp/tp/pp/sp sharding stack.
@@ -368,7 +390,12 @@ def make_train_step(
 
     if not pipeline:
         sp = mesh.shape[SEQ_AXIS] > 1
-        attn_fn = make_sp_attention(mesh, "ring") if sp else None
+        if sp:
+            attn_fn = make_sp_attention(mesh, "ring")
+        elif attention == "flash":
+            attn_fn = make_flash_attention()
+        else:
+            attn_fn = None
 
         def loss_fn(params, tokens):
             return next_token_loss(
@@ -388,7 +415,10 @@ def make_train_step(
         )
         from ..parallel.pipeline import make_pipelined_apply
 
-        blk = functools.partial(block, cfg)
+        flash = attention == "flash"
+        blk = functools.partial(
+            block, cfg, attn_fn=make_flash_attention() if flash else None
+        )
         if remat:
             blk = jax.checkpoint(blk)
 
@@ -400,7 +430,7 @@ def make_train_step(
             if shard_activations and mesh.shape[SEQ_AXIS] > 1:
                 x = lax.with_sharding_constraint(x, P(DATA_AXIS, SEQ_AXIS, None))
             cos, sin = rope_freqs(cfg, jnp.arange(Sm, dtype=jnp.int32))
-            mask = causal_mask(Sm)
+            mask = None if flash else causal_mask(Sm)
 
             def block_stack(stage_layers, x_mb):
                 def body(carry, p_l):
