@@ -1,11 +1,26 @@
-"""Benchmark entry point — prints ONE JSON line.
+"""Benchmark entry point — prints one JSON line PER METRIC, headline last.
 
 Headline metric (BASELINE.json): serving tokens/sec/chip for SpecInfer
 on the flagship LLaMA family, measured on the real chip with the Pallas
 decode/verify kernels, alongside incremental decoding and the
 spec-vs-incremental LLM-step reduction (the comparison the reference's
 inference tests print, tests/inference/python_inference_tests.sh:57-123).
-Secondary: hand-sharded single-chip training MFU vs the 40% north star.
+Secondary: hand-sharded single-chip training MFU vs the 40% north star,
+and Unity-searched training MFU (compile(auto_parallel=True)).
+
+Robustness contract (a bench that dies mid-run must still leave data):
+* every metric is printed the moment it is measured (flushed), cheapest
+  phase first, so a timeout or crash later loses only later phases;
+* the TPU backend is probed in a SUBPROCESS with retries before the
+  main process touches jax — backend init has been observed both to
+  raise UNAVAILABLE and to hang outright; on failure the bench falls
+  back to CPU (platform is recorded per metric, so a CPU number can
+  never masquerade as a TPU number);
+* each phase runs under a SIGALRM budget and an exception in one phase
+  never aborts the others;
+* the Pallas kernels are used only after an on-device parity phase
+  proves they compile AND match the XLA path token-for-token; fallback
+  to XLA is reported with the exception, never silent.
 
 Model: the largest LLaMA-family config that comfortably fits one 16 GB
 v5e chip in bf16 (~3.5 B params; the 7 B headline target needs the
@@ -15,21 +30,126 @@ needs no external weights; on random weights it still yields a real
 ~1.3-1.5x step reduction, and with trained weights the acceptance only
 improves.
 
-vs_baseline compares SpecInfer tokens/sec/chip against an A100 running
-LLaMA-7B SpecInfer (~60 tok/s/device: the reference reports 1.3-2.0x
-over ~30 tok/s incremental serving baselines, SERVE.md:10).
+vs_baseline for the headline compares SpecInfer tokens/sec/chip against
+an A100 running LLaMA-7B SpecInfer (~60 tok/s/device: the reference
+reports 1.3-2.0x over ~30 tok/s incremental serving baselines,
+reference SERVE.md:10).
 """
+import argparse
+import contextlib
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
+import traceback
 
 A100_SPECINFER_TOKS_PER_SEC = 60.0
 TRAIN_MFU_TARGET = 0.40
 
+_RESULTS = {}
+
+
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def emit(metric, value, unit, vs_baseline=None, **detail):
+    line = {"metric": metric, "value": value, "unit": unit}
+    if vs_baseline is not None:
+        line["vs_baseline"] = round(vs_baseline, 4)
+    if detail:
+        line["detail"] = detail
+    print(json.dumps(line), flush=True)
+    _RESULTS[metric] = line
+    return line
+
+
+class PhaseTimeout(Exception):
+    pass
+
+
+@contextlib.contextmanager
+def _alarm(seconds):
+    """Best-effort phase budget. SIGALRM interrupts Python-level work;
+    a blocked native XLA compile only notices on return, so this bounds
+    the common hangs (retry loops, iteration) not a wedged compiler —
+    the driver's outer timeout plus incremental emission covers that."""
+
+    def handler(signum, frame):
+        raise PhaseTimeout(f"phase exceeded {seconds}s budget")
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(int(seconds))
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def run_phase(name, budget_s, fn, *args, **kw):
+    t0 = time.perf_counter()
+    _log(f"phase {name} start (budget {budget_s}s)")
+    try:
+        with _alarm(budget_s):
+            out = fn(*args, **kw)
+        _log(f"phase {name} done in {time.perf_counter() - t0:.1f}s")
+        return out
+    except BaseException as e:  # noqa: BLE001 — bench must keep going
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        _log(f"phase {name} FAILED after {time.perf_counter() - t0:.1f}s: {e!r}")
+        traceback.print_exc(file=sys.stderr)
+        return None
+
+
+# ----------------------------------------------------------------------
+# backend guard
+
+
+def _ensure_backend(probe_timeout=240, retries=2):
+    """Initialize the TPU backend in a subprocess first: jax.devices()
+    has been observed to raise UNAVAILABLE (rounds 1/3) or hang outright
+    when the tunnelled backend is down. Probing out-of-process lets us
+    time out a hang and drop to CPU so every metric still gets measured
+    (with platform honestly recorded as cpu)."""
+    if os.environ.get("JAX_PLATFORMS"):
+        _log(f"JAX_PLATFORMS preset to {os.environ['JAX_PLATFORMS']!r}")
+        return
+    code = "import jax; print(jax.devices()[0].platform)"
+    for attempt in range(retries):
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=probe_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            _log(f"backend probe {attempt}: hung >{probe_timeout}s")
+            continue
+        dt = time.perf_counter() - t0
+        plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "?"
+        if r.returncode == 0:
+            _log(f"backend probe {attempt}: platform={plat} in {dt:.1f}s")
+            return
+        err = r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ""
+        _log(f"backend probe {attempt}: rc={r.returncode} in {dt:.1f}s: {err}")
+        time.sleep(15)
+    _log("TPU backend unavailable — falling back to CPU")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+# ----------------------------------------------------------------------
+# model configs
+
 
 def _llm_cfg(on_tpu):
+    import jax.numpy as jnp
+
     from flexflow_tpu.models import llama
 
     if on_tpu:
@@ -66,89 +186,17 @@ def _layer_skip_draft(cfg, params, k):
     return dcfg, dparams
 
 
-def serve_bench(on_tpu):
-    from flexflow_tpu.models import llama
-    from flexflow_tpu.serve import (
-        InferenceEngine,
-        RequestManager,
-        ServingConfig,
-        SpecConfig,
-        SpecInferManager,
-    )
-
-    cfg = _llm_cfg(on_tpu)
-    params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    n_new = 48 if on_tpu else 16
-    n_req = 4
-    prompt_len = 64 if on_tpu else 12
-    prompts = [
-        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
-        for i in range(n_req)
-    ]
-
-    def make_sc(kernels):
-        return ServingConfig(
-            max_requests_per_batch=n_req,
-            max_sequence_length=prompt_len + n_new + 8,
-            prefill_chunk=32 if on_tpu else 8,
-            max_spec_tree_tokens=16,
-            cache_dtype=cfg.dtype,
-            kernels=kernels,
-        )
-
-    # Engines are reused between warmup and the timed run (slot reuse is
-    # safe by position masking; re-creating an engine would re-jit every
-    # program and double the bench's compile bill).
-    kernels = "pallas"
-    try:
-        eng = InferenceEngine(llama, cfg, params, make_sc(kernels))
-        rm = RequestManager(eng)
-        rm.generate(prompts, max_new_tokens=4)  # compile + kernel sanity
-    except Exception:
-        kernels = "xla"
-        eng = InferenceEngine(llama, cfg, params, make_sc(kernels))
-        rm = RequestManager(eng)
-        rm.generate(prompts, max_new_tokens=4)
-
-    # --- incremental decoding, steady state (same engine, warmed) ---
-    t0 = time.perf_counter()
-    outs = rm.generate(prompts, max_new_tokens=n_new)
-    incr_dt = time.perf_counter() - t0
-    incr_tokens = sum(len(o.output_tokens) for o in outs)
-    incr_steps = sum(o.profile.llm_decoding_steps for o in outs)
-
-    # --- SpecInfer with a layer-skip self-draft ---
-    dcfg, dparams = _layer_skip_draft(cfg, params, 2)
-    spec = SpecConfig(beam_width=2, beam_depth=3)
-    mgr = SpecInferManager(
-        InferenceEngine(llama, cfg, params, make_sc(kernels)),
-        InferenceEngine(llama, dcfg, dparams, make_sc(kernels)),
-        spec,
-    )
-    mgr.generate(prompts, max_new_tokens=4)  # warm all spec programs
-    t0 = time.perf_counter()
-    outs = mgr.generate(prompts, max_new_tokens=n_new)
-    spec_dt = time.perf_counter() - t0
-    spec_tokens = sum(len(o.output_tokens) for o in outs)
-    spec_steps = sum(o.profile.llm_decoding_steps for o in outs)
-    accepted = sum(o.profile.accepted_tokens for o in outs)
-    speculated = sum(o.profile.speculated_tokens for o in outs)
-
-    return {
-        "kernels": kernels,
-        "incr_tokens_per_sec": round(incr_tokens / incr_dt, 2),
-        "spec_tokens_per_sec": round(spec_tokens / spec_dt, 2),
-        "spec_step_reduction": round(incr_steps / max(1, spec_steps), 3),
-        "accept_rate": round(accepted / max(1, speculated), 3),
-        "n_requests": n_req,
-        "new_tokens_per_request": n_new,
-        "model_params_b": round(llama.num_params(cfg) / 1e9, 3),
-    }
+# ----------------------------------------------------------------------
+# phases
 
 
 def train_bench(on_tpu):
-    """Secondary: hand-sharded single-chip training MFU (the r01/r02
-    metric, kept for continuity against the 40% north star)."""
+    """Hand-sharded single-chip training MFU (the r01/r02 metric, kept
+    for continuity against the 40% north star). Cheapest phase: one
+    compile + 10 steps — runs first so SOME metric always lands."""
+    import jax
+    import jax.numpy as jnp
+
     from flexflow_tpu.core.mesh import MachineSpec
     from flexflow_tpu.models import llama
     from flexflow_tpu.optimizers import AdamOptimizer
@@ -187,35 +235,280 @@ def train_bench(on_tpu):
     tokens_per_step = batch * (seq - 1)
     flops = 3 * llama.flops_per_token(cfg, seq) * tokens_per_step
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak FLOP/s
-    return {
-        "train_mfu": round(flops / dt / peak, 4),
-        "train_step_ms": round(dt * 1e3, 2),
-    }
+    mfu = flops / dt / peak
+    emit(
+        "llama_train_mfu",
+        round(mfu, 4),
+        "fraction_of_peak",
+        vs_baseline=mfu / TRAIN_MFU_TARGET,
+        step_ms=round(dt * 1e3, 2),
+        tokens_per_sec=round(tokens_per_step / dt, 1),
+        model_params_m=round(llama.num_params(cfg) / 1e6, 1),
+        platform=_platform(),
+    )
+    return mfu
+
+
+def searched_train_bench(on_tpu):
+    """Unity-searched training MFU: FFModel.compile(auto_parallel=True)
+    on the flagship transformer — the path BASELINE.md's north star #2
+    actually specifies. The search must pick the fused-block fast path
+    (flash attention + scan + remat) for this to approach 40%."""
+    from flexflow_tpu import bench_search
+
+    res = bench_search.searched_train_mfu(on_tpu)
+    emit(
+        "unity_searched_train_mfu",
+        round(res["mfu"], 4),
+        "fraction_of_peak",
+        vs_baseline=res["mfu"] / TRAIN_MFU_TARGET,
+        platform=_platform(),
+        **{k: v for k, v in res.items() if k != "mfu"},
+    )
+    return res
+
+
+def kernel_parity(on_tpu):
+    """On-device Pallas↔XLA parity: greedy-decode a small model with
+    kernels="pallas" and kernels="xla" and require token-identical
+    output over prefill + 12 decode steps — the same acceptance
+    criterion the reference applies to its hand-written decode kernels
+    (tests/inference/python_inference_tests.sh:111-123). Only a PASS
+    here lets the serve phase report kernels="pallas"."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import InferenceEngine, RequestManager, ServingConfig
+
+    # Mosaic-friendly small config: head_dim 128 (lane width), few layers.
+    cfg = llama.LLaMAConfig(
+        vocab_size=2048,
+        hidden_size=1024,
+        intermediate_size=2816,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        max_position_embeddings=256,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    prompts = [[(i * 13 + j * 7 + 1) % cfg.vocab_size for j in range(24)]
+               for i in range(2)]
+    outs = {}
+    for kernels in ("xla", "pallas"):
+        sc = ServingConfig(
+            max_requests_per_batch=2,
+            max_sequence_length=64,
+            prefill_chunk=24,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kernels,
+        )
+        eng = InferenceEngine(llama, cfg, params, sc)
+        rm = RequestManager(eng)
+        outs[kernels] = [
+            o.output_tokens for o in rm.generate(prompts, max_new_tokens=12)
+        ]
+    match = outs["xla"] == outs["pallas"]
+    emit(
+        "pallas_kernel_parity",
+        1.0 if match else 0.0,
+        "bool",
+        platform=_platform(),
+        # off-TPU the Pallas kernels run interpret=True — a pass there
+        # checks semantics, not that Mosaic compiled
+        mosaic=on_tpu,
+        tokens_xla=outs["xla"][0][:8],
+        tokens_pallas=outs["pallas"][0][:8],
+    )
+    if not match:
+        raise AssertionError(
+            f"pallas/xla token mismatch: {outs['xla']} vs {outs['pallas']}"
+        )
+    return True
+
+
+def serve_bench(on_tpu, kernels):
+    """Incremental decoding then SpecInfer on the ~3.5B flagship. The
+    LLM engine is shared between the RequestManager and the SpecInfer
+    verifier (same params, same cache pool) so the compile bill is one
+    engine + one tiny draft, not three engines. Emits the incremental
+    number as soon as it is measured — a later spec failure cannot lose
+    it."""
+    import jax
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import (
+        InferenceEngine,
+        RequestManager,
+        ServingConfig,
+        SpecConfig,
+        SpecInferManager,
+    )
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_new = 48 if on_tpu else 16
+    n_req = 4
+    prompt_len = 64 if on_tpu else 12
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_req)
+    ]
+
+    def make_sc(kern):
+        return ServingConfig(
+            max_requests_per_batch=n_req,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=32 if on_tpu else 8,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kern,
+        )
+
+    # Parity proved the kernels on a small config; the flagship shapes
+    # could still trip a Mosaic/VMEM limit — fall back to XLA with the
+    # exception REPORTED (never silently) rather than lose both serving
+    # metrics.
+    try:
+        eng = InferenceEngine(llama, cfg, params, make_sc(kernels))
+        rm = RequestManager(eng)
+        rm.generate(prompts, max_new_tokens=4)  # compile
+    except Exception as e:
+        if kernels == "xla":
+            raise
+        _log(f"kernels=pallas failed on flagship shapes, retrying xla: {e!r}")
+        traceback.print_exc(file=sys.stderr)
+        kernels = "xla"
+        eng = InferenceEngine(llama, cfg, params, make_sc(kernels))
+        rm = RequestManager(eng)
+        rm.generate(prompts, max_new_tokens=4)
+
+    # --- incremental decoding, steady state (same engine, warmed) ---
+    t0 = time.perf_counter()
+    outs = rm.generate(prompts, max_new_tokens=n_new)
+    incr_dt = time.perf_counter() - t0
+    incr_tokens = sum(len(o.output_tokens) for o in outs)
+    incr_steps = sum(o.profile.llm_decoding_steps for o in outs)
+    incr_tps = incr_tokens / incr_dt
+    emit(
+        "incr_decode_tokens_per_sec_per_chip",
+        round(incr_tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=incr_tps / 30.0,  # ~30 tok/s incremental A100 baseline
+        kernels=kernels,
+        n_requests=n_req,
+        new_tokens_per_request=n_new,
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+
+    # --- SpecInfer with a layer-skip self-draft; verifier REUSES eng ---
+    dcfg, dparams = _layer_skip_draft(cfg, params, 2)
+    spec = SpecConfig(beam_width=2, beam_depth=3)
+    mgr = SpecInferManager(
+        eng,
+        InferenceEngine(llama, dcfg, dparams, make_sc(kernels)),
+        spec,
+    )
+    mgr.generate(prompts, max_new_tokens=4)  # warm all spec programs
+    t0 = time.perf_counter()
+    outs = mgr.generate(prompts, max_new_tokens=n_new)
+    spec_dt = time.perf_counter() - t0
+    spec_tokens = sum(len(o.output_tokens) for o in outs)
+    spec_steps = sum(o.profile.llm_decoding_steps for o in outs)
+    accepted = sum(o.profile.accepted_tokens for o in outs)
+    speculated = sum(o.profile.speculated_tokens for o in outs)
+    spec_tps = spec_tokens / spec_dt
+    emit(
+        "specinfer_tokens_per_sec_per_chip",
+        round(spec_tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=spec_tps / A100_SPECINFER_TOKS_PER_SEC,
+        kernels=kernels,
+        spec_step_reduction=round(incr_steps / max(1, spec_steps), 3),
+        accept_rate=round(accepted / max(1, speculated), 3),
+        incr_tokens_per_sec=round(incr_tps, 2),
+        n_requests=n_req,
+        new_tokens_per_request=n_new,
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return spec_tps
+
+
+def _platform():
+    import jax
+
+    return jax.devices()[0].platform
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--metric",
+        default="all",
+        choices=["all", "train", "searched", "parity", "serve"],
+        help="run a single phase (default: all, cheapest first)",
+    )
+    args = ap.parse_args()
+
+    _ensure_backend()
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The container sitecustomize sets jax_platforms
+        # programmatically, which overrides the env var — force the
+        # fallback through the config API too (same as tests/conftest).
+        jax.config.update("jax_platforms", "cpu")
+
+    t0 = time.perf_counter()
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    serve = serve_bench(on_tpu)
-    train = train_bench(on_tpu)
-    value = serve["spec_tokens_per_sec"]
+    _log(f"backend up: {dev.platform} ({time.perf_counter() - t0:.1f}s)")
+
+    if args.metric in ("all", "train"):
+        run_phase("train", 420 if on_tpu else 180, train_bench, on_tpu)
+    if args.metric in ("all", "searched"):
+        run_phase(
+            "searched_train", 420 if on_tpu else 240, searched_train_bench,
+            on_tpu,
+        )
+    kernels = "xla"
+    if args.metric in ("all", "parity", "serve"):
+        ok = run_phase("kernel_parity", 300 if on_tpu else 180,
+                       kernel_parity, on_tpu)
+        kernels = "pallas" if ok else "xla"
+        if not ok:
+            _log("pallas parity failed — serve phase will run kernels=xla")
+    if args.metric in ("all", "serve"):
+        run_phase("serve", 1500 if on_tpu else 400, serve_bench, on_tpu,
+                  kernels)
+
+    # Headline line LAST (the "one JSON line" the driver records):
+    # SpecInfer if measured, else the best metric that did land.
+    for name in (
+        "specinfer_tokens_per_sec_per_chip",
+        "incr_decode_tokens_per_sec_per_chip",
+        "unity_searched_train_mfu",
+        "llama_train_mfu",
+        "pallas_kernel_parity",
+    ):
+        if name in _RESULTS:
+            print(json.dumps(_RESULTS[name]), flush=True)
+            return
+    # Nothing landed at all — still print a parseable line.
     print(
         json.dumps(
             {
-                "metric": "specinfer_tokens_per_sec_per_chip",
-                "value": value,
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(value / A100_SPECINFER_TOKS_PER_SEC, 4),
-                "detail": {
-                    **serve,
-                    **train,
-                    "train_mfu_vs_target": round(
-                        train["train_mfu"] / TRAIN_MFU_TARGET, 4
-                    ),
-                    "platform": dev.platform,
-                },
+                "metric": "bench_failed",
+                "value": 0,
+                "unit": "none",
+                "vs_baseline": 0,
             }
-        )
+        ),
+        flush=True,
     )
 
 

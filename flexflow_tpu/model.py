@@ -168,6 +168,42 @@ class FFModel:
             name,
         )
 
+    def transformer_decoder_stack(
+        self,
+        input: Tensor,
+        num_layers: int,
+        num_heads: int,
+        intermediate_size: int,
+        num_kv_heads: Optional[int] = None,
+        eps: float = 1e-6,
+        rope_theta: float = 10000.0,
+        remat: bool = True,
+        attention: str = "xla",
+        name: str = "",
+    ) -> Tensor:
+        """N fused causal decoder blocks over (B, S, D) hidden states as
+        ONE graph node (ops/fused_transformer.py): scan-over-layers +
+        remat + optional Pallas flash attention — the fast-path bridge
+        that lets ``compile(auto_parallel=True)`` reach the same program
+        quality as the hand-sharded ``models/llama.make_train_step``
+        (the reference's FusedOp + transformer substitutions,
+        src/ops/fused.cc)."""
+        return self._add(
+            "transformer_decoder_stack",
+            dict(
+                num_layers=num_layers,
+                num_heads=num_heads,
+                num_kv_heads=num_kv_heads,
+                intermediate_size=intermediate_size,
+                eps=eps,
+                rope_theta=rope_theta,
+                remat=remat,
+                attention=attention,
+            ),
+            [input],
+            name,
+        )
+
     def conv2d(
         self,
         input: Tensor,
@@ -1131,14 +1167,19 @@ class FFModel:
             else:
                 x[node.name] = rng.normal(size=spec.shape).astype(np.float32)
         out_id = self._output_ref.node_id if self._output_ref else -1
-        n_out = self.graph.nodes[out_id].out_specs[0].shape[-1]
+        out_shape = self.graph.nodes[out_id].out_specs[0].shape
+        n_out = out_shape[-1]
         loss_type = (self._compile_args or {}).get(
             "loss_type", "sparse_categorical_crossentropy"
         )
         if loss_type.startswith("sparse"):
-            y = rng.integers(0, max(2, n_out), size=bs).astype(np.int32)
+            # labels match the output's leading dims: (B,) for a
+            # classifier head, (B, S) for a sequence model
+            y = rng.integers(
+                0, max(2, n_out), size=tuple(out_shape[:-1]) or (bs,)
+            ).astype(np.int32)
         else:  # dense targets (categorical CE / MSE)
-            y = rng.normal(size=(bs, n_out)).astype(np.float32)
+            y = rng.normal(size=tuple(out_shape)).astype(np.float32)
         import time as _time
 
         # snapshot: timing runs real (donated) optimizer steps on noise;

@@ -1,5 +1,6 @@
 from .registry import OpDef, OpContext, register, get_op, all_ops
 from . import core  # noqa: F401  (registers core ops)
 from . import moe   # noqa: F401  (registers MoE ops)
+from . import fused_transformer  # noqa: F401  (fused decoder stack)
 
 __all__ = ["OpDef", "OpContext", "register", "get_op", "all_ops"]

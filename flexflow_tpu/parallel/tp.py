@@ -67,6 +67,12 @@ def apply_tensor_parallel(graph: Graph, tp_degree: int) -> Dict[str, str]:
             if attrs["num_heads"] % tp_degree == 0:
                 _set_attr(node, "tp_shard", "heads")
                 decisions[node.name] = "heads"
+        elif node.op_type == "transformer_decoder_stack":
+            attrs = node.attrs_dict
+            kv = attrs.get("num_kv_heads") or attrs["num_heads"]
+            if kv % tp_degree == 0 and attrs["intermediate_size"] % tp_degree == 0:
+                _set_attr(node, "tp_shard", "megatron")
+                decisions[node.name] = "megatron"
         # embeddings stay replicated: vocab/hidden sharding of the table is
         # a serving-time decision (lm_head fusion), not part of this pass.
 

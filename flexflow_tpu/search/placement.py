@@ -30,11 +30,18 @@ from .strategy import ParallelStrategy
 def placement_dp(
     graph: Graph,
     cost_model: CostModel,
+    mem_lambda: float = 0.0,
 ) -> ParallelStrategy:
     """Assign a sharding state to every op, minimising estimated step
     time (op roofline + resharding collectives). Returns the strategy
     with per-node choices and its estimated cost (before grad-sync,
-    which is state-independent enough to add afterwards)."""
+    which is state-independent enough to add afterwards).
+
+    ``mem_lambda`` ∈ [0, 1] mixes per-op memory into the objective —
+    (1-λ)·time + λ·mem_time — the reference's generalized cost for its
+    memory/runtime tradeoff search (memory_optimization.h MemorySearch-
+    Result, graph.cc try_one_lambda). λ=0 is the pure-time DP; the
+    reported ``estimated_step_time`` is always pure time."""
     machine = cost_model.machine
     # dp[node_id][state] = (best cumulative cost along the best
     # predecessor states, best predecessor-state pick per input edge)
@@ -52,6 +59,12 @@ def placement_dp(
         back[node.id] = {}
         for s in states:
             cost = cost_model.op_cost(graph, node, s)
+            if mem_lambda > 0.0:
+                cost = (1.0 - mem_lambda) * cost + mem_lambda * (
+                    cost_model.memory_time_equiv(
+                        cost_model.op_memory_bytes(graph, node, s)
+                    )
+                )
             picks: Dict[int, str] = {}
             for ref in node.inputs:
                 spec = graph.out_spec(ref)
@@ -59,9 +72,14 @@ def placement_dp(
                 for p_state, p_cost in dp[ref.node_id].items():
                     # amortise a shared producer's cost over its fan-out
                     fan = max(1, len(graph.consumers(ref.node_id)))
-                    c = p_cost / fan + cost_model.reshard_cost(
+                    reshard = cost_model.reshard_cost(
                         graph, spec, p_state, s
                     )
+                    # the edge term is pure time — weight it like the
+                    # time component so λ=1 really is pure memory
+                    # minimisation (else zero-reshard replicated states
+                    # beat memory-minimal TP states at every λ)
+                    c = p_cost / fan + (1.0 - mem_lambda) * reshard
                     if c < best_c:
                         best_c, best_p = c, p_state
                 cost += best_c if best_p is not None else 0.0
@@ -95,7 +113,13 @@ def placement_dp(
         choices[nid] = max(v, key=v.get)
 
     strategy = ParallelStrategy(machine=machine, choices=choices)
-    strategy.estimated_step_time = total + cost_model.grad_sync_cost(
-        graph, strategy
+    # Re-price the VOTED choices with the one shared estimator (pure
+    # time), whatever λ the DP optimised: the DP objective is optimistic
+    # at fan-outs and λ>0 mixes memory in — either would make costs
+    # incomparable across machine/λ candidates in unity.optimize.
+    from .simulator import estimate_graph_cost
+
+    strategy.estimated_step_time = estimate_graph_cost(
+        graph, strategy, cost_model
     )
     return strategy

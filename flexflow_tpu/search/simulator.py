@@ -75,6 +75,9 @@ _TP_STATES = {
     "dense": ("TP_COL", "TP_ROW"),
     "embedding": ("TP_COL",),
     "multihead_attention": ("TP_COL", "TP_ROW"),  # both stamp tp_shard=heads
+    # fused decoder stack: full Megatron layout inside the op (col QKV/up,
+    # row O/down, GSPMD all-reduces priced via internal_collectives)
+    "transformer_decoder_stack": ("TP_MEGATRON",),
 }
 _ANY = ("REP", "DP")
 
@@ -145,9 +148,9 @@ class CostModel:
             bytes_moved *= 2.0
         # work divides over the axes this state shards
         div = 1
-        if state in ("DP", "TP_COL", "TP_ROW", "SAMPLE", "ATTR"):
+        if state in ("DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR"):
             div *= self.machine.data
-        if state in ("TP_COL", "TP_ROW", "SAMPLE", "ATTR"):
+        if state in ("TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR"):
             div *= self.machine.model
         # expert parallelism: MoE expert compute splits over the expert
         # axis (reference experts_start_idx/num_experts range sharding)
@@ -155,6 +158,7 @@ class CostModel:
             "moe", "experts", "group_by", "aggregate"
         ):
             div *= self.machine.expert
+        t = None
         if self.measured:
             mult = 3.0 if self.training else 1.0
             shapes = tuple(s.shape for s in in_specs)
@@ -162,13 +166,34 @@ class CostModel:
             # unsharded forward (reference inner_measure_operator_cost
             # memo) by the shard division and fwd+bwd multiplier
             state_key = (node.op_type, node.attrs, shapes, state)
-            if state_key in self.measured:
-                return self.measured[state_key] * mult
             base_key = (node.op_type, node.attrs, shapes, "REP")
-            if base_key in self.measured:
-                return self.measured[base_key] * mult / div
-        t = compute_time(self.topo.chip, flops / div, bytes_moved / div)
+            if state_key in self.measured:
+                t = self.measured[state_key] * mult
+            elif base_key in self.measured:
+                t = self.measured[base_key] * mult / div
+        if t is None:
+            t = compute_time(self.topo.chip, flops / div, bytes_moved / div)
+        # single-device measurements never include the multi-device
+        # collectives a sharded state implies — always price them on top
+        t += self._internal_comm_cost(node, in_specs, state)
         return t
+
+    def _internal_comm_cost(self, node: OpNode, in_specs, state: str) -> float:
+        """Collectives GSPMD inserts *inside* one op under this state
+        (fused ops declare them via OpDef.internal_collectives) — e.g.
+        the per-layer Megatron all-reduces of a fused decoder stack."""
+        op = get_op(node.op_type)
+        fn = getattr(op, "internal_collectives", None)
+        if fn is None or self.machine.model <= 1:
+            return 0.0
+        total = 0.0
+        for kind, nbytes in fn(in_specs, node.attrs_dict, state, self.training):
+            if self.machine.data > 1:
+                nbytes /= self.machine.data
+            total += getattr(self.coll, kind)(
+                nbytes, self.machine.model, MODEL_AXIS
+            )
+        return total
 
     def calibrate(self, graph: Graph, iters: int = 3) -> int:
         """Measure every op's unsharded forward on the current device
@@ -194,6 +219,12 @@ class CostModel:
         """Collective cost of moving one activation between two op
         sharding states (the priced equivalents of the reference's
         Repartition/Combine/Replicate/Reduction/AllReduce nodes)."""
+        # TP_MEGATRON's boundary activations are batch-sharded
+        # full-feature tensors — exactly a DP edge
+        if producer_state == "TP_MEGATRON":
+            producer_state = "DP"
+        if consumer_state == "TP_MEGATRON":
+            consumer_state = "DP"
         if producer_state == consumer_state:
             rule = _RESHARD.get((producer_state, consumer_state))
         elif (producer_state, consumer_state) in _RESHARD:
@@ -231,6 +262,63 @@ class CostModel:
             )
         return 0.0
 
+    # ------------------------------------------------------------------
+    # memory model (reference memory_optimization.cc MemoryUsage +
+    # graph.cc:2132-2190 try_one_lambda / perform_memory_search)
+
+    # Bytes of optimizer + gradient state per parameter byte: grads (1x)
+    # + Adam m/v in f32 (2 leaves x fp32/param-dtype ratio ~2 for bf16
+    # params). Conservative for SGD; the search only needs an upper
+    # bound that scales with the right sharding.
+    opt_state_mult: float = 3.0
+
+    def op_memory_bytes(self, graph: Graph, node: OpNode, state: str) -> float:
+        """Per-device HBM bytes attributable to one op under ``state``:
+        parameters (+grads+optimizer state when training) + activations
+        saved for the backward pass. Weights shard over ``model`` only in
+        TP states (DP replicates them); activations shard over whatever
+        the state shards."""
+        if node.op_type == "input":
+            return 0.0
+        w = weight_bytes(graph, node)
+        if state in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
+            w /= self.machine.model
+        if self.training:
+            w *= 1.0 + self.opt_state_mult
+        op = get_op(node.op_type)
+        in_specs = [graph.out_spec(r) for r in node.inputs]
+        act_fn = getattr(op, "activation_bytes", None)
+        if act_fn is not None:
+            act = float(act_fn(in_specs, node.attrs_dict, self.training))
+        else:
+            act = float(sum(_nbytes(s) for s in node.out_specs))
+        div = 1
+        if state in ("DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR"):
+            div *= self.machine.data
+        if state in ("SAMPLE", "ATTR", "TP_COL"):
+            div *= self.machine.model
+        return w + act / div
+
+    def strategy_memory_bytes(
+        self, graph: Graph, strategy: ParallelStrategy
+    ) -> float:
+        """Per-device byte estimate for a whole strategy. Activations are
+        summed (the interpreted training graph keeps every intermediate
+        live for backward; fused ops report their remat footprint via
+        OpDef.activation_bytes)."""
+        return sum(
+            self.op_memory_bytes(
+                graph, node, strategy.choices.get(node.id, "DP")
+            )
+            for node in graph.nodes
+        )
+
+    def memory_time_equiv(self, nbytes: float) -> float:
+        """Convert bytes to a time-dimensioned quantity so the memory
+        term can mix with step time in a (1-λ)·time + λ·mem objective
+        (the reference's generalized cost, memory_optimization.h)."""
+        return nbytes / (self.topo.chip.hbm_bandwidth * self.topo.chip.hbm_efficiency)
+
     def grad_sync_cost(self, graph: Graph, strategy: ParallelStrategy) -> float:
         """Per-step DP gradient all-reduce over replicated weights
         (reference: NCCL optimizer path, optimizer_kernel.cu:88)."""
@@ -242,7 +330,7 @@ class CostModel:
                 continue
             nbytes = weight_bytes(graph, node)
             state = strategy.choices.get(node.id, "DP")
-            if state in ("TP_COL", "TP_ROW"):
+            if state in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
                 nbytes /= self.machine.model  # sharded grads all-reduce less
             total += nbytes
         return self.coll.all_reduce(total, self.machine.data, DATA_AXIS)

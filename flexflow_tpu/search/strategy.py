@@ -44,7 +44,7 @@ from ..core.mesh import DATA_AXIS, MODEL_AXIS, MachineSpec
 # enable_attribute_parallel, reference config.h:160-162): SAMPLE splits
 # the batch over BOTH mesh axes (weights replicated), ATTR splits a
 # non-batch activation dim (spatial/sequence) over the model axis.
-STATES = ("REP", "DP", "TP_COL", "TP_ROW", "SAMPLE", "ATTR")
+STATES = ("REP", "DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,7 +83,7 @@ class ParallelStrategy:
             if not w:
                 continue
             state = self.choices.get(node.id, "DP")
-            if state in ("TP_COL", "TP_ROW"):
+            if state in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
                 attrs = node.attrs_dict
                 attrs["tp_shard"] = self._tp_kind(node.op_type, state)
                 out[node.name] = op.weight_pspecs(in_specs, attrs, MODEL_AXIS)
@@ -93,6 +93,8 @@ class ParallelStrategy:
 
     @staticmethod
     def _tp_kind(op_type: str, state: str) -> str:
+        if state == "TP_MEGATRON":
+            return "megatron"
         if op_type == "multihead_attention":
             return "heads"
         return "col" if state == "TP_COL" else "row"
@@ -105,7 +107,7 @@ class ParallelStrategy:
         (model.cc:3347-3349)."""
         for node in graph.nodes:
             state = self.choices.get(node.id)
-            if state in ("TP_COL", "TP_ROW"):
+            if state in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
                 d = dict(node.attrs)
                 d["tp_shard"] = self._tp_kind(node.op_type, state)
                 node.attrs = tuple(sorted(d.items()))
@@ -116,7 +118,10 @@ class ParallelStrategy:
         pad = (None,) * max(0, rank - 2)
         if state == "TP_COL":  # features (last dim) sharded
             return P(data, *pad, MODEL_AXIS)
-        if state in ("DP", "TP_ROW"):
+        if state in ("DP", "TP_ROW", "TP_MEGATRON"):
+            # TP_MEGATRON keeps boundary activations full-feature; the
+            # model-axis sharding lives inside the op (GSPMD-propagated
+            # from the Megatron weight pspecs)
             return P(data)
         if state == "SAMPLE":  # batch over both axes
             both = tuple(a for a in (data, MODEL_AXIS) if a)
@@ -144,7 +149,8 @@ class ParallelStrategy:
         tools/substitutions_to_dot)."""
         colors = {
             "REP": "gray80", "DP": "lightblue", "TP_COL": "salmon",
-            "TP_ROW": "orange", "SAMPLE": "palegreen", "ATTR": "plum",
+            "TP_ROW": "orange", "TP_MEGATRON": "gold",
+            "SAMPLE": "palegreen", "ATTR": "plum",
         }
         lines = ["digraph strategy {", "  node [style=filled];"]
         for n in graph.nodes:

@@ -63,6 +63,43 @@ class SearchReport:
     machine: MachineSpec
     substitutions_applied: List[str]
     candidates_evaluated: int
+    # memory-aware search results (reference perform_memory_search,
+    # graph.cc:2132-2190)
+    memory_bytes: float = 0.0
+    memory_budget: Optional[float] = None
+    memory_lambda: float = 0.0
+    memory_feasible: bool = True
+
+
+def memory_search(
+    graph: Graph,
+    cm: CostModel,
+    budget_bytes: float,
+    *,
+    iters: int = 8,
+) -> Tuple[ParallelStrategy, float]:
+    """Binary-search the memory/runtime tradeoff λ (reference
+    ``try_one_lambda`` / ``perform_memory_search``): find the smallest λ
+    whose placement fits ``budget_bytes`` per device — i.e. give up only
+    as much runtime as HBM requires. Returns (strategy, λ); the caller
+    checks feasibility via ``cm.strategy_memory_bytes``."""
+    strat0 = placement_dp(graph, cm)
+    if cm.strategy_memory_bytes(graph, strat0) <= budget_bytes:
+        return strat0, 0.0
+    strat1 = placement_dp(graph, cm, mem_lambda=1.0)
+    if cm.strategy_memory_bytes(graph, strat1) > budget_bytes:
+        return strat1, 1.0  # even pure memory-minimisation doesn't fit
+    lo, hi = 0.0, 1.0
+    best, best_lambda = strat1, 1.0
+    for _ in range(iters):
+        mid = 0.5 * (lo + hi)
+        s = placement_dp(graph, cm, mem_lambda=mid)
+        if cm.strategy_memory_bytes(graph, s) <= budget_bytes:
+            best, best_lambda = s, mid
+            hi = mid
+        else:
+            lo = mid
+    return best, best_lambda
 
 
 def optimize(
@@ -79,13 +116,23 @@ def optimize(
     enable_attribute: bool = True,
     allow_expert: bool = True,
     extra_rules: Optional[List] = None,
+    memory_budget: Optional[float] = None,
 ) -> Tuple[Graph, ParallelStrategy, SearchReport]:
     """Joint substitution + sharding search. Returns the rewritten graph,
     the winning strategy, and a report. With ``measured`` the cost model
     calibrates per-op times on the current device first (the reference's
     on-device ``inner_measure_operator_cost``, model.cu:38).
     ``allow_expert=False`` keeps MoE expert degrees out of the grid
-    (when the config fixed the expert degree outside the search)."""
+    (when the config fixed the expert degree outside the search).
+
+    ``memory_budget`` (bytes per device; defaults to the chip's HBM
+    capacity) makes the search memory-aware: a machine/strategy whose
+    per-device footprint exceeds the budget is re-searched with the λ
+    tradeoff (:func:`memory_search`) and discarded as infeasible if even
+    pure memory-minimisation doesn't fit — so the search can no longer
+    return a strategy that OOMs the chip (reference
+    ``perform_memory_search``, graph.cc:2132-2190). Pass ``float('inf')``
+    to disable."""
     topo = topo or TPUTopology(chip=TPUChip.v5e(), num_chips=num_devices)
     has_moe = any(
         n.op_type in ("moe", "experts", "group_by") for n in graph.nodes
@@ -103,7 +150,14 @@ def optimize(
         cm0.calibrate(graph)
         shared_measured = cm0.measured
 
-    best: Optional[Tuple[float, Graph, ParallelStrategy, List[str]]] = None
+    if memory_budget is None:
+        memory_budget = topo.chip.hbm_capacity
+
+    # (feasible?, time, graph, strategy, trace, mem, λ) — feasible
+    # strategies always beat infeasible ones; within a class, min time
+    # (infeasible fallback: min memory, so we never return silently-OOM
+    # when a fitting machine exists).
+    best = None
     evaluated = 0
     for machine in machines:
         cm = CostModel(
@@ -119,16 +173,26 @@ def optimize(
         g2, cost2, trace = apply_substitutions(
             graph, cost_fn, budget=budget, alpha=alpha, rules=rules
         )
-        strat = placement_dp(g2, cm)
+        strat, lam = memory_search(g2, cm, memory_budget)
+        mem = cm.strategy_memory_bytes(g2, strat)
+        feasible = mem <= memory_budget
         evaluated += 1
-        if best is None or strat.estimated_step_time < best[0]:
-            best = (strat.estimated_step_time, g2, strat, trace)
-    cost, g_best, s_best, trace = best
+        key = (
+            not feasible,
+            strat.estimated_step_time if feasible else mem,
+        )
+        if best is None or key < best[0]:
+            best = (key, g2, strat, trace, mem, lam, feasible)
+    _, g_best, s_best, trace, mem, lam, feasible = best
     report = SearchReport(
-        best_cost=cost,
+        best_cost=s_best.estimated_step_time,
         machine=s_best.machine,
         substitutions_applied=trace,
         candidates_evaluated=evaluated,
+        memory_bytes=mem,
+        memory_budget=memory_budget,
+        memory_lambda=lam,
+        memory_feasible=feasible,
     )
     return g_best, s_best, report
 
