@@ -1,0 +1,91 @@
+"""InceptionV3-style training app (reference
+``examples/cpp/InceptionV3/inception.cc:26-120``: InceptionA/B/C
+multi-branch conv modules concatenated on the channel dim, built through
+the FFModel API). Scaled-down defaults so the CPU mesh can smoke it;
+``--full`` builds closer-to-paper widths.
+
+Run: python examples/inception_v3.py [--devices N]
+"""
+import argparse
+
+import numpy as np
+
+
+def inception_a(model, t, w, pool_features):
+    """Four branches: 1x1 / 1x1+5x5 / 1x1+3x3+3x3 / avgpool+1x1
+    (reference inception.cc:26-48), widths scaled by w."""
+    b1 = model.conv2d(t, 4 * w, 1, 1, 1, 1, 0, 0, activation="relu")
+    b2 = model.conv2d(t, 3 * w, 1, 1, 1, 1, 0, 0, activation="relu")
+    b2 = model.conv2d(b2, 4 * w, 5, 5, 1, 1, 2, 2, activation="relu")
+    b3 = model.conv2d(t, 4 * w, 1, 1, 1, 1, 0, 0, activation="relu")
+    b3 = model.conv2d(b3, 6 * w, 3, 3, 1, 1, 1, 1, activation="relu")
+    b3 = model.conv2d(b3, 6 * w, 3, 3, 1, 1, 1, 1, activation="relu")
+    b4 = model.pool2d(t, 3, 3, 1, 1, 1, 1, pool_type="avg")
+    b4 = model.conv2d(b4, pool_features, 1, 1, 1, 1, 0, 0, activation="relu")
+    return model.concat([b1, b2, b3, b4], axis=1)
+
+
+def inception_b(model, t, w):
+    """Grid-size reduction: stride-2 branches + maxpool
+    (reference inception.cc:50-62)."""
+    b1 = model.conv2d(t, 12 * w, 3, 3, 2, 2, 0, 0, activation="relu")
+    b2 = model.conv2d(t, 4 * w, 1, 1, 1, 1, 0, 0, activation="relu")
+    b2 = model.conv2d(b2, 6 * w, 3, 3, 1, 1, 1, 1, activation="relu")
+    b2 = model.conv2d(b2, 6 * w, 3, 3, 2, 2, 0, 0, activation="relu")
+    b3 = model.pool2d(t, 3, 3, 2, 2, 0, 0, pool_type="max")
+    return model.concat([b1, b2, b3], axis=1)
+
+
+def inception_c(model, t, w):
+    """Factorized 7x7 branches approximated at reduced width with
+    (1x7)(7x1) pairs (reference inception.cc:64-100)."""
+    b1 = model.conv2d(t, 6 * w, 1, 1, 1, 1, 0, 0, activation="relu")
+    b2 = model.conv2d(t, 4 * w, 1, 1, 1, 1, 0, 0, activation="relu")
+    b2 = model.conv2d(b2, 4 * w, 1, 7, 1, 1, 0, 3, activation="relu")
+    b2 = model.conv2d(b2, 6 * w, 7, 1, 1, 1, 3, 0, activation="relu")
+    b3 = model.pool2d(t, 3, 3, 1, 1, 1, 1, pool_type="avg")
+    b3 = model.conv2d(b3, 6 * w, 1, 1, 1, 1, 0, 0, activation="relu")
+    return model.concat([b1, b2, b3], axis=1)
+
+
+def build(model, batch_size, image_size=32, num_classes=10, w=4):
+    t = model.create_tensor((batch_size, 3, image_size, image_size), name="x")
+    t = model.conv2d(t, 2 * w, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = inception_a(model, t, w, pool_features=2 * w)
+    t = inception_b(model, t, w)
+    t = inception_c(model, t, w)
+    t = model.mean(t, axes=(2, 3))
+    t = model.dense(t, num_classes)
+    return model.softmax(t)
+
+
+def main(num_devices=1, epochs=2, batch_size=16, image_size=16, w=2,
+         n_samples=128, num_classes=10):
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(
+        batch_size=batch_size, epochs=epochs, num_devices=num_devices
+    )
+    model = ff.FFModel(cfg)
+    build(model, batch_size, image_size, num_classes, w)
+    model.compile(
+        optimizer=ff.SGDOptimizer(lr=0.02, momentum=0.9),
+        loss_type="sparse_categorical_crossentropy",
+        metrics=("accuracy",),
+    )
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, num_classes, size=n_samples).astype(np.int32)
+    x = rng.normal(size=(n_samples, 3, image_size, image_size)).astype(
+        np.float32
+    )
+    x += y[:, None, None, None].astype(np.float32) / 8
+    perf = model.fit(x, y)
+    return perf.averages()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=2)
+    a = p.parse_args()
+    print(main(num_devices=a.devices, epochs=a.epochs))

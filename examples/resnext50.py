@@ -1,0 +1,76 @@
+"""ResNeXt-style training app — grouped-convolution bottleneck blocks
+(reference ``examples/cpp/resnext50/resnext.cc``: the ResNet bottleneck
+with ``groups=32`` cardinality). Scaled-down defaults for the CPU mesh.
+
+Run: python examples/resnext50.py [--devices N]
+"""
+import argparse
+
+import numpy as np
+
+
+def resnext_block(model, t, channels, cardinality, stride=1):
+    """1x1 reduce → 3x3 grouped conv (cardinality groups) → 1x1 expand +
+    projection skip (reference resnext.cc bottleneck)."""
+    skip = t
+    out = model.conv2d(t, channels, 1, 1, 1, 1, 0, 0)
+    out = model.batch_norm(out, relu=True)
+    out = model.conv2d(
+        out, channels, 3, 3, stride, stride, 1, 1, groups=cardinality
+    )
+    out = model.batch_norm(out, relu=True)
+    out = model.conv2d(out, 2 * channels, 1, 1, 1, 1, 0, 0)
+    out = model.batch_norm(out, relu=False)
+    if stride != 1 or t.shape[1] != 2 * channels:
+        skip = model.conv2d(t, 2 * channels, 1, 1, stride, stride, 0, 0)
+        skip = model.batch_norm(skip, relu=False)
+    out = model.add(out, skip)
+    return model.relu(out)
+
+
+def build(model, batch_size, image_size=32, num_classes=10,
+          stages=(1, 1, 1), base=16, cardinality=4):
+    t = model.create_tensor((batch_size, 3, image_size, image_size), name="x")
+    t = model.conv2d(t, base, 3, 3, 1, 1, 1, 1, activation="relu")
+    ch = base
+    for i, blocks in enumerate(stages):
+        for b in range(blocks):
+            stride = 2 if (i > 0 and b == 0) else 1
+            t = resnext_block(model, t, ch, cardinality, stride)
+        ch *= 2
+    t = model.mean(t, axes=(2, 3))
+    t = model.dense(t, num_classes)
+    return model.softmax(t)
+
+
+def main(num_devices=1, epochs=2, batch_size=16, image_size=16,
+         stages=(1, 1), base=8, cardinality=4, n_samples=128):
+    import flexflow_tpu as ff
+
+    cfg = ff.FFConfig(
+        batch_size=batch_size, epochs=epochs, num_devices=num_devices
+    )
+    model = ff.FFModel(cfg)
+    build(model, batch_size, image_size, stages=stages, base=base,
+          cardinality=cardinality)
+    model.compile(
+        optimizer=ff.SGDOptimizer(lr=0.02, momentum=0.9),
+        loss_type="sparse_categorical_crossentropy",
+        metrics=("accuracy",),
+    )
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, size=n_samples).astype(np.int32)
+    x = rng.normal(size=(n_samples, 3, image_size, image_size)).astype(
+        np.float32
+    )
+    x += y[:, None, None, None].astype(np.float32) / 4
+    perf = model.fit(x, y)
+    return perf.averages()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=2)
+    a = p.parse_args()
+    print(main(num_devices=a.devices, epochs=a.epochs))

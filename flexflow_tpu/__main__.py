@@ -18,7 +18,18 @@ leading dashes (-tensor-parallelism-degree / --tensor-parallelism-degree).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+if os.environ.get("JAX_PLATFORMS"):
+    # The container sitecustomize (axon plugin) sets jax_platforms
+    # PROGRAMMATICALLY, which overrides the env var — re-assert the
+    # user's explicit choice so `JAX_PLATFORMS=cpu python -m
+    # flexflow_tpu ...` behaves as documented (same fix as
+    # tests/conftest.py and bench.py).
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 def _degree_args(p: argparse.ArgumentParser):
@@ -104,7 +115,8 @@ def cmd_serve(args):
             ssms = [SSM(llm.family, dcfg, dparams, mesh=mesh)]
         spec = SpecConfig(beam_width=2, beam_depth=4)
     llm.compile(sc, ssms=ssms, spec=spec,
-                quantization=args.quantization, offload=args.offload)
+                quantization=args.quantization, offload=args.offload,
+                output_file=args.output_file)
     prompts = args.prompt or [[3, 17, 91, 42, 7]]
     gen = GenerationConfig(num_beams=args.num_beams)
     outs = llm.generate(
@@ -172,6 +184,9 @@ def main(argv=None):
     s.add_argument("--quantization", choices=["int8", "int4"], default=None)
     s.add_argument("--offload", action="store_true")
     s.add_argument("--pallas", action="store_true")
+    # reference -output-file (request_manager.cc:417-440): append each
+    # finished request's latency/steps/token-ids
+    s.add_argument("--output-file", "-output-file", default=None)
     _degree_args(s)
     s.set_defaults(fn=cmd_serve)
 

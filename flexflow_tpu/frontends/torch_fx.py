@@ -22,13 +22,38 @@ import numpy as np
 class PyTorchModel:
     """Wraps a ``torch.nn.Module``; ``to_ff(ffmodel, input_tensors)``
     replays its fx graph as FFModel layers and returns the outputs
-    (reference ``PyTorchModel.torch_to_ff``)."""
+    (reference ``PyTorchModel.torch_to_ff``).
 
-    def __init__(self, module, batch_size: Optional[int] = None):
+    HuggingFace ``PreTrainedModel``s are traced through
+    ``transformers.utils.fx.symbolic_trace`` (shape-dependent control
+    flow defeats plain ``torch.fx``), matching the reference's
+    HF-traceable importer (reference
+    ``python/flexflow/torch/model.py:2408-2444`` + ``tests/align``).
+    Pass ``input_names`` (e.g. ``["input_ids", "attention_mask"]``) to
+    pick the traced signature."""
+
+    def __init__(
+        self,
+        module,
+        batch_size: Optional[int] = None,
+        input_names: Optional[Sequence[str]] = None,
+    ):
         import torch.fx
 
         self.module = module.eval()
-        self.graph_module = torch.fx.symbolic_trace(module)
+        traced = None
+        try:
+            from transformers import PreTrainedModel
+
+            if isinstance(module, PreTrainedModel):
+                from transformers.utils import fx as hf_fx
+
+                traced = hf_fx.symbolic_trace(
+                    module, input_names=list(input_names or ["input_ids"])
+                )
+        except ImportError:
+            pass
+        self.graph_module = traced or torch.fx.symbolic_trace(module)
         self.batch_size = batch_size
 
     # ------------------------------------------------------------------
@@ -60,8 +85,10 @@ class PyTorchModel:
                 continue
             if node.op == "output":
                 args = node.args[0]
+                if isinstance(args, dict):  # HF ModelOutput-shaped returns
+                    args = list(args.values())
                 outputs = list(args) if isinstance(args, (tuple, list)) else [args]
-                outputs = [env[a.name] for a in outputs]
+                outputs = [self._arg(env, a) for a in outputs]
                 continue
             if node.op == "call_module":
                 mod = self.graph_module.get_submodule(node.target)
@@ -69,9 +96,21 @@ class PyTorchModel:
             elif node.op in ("call_function", "call_method"):
                 env[node.name] = self._function_node(ffmodel, node, env)
             elif node.op == "get_attr":
-                raise NotImplementedError(
-                    f"get_attr nodes (free parameters) unsupported: {node.target}"
-                )
+                # registered buffers (position_ids, token_type_ids,
+                # causal masks): fold to numpy, materialised as a
+                # `constant` op only if an FF op consumes them. A
+                # TRAINABLE nn.Parameter must not be silently frozen
+                # into a constant — keep the loud failure for those.
+                obj = self.module
+                for part in node.target.split("."):
+                    obj = getattr(obj, part)
+                if isinstance(obj, torch.nn.Parameter):
+                    raise NotImplementedError(
+                        f"get_attr on trainable parameter {node.target!r}: "
+                        "folding it to a constant would silently freeze "
+                        "it; wrap it in a module the importer understands"
+                    )
+                env[node.name] = obj.detach().cpu().numpy()
         ffmodel._imported_params = getattr(ffmodel, "_imported_params", {})
         ffmodel._imported_params.update(self._weights)
         return outputs
@@ -86,11 +125,41 @@ class PyTorchModel:
     # ------------------------------------------------------------------
 
     def _arg(self, env, a):
+        """Recursively resolve fx Nodes — indices arrive as tuples of
+        slices whose bounds are themselves traced size() nodes."""
         import torch.fx
 
         if isinstance(a, torch.fx.Node):
             return env[a.name]
+        if isinstance(a, slice):
+            return slice(
+                self._arg(env, a.start),
+                self._arg(env, a.stop),
+                self._arg(env, a.step),
+            )
+        if isinstance(a, (tuple, list)):
+            return type(a)(self._arg(env, x) for x in a)
+        if isinstance(a, dict):
+            return {k: self._arg(env, v) for k, v in a.items()}
         return a
+
+    @staticmethod
+    def _is_ff(v) -> bool:
+        return hasattr(v, "ref")
+
+    def _ensure_ff(self, ff, v, name: str):
+        """Materialise a folded numpy value as a `constant` op the
+        moment a real FF op needs it as input."""
+        if self._is_ff(v):
+            return v
+        return ff.constant(np.asarray(v), name=f"{name}_const")
+
+    @staticmethod
+    def _np_dtype(dt):
+        """torch.dtype / np.dtype / DataType-ish → numpy dtype (int4 has
+        no numpy equivalent and never appears in traced graphs)."""
+        s = str(dt).replace("torch.", "")
+        return np.dtype({"long": "int64", "half": "float16"}.get(s, s))
 
     def _module_node(self, ff, node, mod, env):
         import torch.nn as nn
@@ -119,6 +188,7 @@ class PyTorchModel:
             self._weights[name] = w
             return out
         if isinstance(mod, nn.Embedding):
+            x = self._ensure_ff(ff, x, name)  # folded position-id buffers
             out = ff.embedding(x, mod.num_embeddings, mod.embedding_dim, name=name)
             self._weights[name] = {"table": mod.weight.detach().numpy()}
             return out
@@ -175,21 +245,126 @@ class PyTorchModel:
         kwargs = {k: self._arg(env, v) for k, v in node.kwargs.items()}
         t = node.target
         name = node.name
+        tname = getattr(t, "__name__", str(t))
+
+        # ---- meta values: when no FF tensor is involved, the node is
+        # shape/buffer arithmetic from the trace — fold it eagerly (the
+        # reference's importer resolves symbolic shapes the same way)
+        any_ff = any(
+            self._is_ff(v)
+            for v in (*args, *kwargs.values())
+        ) or any(
+            isinstance(v, (tuple, list)) and any(self._is_ff(x) for x in v)
+            for v in args
+        )
+        if not any_ff:
+            folded = self._fold_meta(tname, t, args, kwargs)
+            if folded is not NotImplemented:
+                return folded
 
         if t in (operator.add, torch.add, "add"):
-            if hasattr(args[1], "ref"):
+            if self._is_ff(args[0]) and self._is_ff(args[1]):
                 return ff.add(args[0], args[1], name=name)
+            if not self._is_ff(args[1]) and np.ndim(args[1]) > 0:
+                return ff.add(
+                    args[0], self._ensure_ff(ff, args[1], name), name=name
+                )
+            if not self._is_ff(args[0]):  # scalar + tensor
+                return ff.scalar_add(args[1], float(args[0]), name=name)
             return ff.scalar_add(args[0], float(args[1]), name=name)
         if t in (operator.mul, torch.mul, "mul"):
-            if hasattr(args[1], "ref"):
-                return ff.multiply(args[0], args[1], name=name)
-            return ff.scalar_multiply(args[0], float(args[1]), name=name)
-        if t in (operator.sub, torch.sub, "sub"):
-            if hasattr(args[1], "ref"):
-                return ff.subtract(args[0], args[1], name=name)
-            return ff.scalar_sub(args[0], float(args[1]), name=name)
+            a, b = args[0], args[1]
+            if not self._is_ff(a):
+                a, b = b, a  # commutative: tensor first
+            if self._is_ff(b) or np.ndim(b) > 0:
+                return ff.multiply(
+                    a, self._ensure_ff(ff, b, name), name=name
+                )
+            return ff.scalar_multiply(a, float(b), name=name)
+        if t in (operator.sub, torch.sub, "sub", "rsub", torch.rsub):
+            a, b = args[0], args[1]
+            if t in ("rsub", torch.rsub):
+                a, b = b, a
+            if self._is_ff(a) and (self._is_ff(b) or np.ndim(b) > 0):
+                return ff.subtract(
+                    a, self._ensure_ff(ff, b, name), name=name
+                )
+            if not self._is_ff(a):  # scalar/array - tensor
+                neg = ff.scalar_multiply(b, -1.0, name=f"{name}_neg")
+                if np.ndim(a) > 0:
+                    return ff.add(
+                        neg, self._ensure_ff(ff, a, name), name=name
+                    )
+                return ff.scalar_add(neg, float(a), name=name)
+            return ff.scalar_sub(a, float(b), name=name)
         if t in (operator.truediv, torch.div, "div"):
             return ff.scalar_truediv(args[0], float(args[1]), name=name)
+        if tname == "scaled_dot_product_attention":
+            return self._sdpa(ff, name, args, kwargs)
+        if t is getattr:
+            obj, attr = args[0], args[1]
+            if self._is_ff(obj):
+                if attr == "dtype":
+                    return np.dtype(obj.spec.dtype.value)
+                if attr == "shape":
+                    return tuple(obj.shape)
+                raise NotImplementedError(f"getattr({attr!r}) on traced tensor")
+            return getattr(obj, attr)
+        if t is operator.getitem or tname == "getitem":
+            return self._getitem(ff, name, args[0], args[1])
+        if tname in ("masked_fill", "masked_fill_"):
+            x, m, v = args[0], args[1], args[2]
+            # fill where m (a 0/1-valued traced mask) is set:
+            # x·(1-m) + m·v — elementwise, broadcasting like torch.
+            # ±inf fills (the standard attention-mask idiom) are clamped
+            # to the framework's finite mask constant: m·(-inf) would
+            # turn every UNmasked position into 0·-inf = NaN.
+            v = float(np.clip(float(v), -1e30, 1e30))
+            m = self._ensure_ff(ff, m, name)
+            keep = ff.scalar_add(
+                ff.scalar_multiply(m, -1.0, name=f"{name}_negm"),
+                1.0,
+                name=f"{name}_keep",
+            )
+            return ff.add(
+                ff.multiply(x, keep, name=f"{name}_kept"),
+                ff.scalar_multiply(m, float(v), name=f"{name}_fill"),
+                name=name,
+            )
+        if tname in ("expand", "expand_as"):
+            # consumers broadcast; shape metadata alone needs no op
+            return args[0]
+        if tname in ("to", "type_as", "float", "bool", "contiguous", "clone",
+                     "detach") or t is torch.clone:
+            x = args[0]
+            if tname == "to" and len(args) > 1 and self._is_ff(x):
+                try:
+                    target = self._np_dtype(args[1])
+                except TypeError:
+                    return x  # .to(device) / .to(memory_format)
+                if target == np.bool_:
+                    # traced masks are already 0/1-valued floats
+                    return x
+                if str(target) != x.spec.dtype.value:
+                    return ff.cast(x, str(target), name=name)
+            return x
+        if tname == "size":
+            x = args[0]
+            shape = tuple(int(d) for d in x.shape)
+            return shape[args[1]] if len(args) > 1 else shape
+        if tname == "dim":
+            return len(args[0].shape)
+        if tname in ("unsqueeze",):
+            x = args[0]
+            d = args[1] % (len(x.shape) + 1)
+            shape = list(x.shape)
+            shape.insert(d, 1)
+            return ff.reshape(x, tuple(shape), name=name)
+        if tname == "permute":
+            perm = args[1] if isinstance(args[1], (tuple, list)) else args[1:]
+            return ff.transpose(args[0], tuple(int(p) for p in perm), name=name)
+        if t in (torch.matmul, torch.bmm, "matmul", "bmm"):
+            return ff.batch_matmul(args[0], args[1], name=name)
         if t in (F.relu, torch.relu, "relu"):
             return ff.relu(args[0], name=name)
         if t in (F.gelu, "gelu"):
@@ -209,11 +384,19 @@ class PyTorchModel:
             axis = kwargs.get("dim", args[1] if len(args) > 1 else 0)
             return ff.concat(tensors, axis=axis, name=name)
         if t in (torch.reshape, "reshape", "view"):
+            x = args[0]
             shape = args[1] if isinstance(args[1], (tuple, list)) else args[1:]
-            shape = tuple(int(s) for s in shape)
-            if shape[0] == -1 and self.batch_size is not None:
-                shape = (self.batch_size,) + shape[1:]
-            return ff.reshape(args[0], shape, name=name)
+            shape = [int(s) for s in shape]
+            if -1 in shape:  # resolve from the static input shape
+                total = 1
+                for d in x.shape:
+                    total *= int(d)
+                known = 1
+                for s in shape:
+                    if s != -1:
+                        known *= s
+                shape[shape.index(-1)] = total // known
+            return ff.reshape(x, tuple(shape), name=name)
         if t in (torch.transpose, "transpose"):
             x = args[0]
             d0, d1 = int(args[1]), int(args[2])
@@ -225,8 +408,110 @@ class PyTorchModel:
             return ff.exp(args[0], name=name)
         if t in (torch.pow, operator.pow, "pow"):
             return ff.pow(args[0], float(args[1]), name=name)
-        if t == "contiguous" or t is torch.clone:
-            return args[0]
         if t in (F.dropout, "dropout"):
             return ff.dropout(args[0], rate=kwargs.get("p", 0.5), name=name)
         raise NotImplementedError(f"fx function/method {t} unsupported")
+
+    # -- traced-transformer helpers ------------------------------------
+
+    @staticmethod
+    def _fold_meta(tname, t, args, kwargs):
+        """Evaluate a node eagerly when every argument is a folded
+        python/numpy value (shape arithmetic, buffer slicing, dtype
+        plumbing from the HF trace). Returns NotImplemented when the
+        target isn't meta-foldable."""
+        import torch
+
+        if t in (operator.add, operator.sub, operator.mul, operator.eq,
+                 operator.floordiv, operator.truediv, operator.getitem):
+            return t(*args)
+        if tname == "expand":
+            return np.broadcast_to(
+                np.asarray(args[0]), tuple(int(d) for d in args[1:])
+            )
+        if tname == "size":
+            shape = tuple(np.asarray(args[0]).shape)
+            return shape[args[1]] if len(args) > 1 else shape
+        if tname == "dim":
+            return np.asarray(args[0]).ndim
+        if t is torch.tensor or tname == "tensor":
+            dt = kwargs.get("dtype")
+            return np.asarray(
+                args[0],
+                dtype=PyTorchModel._np_dtype(dt) if dt is not None else None,
+            )
+        if t is torch.finfo or tname == "finfo":
+            return np.finfo(PyTorchModel._np_dtype(args[0]))
+        if t is getattr:
+            return getattr(args[0], args[1])
+        if tname in ("to", "contiguous", "clone", "detach", "float", "bool"):
+            return args[0]
+        return NotImplemented
+
+    def _getitem(self, ff, name, obj, idx):
+        """getitem over folded values (tuples, buffers) or over traced
+        tensors — the latter only for the None/full-slice indexing HF
+        uses to grow mask dims (``mask[:, None, None, :]``)."""
+        if not self._is_ff(obj):
+            return obj[idx]
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        shape = list(obj.shape)
+        out_shape = []
+        dim = 0
+        for e in idx:
+            if e is None:
+                out_shape.append(1)
+            elif isinstance(e, slice):
+                start, stop, step = e.indices(shape[dim])
+                if step != 1:
+                    raise NotImplementedError("strided tensor slicing")
+                if (start, stop) != (0, shape[dim]):
+                    raise NotImplementedError(
+                        "partial tensor slicing (only full slices / None "
+                        "unsqueezing supported on traced tensors)"
+                    )
+                out_shape.append(shape[dim])
+                dim += 1
+            else:
+                raise NotImplementedError(
+                    f"integer tensor indexing in trace: {idx}"
+                )
+        out_shape.extend(shape[dim:])
+        return ff.reshape(obj, tuple(out_shape), name=name)
+
+    def _sdpa(self, ff, name, args, kwargs):
+        """torch.scaled_dot_product_attention → QK^T·scale (+ additive
+        mask) → softmax → PV, on existing graph ops (the training-path
+        attention; the reference's traced MHA lowers to its attention op
+        the same way)."""
+        import math
+
+        q, k, v = args[0], args[1], args[2]
+        mask = kwargs.get("attn_mask", args[3] if len(args) > 3 else None)
+        is_causal = kwargs.get(
+            "is_causal", args[5] if len(args) > 5 else False
+        )
+        dk = int(q.shape[-1])
+        n = len(k.shape)
+        perm = list(range(n))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        kt = ff.transpose(k, tuple(perm), name=f"{name}_kT")
+        scores = ff.scalar_multiply(
+            ff.batch_matmul(q, kt, name=f"{name}_qk"),
+            kwargs.get("scale") or 1.0 / math.sqrt(dk),
+            name=f"{name}_scaled",
+        )
+        if is_causal:
+            S, T = int(q.shape[-2]), int(k.shape[-2])
+            causal = np.where(
+                np.tril(np.ones((S, T), bool)), 0.0, -1e9
+            ).astype(np.float32)
+            mask_ff = ff.constant(causal, name=f"{name}_causal")
+            scores = ff.add(scores, mask_ff, name=f"{name}_cmasked")
+        if mask is not None:
+            scores = ff.add(
+                scores, self._ensure_ff(ff, mask, name), name=f"{name}_masked"
+            )
+        probs = ff.softmax(scores, axis=-1, name=f"{name}_probs")
+        return ff.batch_matmul(probs, v, name=name)

@@ -168,6 +168,25 @@ class FFModel:
             name,
         )
 
+    def constant(self, value, name: str = "") -> Tensor:
+        """Inline constant tensor (frontend-imported buffers: position
+        ids, masks)."""
+        value = np.asarray(value)
+        if value.dtype == np.int64:
+            value = value.astype(np.int32)
+        if value.dtype == np.float64:
+            value = value.astype(np.float32)
+        return self._add(
+            "constant",
+            dict(
+                shape=tuple(value.shape),
+                dtype=str(value.dtype),
+                data=value.tobytes(),
+            ),
+            [],
+            name,
+        )
+
     def transformer_decoder_stack(
         self,
         input: Tensor,
@@ -445,6 +464,24 @@ class FFModel:
             name,
         )
 
+    def aggregate_spec(
+        self,
+        expert_out: Tensor,
+        combine: Tensor,
+        probs: Tensor,
+        name: str = "",
+    ):
+        """Spec-mode combine: fixed routing, no gate gradient / aux loss
+        (reference ``FFModel::aggregate_spec``, ops/aggregate_spec.h:14)."""
+        return self._add(
+            "aggregate_spec", {}, [expert_out, combine, probs], name
+        )
+
+    def cache(self, input: Tensor, name: str = ""):
+        """Memoize an activation across batches; inference serves the
+        cached copy (reference ``FFModel::cache``, ops/cache.h:8)."""
+        return self._add("cache", {}, [input], name)
+
     def moe(
         self,
         input: Tensor,
@@ -676,6 +713,16 @@ class FFModel:
         rewritten = False
         if cfgf.import_strategy_file:
             strategy = unity.ParallelStrategy.load(cfgf.import_strategy_file)
+            if strategy.graph is not None:
+                # The exported search rewrote the graph: adopt the
+                # rewritten graph so the imported per-node choices bind
+                # to the node ids they were searched for (reference
+                # deserializes graph + views together, graph.cc:2225).
+                rewritten = strategy.graph is not self.graph
+                self.graph = strategy.graph
+                self.input_nodes = [
+                    n.id for n in self.graph.nodes if n.op_type == "input"
+                ]
         else:
             assert output is None or output.ref.node_id == len(self.graph.nodes) - 1, (
                 "auto_parallel currently requires the output to be the "
@@ -734,7 +781,7 @@ class FFModel:
             // cfgf.sequence_parallelism_degree
         )
         if cfgf.export_strategy_file:
-            strategy.save(cfgf.export_strategy_file)
+            strategy.save(cfgf.export_strategy_file, graph=self.graph)
         return rewritten
 
     def _param_shardings(self):

@@ -391,6 +391,16 @@ def make_train_step(
     if not pipeline:
         sp = mesh.shape[SEQ_AXIS] > 1
         if sp:
+            if attention == "flash":
+                # explicit kernel choices must not be silently ignored
+                from ..logging_utils import get_logger
+
+                get_logger("model").warning(
+                    "attention='flash' requested but the mesh has seq=%d: "
+                    "sequence parallelism uses ring attention instead "
+                    "(flash+SP composition is not implemented)",
+                    mesh.shape[SEQ_AXIS],
+                )
             attn_fn = make_sp_attention(mesh, "ring")
         elif attention == "flash":
             attn_fn = make_flash_attention()

@@ -71,6 +71,34 @@ class InputOp(OpDef):
 
 
 @register
+class ConstantOp(OpDef):
+    """Inline constant tensor (no inputs, no weights): the value lives in
+    the attrs as raw bytes and bakes into the compiled program. Used by
+    frontends for traced buffers (position ids, causal masks) — the
+    reference materialises such buffers as frozen weight tensors
+    (python/flexflow/torch/model.py attribute tensors)."""
+
+    type = "constant"
+
+    def infer(self, in_specs, attrs):
+        return [TensorSpec(tuple(attrs["shape"]), attrs["dtype"])]
+
+    def _value(self, attrs):
+        import numpy as np
+
+        dt = DataType.from_any(attrs["dtype"])
+        return np.frombuffer(
+            attrs["data"], dtype=np.dtype(dt.value)
+        ).reshape(tuple(attrs["shape"]))
+
+    def forward(self, weights, inputs, attrs, ctx):
+        return [jnp.asarray(self._value(attrs))]
+
+    def flops(self, in_specs, attrs):
+        return 0
+
+
+@register
 class WeightOp(OpDef):
     """WEIGHT placeholder node (standalone trainable tensor)."""
 

@@ -335,3 +335,74 @@ class ExpertsOp(OpDef):
         E, F = attrs["num_experts"], attrs["expert_hidden"]
         C = _capacity(N, E, attrs["top_k"], attrs.get("capacity_factor", 2.0))
         return 4 * E * C * D * F
+
+
+@register
+class AggregateSpecOp(OpDef):
+    """Spec-mode weighted combine of expert outputs — reference
+    ``src/ops/aggregate_spec.cc`` (``include/flexflow/ops/
+    aggregate_spec.h:14``): during speculative/beam decoding the routing
+    decisions come from the draft pass and are FIXED, so unlike
+    :class:`AggregateOp` the combine weights carry no gradient and no
+    load-balance aux loss is accumulated. Inputs match ``aggregate``:
+    expert_out (E, C, D), combine (N, E, C), probs (N, E)."""
+
+    type = "aggregate_spec"
+
+    def infer(self, in_specs, attrs):
+        eo, combine, probs = in_specs
+        return [TensorSpec((combine.shape[0], eo.shape[-1]), eo.dtype)]
+
+    def forward(self, weights, inputs, attrs, ctx):
+        expert_out, combine, _probs = inputs
+        combine = lax.stop_gradient(combine)  # routing fixed in spec mode
+        y = jnp.einsum(
+            "nec,ecd->nd", combine, expert_out,
+            preferred_element_type=jnp.float32,
+        ).astype(expert_out.dtype)
+        return [y]
+
+    def flops(self, in_specs, attrs):
+        eo, combine, _ = in_specs
+        return 2 * combine.num_elements * eo.shape[-1]
+
+
+@register
+class CacheOp(OpDef):
+    """Activation cache — reference ``src/ops/cache.cc``
+    (``include/flexflow/ops/cache.h:8``): memoize an upstream tensor
+    (e.g. embeddings of a repeated static batch) and serve the cached
+    copy at inference, refreshed whenever the op runs in training mode.
+    The reference triggers refresh through a host ``cache_update`` task
+    and a staleness score; functionally the cached value lives in the
+    model's non-trainable state collection here (like batch-norm running
+    stats) and updates out-of-gradient."""
+
+    type = "cache"
+
+    def infer(self, in_specs, attrs):
+        return [in_specs[0]]
+
+    def init_state(self, in_specs, attrs):
+        (x,) = in_specs
+        return {
+            "value": jnp.zeros(x.shape, x.jnp_dtype),
+            "valid": jnp.zeros((), jnp.bool_),
+        }
+
+    def forward(self, weights, inputs, attrs, ctx):
+        (x,) = inputs
+        st = ctx.state.get(attrs["_node"]) if ctx.state else None
+        if ctx.training or st is None:
+            if ctx.state_updates is not None:
+                ctx.state_updates[attrs["_node"]] = {
+                    "value": lax.stop_gradient(x),
+                    "valid": jnp.ones((), jnp.bool_),
+                }
+            return [x]
+        # inference: serve the cached copy when it exists, else the
+        # live input (first run before any training step)
+        return [jnp.where(st["valid"], st["value"].astype(x.dtype), x)]
+
+    def flops(self, in_specs, attrs):
+        return 0

@@ -97,32 +97,43 @@ def make_pipelined_serve(
     cache_spec: Any,
     row_specs: tuple = (),
     x_spec: P = None,
+    num_microbatches: int = None,
 ):
-    """Pipeline-parallel *serving* step over the ``pipe`` axis.
+    """Pipeline-parallel *serving* step over the ``pipe`` axis, with
+    inter-batch overlap.
 
     The reference pipelines inference by mapping layer ranges to stages
-    (reference ``src/runtime/inference_manager.cc:91-133``). Here each
+    and keeps up to 4 batches in flight across them (reference
+    ``src/runtime/inference_manager.cc:91-133`` stage mapping +
+    ``request_manager.cc:2310-2325`` batch-future pipeline). Here each
     stage holds its slice of the stacked layer params AND of the
-    layer-major KV cache; the batch's activations flow stage-to-stage
-    over the ICI ring via ``ppermute``. ``stage_fn(stage_layers,
-    stage_caches, h, row_args) -> (h, new_caches)`` runs one stage's
-    local layer stack, updating its local cache slice. ``row_args`` is
-    a pytree (e.g. a dict of masks/positions/rope tables) forwarded to
-    ``stage_fn`` verbatim; ``row_specs`` must mirror its structure.
+    layer-major KV cache, and the request slots are split into
+    ``num_microbatches`` groups that flow through the stages
+    GPipe-style: while stage 1 runs group 0, stage 0 already runs group
+    1 — ≥2 batches in flight, the reference's overlap. Activations move
+    stage-to-stage over the ICI ring via ``ppermute``.
 
-    Runs ``num_stages`` ticks: at tick t stage t consumes real
-    activations (earlier stages' outputs), so stage s's cache update is
-    committed only at tick s. Output is valid on the last stage at the
-    final tick, rotated to stage 0 by the ppermute, then broadcast.
+    ``stage_fn(stage_layers, stage_caches, h, row_args) -> (h,
+    new_caches)`` runs one stage's local layer stack over ONE slot
+    group, updating that group's rows of its local cache slice (slot
+    slicing happens here, outside ``stage_fn``). ``row_args`` is a
+    pytree of per-slot tensors (masks, positions, rope tables) with
+    leading dim = slots; they are grouped the same way. They must be
+    passed as args, NOT captured by closure: closures replicate over
+    manual axes, which would mismatch the slot-sharded activations.
+
+    Schedule: ``M + S - 1`` ticks for M groups over S stages, each tick
+    costing (layers/S × slots/M) — stage-tick utilisation M/(M+S-1)
+    versus 1/S for the unoverlapped single-batch schedule. Defaults to
+    M = S groups when the local slot count divides evenly, else M = 1
+    (the old schedule). Stage s's group-m cache commit happens at tick
+    s+m; garbage ticks are masked out. The final stage banks each
+    finished group; the banked full batch is broadcast with a psum.
 
     Partial-manual shard_map: ``pipe`` AND ``data`` are manual (each DP
     group serves its own request slots, so the KV-cache scatter stays
-    shard-local — the SPMD partitioner cannot, and need not, partition
-    it); Megatron TP of the per-stage weights stays under GSPMD on
-    ``model``. Per-row tensors (masks, positions, rope tables) must be
-    passed through ``row_specs``-annotated args, NOT captured by
-    closure: closures replicate over manual axes, which would mismatch
-    the slot-sharded activations.
+    shard-local); Megatron TP of the per-stage weights stays under
+    GSPMD on ``model``.
     """
     num_stages = mesh.shape[PIPE_AXIS]
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
@@ -131,23 +142,76 @@ def make_pipelined_serve(
 
     def inner(stage_layers, caches, h, row_args):
         stage = lax.axis_index(PIPE_AXIS)
+        R = h.shape[0]  # local slots (data axis is manual)
+        M = num_microbatches or num_stages
+        if R % M:
+            M = 1
+        G = R // M
+        S = num_stages
+        h_mb = h.reshape(M, G, *h.shape[1:])
+        row_mb = jax.tree.map(
+            lambda a: a.reshape(M, G, *a.shape[1:]), row_args
+        )
+        out_struct = jax.eval_shape(
+            lambda: stage_fn(
+                stage_layers,
+                jax.tree.map(
+                    lambda c: lax.dynamic_slice_in_dim(c, 0, G, axis=1),
+                    caches,
+                ),
+                h_mb[0],
+                jax.tree.map(lambda a: a[0], row_mb),
+            )[0]
+        )
 
         def tick(carry, t):
-            b, cs = carry
-            out, cs_new = stage_fn(stage_layers, cs, b, row_args)
-            keep = stage == t
-            cs = jax.tree.map(
-                lambda new, old: jnp.where(keep, new, old), cs_new, cs
+            outputs, cur_in, cs = carry
+            m = jnp.clip(t - stage, 0, M - 1)  # this stage's group now
+            valid = (t >= stage) & (t - stage < M)
+            inp0 = lax.dynamic_index_in_dim(h_mb, m, 0, keepdims=False)
+            inp = jnp.where(stage == 0, inp0, cur_in)
+            row_t = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+                row_mb,
             )
-            b = lax.ppermute(out, PIPE_AXIS, perm)
-            return (b, cs), None
+            slot0 = m * G
+            cs_g = jax.tree.map(
+                lambda c: lax.dynamic_slice_in_dim(c, slot0, G, axis=1), cs
+            )
+            out, cs_g_new = stage_fn(stage_layers, cs_g, inp, row_t)
+            cs = jax.tree.map(
+                lambda c, new, old: lax.dynamic_update_slice_in_dim(
+                    c, jnp.where(valid, new, old), slot0, axis=1
+                ),
+                cs,
+                cs_g_new,
+                cs_g,
+            )
+            # final stage banks its finished group
+            bank = jnp.clip(t - (S - 1), 0, M - 1)
+            is_done = valid & (stage == S - 1)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(
+                    is_done,
+                    out,
+                    lax.dynamic_index_in_dim(outputs, bank, 0, keepdims=False),
+                ),
+                bank,
+                axis=0,
+            )
+            nxt = lax.ppermute(out, PIPE_AXIS, perm)
+            return (outputs, nxt, cs), None
 
-        (b, caches_out), _ = lax.scan(
-            tick, (h, caches), jnp.arange(num_stages)
+        outputs0 = jnp.zeros((M,) + out_struct.shape, out_struct.dtype)
+        (outputs, _, caches_out), _ = lax.scan(
+            tick,
+            (outputs0, jnp.zeros_like(h_mb[0]), caches),
+            jnp.arange(M + S - 1),
         )
-        # Last stage's valid output was ppermuted onto stage 0.
+        full = outputs.reshape((R,) + out_struct.shape[1:])
         out = lax.psum(
-            jnp.where(stage == 0, b, jnp.zeros_like(b)), PIPE_AXIS
+            jnp.where(stage == S - 1, full, jnp.zeros_like(full)), PIPE_AXIS
         )
         return out, caches_out
 

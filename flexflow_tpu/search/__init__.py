@@ -22,6 +22,7 @@ re-design keeps the same three pillars but changes their meaning:
 from .machine_model import TPUChip, TPUTopology, CollectiveModel
 from .strategy import OpShardingChoice, ParallelStrategy
 from .simulator import CostModel, estimate_graph_cost
+from .event_sim import event_sim_cost
 from .substitutions import SUBSTITUTIONS, apply_substitutions, Substitution
 from .placement import placement_dp
 from .planner import PlanReport, plan_decoder_mesh
@@ -37,6 +38,7 @@ __all__ = [
     "ParallelStrategy",
     "CostModel",
     "estimate_graph_cost",
+    "event_sim_cost",
     "SUBSTITUTIONS",
     "Substitution",
     "apply_substitutions",

@@ -113,13 +113,14 @@ def placement_dp(
         choices[nid] = max(v, key=v.get)
 
     strategy = ParallelStrategy(machine=machine, choices=choices)
-    # Re-price the VOTED choices with the one shared estimator (pure
-    # time), whatever λ the DP optimised: the DP objective is optimistic
-    # at fan-outs and λ>0 mixes memory in — either would make costs
-    # incomparable across machine/λ candidates in unity.optimize.
-    from .simulator import estimate_graph_cost
+    # Re-price the VOTED choices with the one shared estimator (the
+    # overlap-aware event simulation), whatever λ the DP optimised: the
+    # DP objective is additive and optimistic at fan-outs, and λ>0
+    # mixes memory in — either would make costs incomparable across
+    # machine/λ candidates in unity.optimize.
+    from .event_sim import event_sim_cost
 
-    strategy.estimated_step_time = estimate_graph_cost(
+    strategy.estimated_step_time = event_sim_cost(
         graph, strategy, cost_model
     )
     return strategy

@@ -93,6 +93,27 @@ _SAMPLE_OK = {
 }
 
 
+def _tp_state_valid(node: OpNode, state: str, model: int) -> bool:
+    """A TP state may only be offered when the op's sharded dims divide
+    the model degree — otherwise the searched strategy crashes at param
+    init (same gate the explicit-TP pass applies, parallel/tp.py)."""
+    attrs = node.attrs_dict
+    if state == "TP_MEGATRON":
+        kv = attrs.get("num_kv_heads") or attrs["num_heads"]
+        return kv % model == 0 and attrs["intermediate_size"] % model == 0
+    if node.op_type == "dense":
+        # TP_COL shards out_dim; TP_ROW shards in_dim (not visible from
+        # the node alone — out_dim divisibility is the usable proxy;
+        # GSPMD tolerates a ragged in_dim split, unlike a ragged named
+        # sharding of the weight's out axis)
+        return attrs["out_dim"] % model == 0
+    if node.op_type == "multihead_attention":
+        return attrs["num_heads"] % model == 0
+    if node.op_type == "embedding":
+        return attrs["out_dim"] % model == 0
+    return True
+
+
 def candidate_states(
     node: OpNode,
     machine: MachineSpec,
@@ -105,7 +126,11 @@ def candidate_states(
     states = _ANY
     if machine.model > 1:
         if node.op_type in _TP_STATES:
-            states = states + _TP_STATES[node.op_type]
+            states = states + tuple(
+                s
+                for s in _TP_STATES[node.op_type]
+                if _tp_state_valid(node, s, machine.model)
+            )
         if node.op_type in _SAMPLE_OK:
             if enable_sample:
                 states = states + ("SAMPLE",)

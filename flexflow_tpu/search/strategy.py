@@ -30,8 +30,10 @@ Strategies serialize to JSON — the analog of ``--export-strategy`` /
 """
 from __future__ import annotations
 
+import base64
 import dataclasses
 import json
+import pickle
 from typing import Dict, List, Optional
 
 from jax.sharding import PartitionSpec as P
@@ -45,6 +47,32 @@ from ..core.mesh import DATA_AXIS, MODEL_AXIS, MachineSpec
 # the batch over BOTH mesh axes (weights replicated), ATTR splits a
 # non-batch activation dim (spatial/sequence) over the model axis.
 STATES = ("REP", "DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR")
+
+
+class _GraphUnpickler(pickle.Unpickler):
+    """Unpickler restricted to the types a serialized :class:`Graph` can
+    legitimately contain — a strategy file is an interchange format
+    (``--import-strategy``), so a crafted ``graph_pkl`` must not be able
+    to execute arbitrary code via pickle's default class resolution."""
+
+    _SAFE_PREFIXES = ("flexflow_tpu.", "numpy", "jax.numpy")
+    _SAFE_BUILTINS = {"set", "frozenset", "slice", "complex", "bytearray"}
+
+    def find_class(self, module, name):
+        if module.split(".")[0] == "builtins":
+            if name in self._SAFE_BUILTINS:
+                return super().find_class(module, name)
+        elif module.startswith(self._SAFE_PREFIXES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"strategy graph_pkl references forbidden type {module}.{name}"
+        )
+
+
+def _restricted_graph_loads(data: bytes):
+    import io
+
+    return _GraphUnpickler(io.BytesIO(data)).load()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +89,12 @@ class ParallelStrategy:
     machine: MachineSpec
     choices: Dict[int, str]  # node_id -> state
     estimated_step_time: float = 0.0
+    # The (possibly substitution-rewritten) graph the choices refer to.
+    # Persisted with the strategy so an exported strategy from a search
+    # that REWROTE the graph re-applies against the right node ids on
+    # import — the reference ships the optimized graph + views together
+    # the same way (GraphOptimalViewSerialized, graph.cc:2225, graph.h:92).
+    graph: Optional[Graph] = None
 
     # ------------------------------------------------------------------
     # lowering to GSPMD annotations
@@ -171,25 +205,41 @@ class ParallelStrategy:
     # (de)serialization — reference --export-strategy/--import-strategy
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "machine": dataclasses.asdict(self.machine),
-                "choices": {str(k): v for k, v in self.choices.items()},
-                "estimated_step_time": self.estimated_step_time,
-            },
-            indent=2,
-        )
+        d = {
+            "machine": dataclasses.asdict(self.machine),
+            "choices": {str(k): v for k, v in self.choices.items()},
+            "estimated_step_time": self.estimated_step_time,
+        }
+        if self.graph is not None:
+            # The graph holds arbitrary attr values (initializer
+            # objects, dtypes) — a pickled blob inside the JSON is the
+            # moral equivalent of the reference's binary
+            # GraphOptimalViewSerialized payload.
+            d["graph_pkl"] = base64.b64encode(
+                pickle.dumps(self.graph)
+            ).decode("ascii")
+        return json.dumps(d, indent=2)
 
     @classmethod
     def from_json(cls, text: str) -> "ParallelStrategy":
         d = json.loads(text)
+        graph = None
+        if "graph_pkl" in d:
+            graph = _restricted_graph_loads(base64.b64decode(d["graph_pkl"]))
+            if not isinstance(graph, Graph):
+                raise ValueError(
+                    "strategy file graph_pkl did not decode to a Graph"
+                )
         return cls(
             machine=MachineSpec(**d["machine"]),
             choices={int(k): v for k, v in d["choices"].items()},
             estimated_step_time=d.get("estimated_step_time", 0.0),
+            graph=graph,
         )
 
-    def save(self, path: str):
+    def save(self, path: str, graph: Optional[Graph] = None):
+        if graph is not None:
+            self.graph = graph
         with open(path, "w") as f:
             f.write(self.to_json())
 

@@ -310,6 +310,29 @@ def make_json_rule(spec: Dict) -> Substitution:
         from ..ops.registry import get_op
 
         get_op(action["op"])  # unknown target op fails at load, not apply
+        # attr references must parse and stay in pattern bounds at LOAD
+        # time — a typo'd '$5.k' or '$x.k' must not abort an
+        # auto_parallel compile mid-search
+        for key, val in (action.get("attrs") or {}).items():
+            if isinstance(val, str) and val.startswith("$"):
+                i, _, name = val[1:].partition(".")
+                if not i.isdigit() or int(i) >= len(pattern) or not name:
+                    raise ValueError(
+                        f"rule {spec.get('name')!r}: malformed attr "
+                        f"reference {val!r} for {key!r} (expected "
+                        f"'$<pattern-index>.<attr>' with index < "
+                        f"{len(pattern)})"
+                    )
+    # $eq cross-references in pattern attrs get the same load-time check
+    for i, pspec in enumerate(pattern):
+        for key, cond in (pspec.get("attrs") or {}).items():
+            if isinstance(cond, dict) and "$eq" in cond:
+                j, _, other = cond["$eq"].partition(".")
+                if not j.isdigit() or int(j) >= len(pattern) or not other:
+                    raise ValueError(
+                        f"rule {spec.get('name')!r}: malformed $eq "
+                        f"reference {cond['$eq']!r} in pattern[{i}].{key}"
+                    )
 
     def apply_fn(graph: Graph) -> Optional[Graph]:
         for node in graph.nodes:
@@ -341,7 +364,13 @@ def make_json_rule(spec: Dict) -> Substitution:
                     redirect={TensorRef(chain[-1].id, 0): head_input},
                 )
             else:  # "replace" (kinds validated at load time)
-                attrs = _resolve_attrs(action.get("attrs", {}), chain)
+                try:
+                    attrs = _resolve_attrs(action.get("attrs", {}), chain)
+                except ValueError:
+                    # a well-formed reference can still name an attr the
+                    # matched op doesn't carry — skip the match rather
+                    # than abort the whole search
+                    continue
                 # same legality guard as drop: the replacement op must
                 # reproduce the matched chain's output spec, or downstream
                 # consumers would silently re-infer from a different shape

@@ -23,8 +23,9 @@ from typing import Iterable, List, Optional, Tuple
 from ..core.graph import Graph
 from ..core.mesh import MachineSpec
 from .machine_model import TPUChip, TPUTopology
+from .event_sim import event_sim_cost
 from .placement import placement_dp
-from .simulator import CostModel, candidate_states, estimate_graph_cost
+from .simulator import CostModel, candidate_states
 from .strategy import ParallelStrategy
 from .substitutions import SUBSTITUTIONS, apply_substitutions
 
@@ -34,17 +35,19 @@ def _divisors(n: int) -> List[int]:
 
 
 def mesh_candidates(
-    num_devices: int, max_model: int = 8, *, expert: bool = False
+    num_devices: int, max_model: Optional[int] = None, *, expert: bool = False
 ) -> List[MachineSpec]:
     """Factor the device count over (data, model[, expert]) axis degrees
     — the search's machine-grid enumeration (all factorizations, not
-    just powers of two). Expert degrees join the grid when the graph
-    contains MoE ops; pipeline/seq degrees are planned by
+    just powers of two; a device count's divisor set is small, so the
+    grid stays cheap even at pod scale). Expert degrees join the grid
+    when the graph contains MoE ops; pipeline/seq degrees are planned by
     :mod:`.planner` for stacked-layer models (the reference likewise
-    fixes inference PP outside its search)."""
+    fixes inference PP outside its search). ``max_model`` optionally
+    bounds the TP degree (e.g. to one ICI torus axis)."""
     out = []
     for model in _divisors(num_devices):
-        if model > max_model and model != num_devices:
+        if max_model is not None and model > max_model:
             continue
         rest = num_devices // model
         if expert:
@@ -217,7 +220,7 @@ def mcmc_optimize(
     else:
         choices = {n.id: "DP" for n in graph.nodes}
     strat = ParallelStrategy(machine=machine, choices=choices)
-    cur = estimate_graph_cost(graph, strat, cost_model)
+    cur = event_sim_cost(graph, strat, cost_model)
     best_choices, best_cost = dict(choices), cur
     for _ in range(iters):
         node = rng.choice(nodes)
@@ -232,7 +235,7 @@ def mcmc_optimize(
         if new_state == old_state:
             continue
         choices[node.id] = new_state
-        cand = estimate_graph_cost(
+        cand = event_sim_cost(
             graph, ParallelStrategy(machine=machine, choices=choices), cost_model
         )
         delta = cand - cur

@@ -117,6 +117,7 @@ class LLM:
         seed: int = 0,
         quantization: Optional[str] = None,  # "int8" | "int4"
         offload: bool = False,
+        output_file: Optional[str] = None,
     ) -> None:
         """Build the inference engine(s) and request manager (reference
         ``LLM.compile`` → InferenceManager.compile_model_and_allocate_buffer).
@@ -161,6 +162,7 @@ class LLM:
             self.rm = SpecInferManager(
                 self.engine, [s.engine for s in ssms], spec,
                 tokenizer=self.tokenizer, eos_token_id=eos_token_id, seed=seed,
+                output_file=output_file,
             )
         else:
             self.rm = RequestManager(
@@ -168,6 +170,7 @@ class LLM:
                 tokenizer=self.tokenizer,
                 eos_token_id=eos_token_id,
                 seed=seed,
+                output_file=output_file,
             )
 
     def _place_params(

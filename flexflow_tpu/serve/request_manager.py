@@ -69,10 +69,15 @@ class RequestManager:
         tokenizer: Any = None,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        output_file: Optional[str] = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.eos_token_id = eos_token_id
+        # Per-request telemetry sink (reference -output-file,
+        # request_manager.cc:417-440: e2e latency, decoding steps and
+        # token ids appended per finished request).
+        self.output_file = output_file
         if eos_token_id is None and tokenizer is not None:
             self.eos_token_id = getattr(tokenizer, "eos_token_id", None)
         self.requests: Dict[int, Request] = {}
@@ -153,6 +158,32 @@ class RequestManager:
         if req.slot >= 0:
             self.slots[req.slot] = None
             req.slot = -1
+        if self.output_file:
+            self._write_output_record(req)
+
+    def _write_output_record(self, req: Request):
+        """Append one finished request's telemetry — the format mirrors
+        the reference's output-file writer (request_manager.cc:417-440:
+        ``[Profile] guid(%d) llm_decoding_steps(%d) start(%.1lf)
+        finish(%.1lf) latency(%.1lf)`` then the token ids)."""
+        p = req.profile
+        latency_us = (p.finish_time - p.start_time) * 1e6
+        text = (
+            self.tokenizer.decode(req.output_tokens)
+            if self.tokenizer is not None
+            else ""
+        )
+        with open(self.output_file, "a") as f:
+            f.write(
+                f"[Profile] guid({req.request_id}) "
+                f"llm_decoding_steps({p.llm_decoding_steps}) "
+                f"latency({latency_us:.1f})\n"
+            )
+            f.write(
+                f"guid({req.request_id}) tokens("
+                + " ".join(str(t) for t in req.tokens)
+                + f") output({text})\n"
+            )
 
     # ------------------------------------------------------------------
     # batch building (reference prepare_next_batch, request_manager.cc:350)

@@ -156,8 +156,9 @@ class SpecInferManager(RequestManager):
         tokenizer: Any = None,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        output_file: Optional[str] = None,
     ):
-        super().__init__(llm_engine, tokenizer, eos_token_id, seed)
+        super().__init__(llm_engine, tokenizer, eos_token_id, seed, output_file)
         if isinstance(ssm_engines, InferenceEngine):
             ssm_engines = [ssm_engines]
         self.ssms: List[InferenceEngine] = list(ssm_engines)

@@ -19,3 +19,35 @@ import jax  # noqa: E402
 # (backends are not initialised yet at conftest import time).
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+
+def pytest_addoption(parser):
+    # Compile cost dominates the suite on the 1-core CPU box; a full run
+    # exceeds a 10-minute window. `--shard i/n` deterministically
+    # partitions tests so N parallel/short invocations cover everything:
+    #   pytest tests/ -q --shard 1/2   &&   pytest tests/ -q --shard 2/2
+    parser.addoption(
+        "--shard", default=None,
+        help="deterministic test sharding as i/n (1-based)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-training/example tests; deselect with -m 'not slow' "
+        "for a fast iteration loop",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    shard = config.getoption("--shard")
+    if not shard:
+        return
+    i, n = (int(x) for x in shard.split("/"))
+    order = sorted(items, key=lambda it: it.nodeid)
+    keep = {id(it) for idx, it in enumerate(order) if idx % n == i - 1}
+    deselected = [it for it in items if id(it) not in keep]
+    items[:] = [it for it in items if id(it) in keep]
+    if deselected:
+        config.hook.pytest_deselected(items=deselected)

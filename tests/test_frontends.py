@@ -188,3 +188,45 @@ def test_onnx_importer_mlp():
     ref = np.exp(ref - ref.max(-1, keepdims=True))
     ref = ref / ref.sum(-1, keepdims=True)
     np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_torch_fx_hf_bert_alignment():
+    """HF-traced BERT encoder imports end-to-end and matches torch
+    numerically (VERDICT r3 #7; reference
+    python/flexflow/torch/model.py:2408-2444 + tests/align)."""
+    from transformers import BertConfig, BertModel
+
+    from flexflow_tpu.frontends import PyTorchModel
+
+    torch.manual_seed(0)
+    hf_cfg = BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    net = BertModel(hf_cfg, add_pooling_layer=False).eval()
+    pt = PyTorchModel(net, input_names=["input_ids", "attention_mask"])
+
+    B, S = 2, 12
+    cfg = ff.FFConfig(batch_size=B, num_devices=1)
+    m = ff.FFModel(cfg)
+    ids_t = m.create_tensor((B, S), dtype="int32", name="input_ids")
+    mask_t = m.create_tensor((B, S), name="attention_mask")
+    (out,) = pt.to_ff(m, [ids_t, mask_t])
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.01), output=out,
+              loss_type="mean_squared_error", metrics=())
+    pt.load_weights(m)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 96, size=(B, S)).astype(np.int32)
+    mask = np.ones((B, S), np.float32)
+    mask[1, 8:] = 0.0  # one padded row exercises the mask path
+    got = np.asarray(m.forward({"input_ids": ids, "attention_mask": mask}))
+    with torch.no_grad():
+        ref = net(
+            input_ids=torch.from_numpy(ids.astype(np.int64)),
+            attention_mask=torch.from_numpy(mask),
+        ).last_hidden_state.numpy()
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)

@@ -167,3 +167,22 @@ class TestSampling:
         rm = RequestManager(eng, eos_token_id=first)
         out = rm.generate([[4, 9]], max_new_tokens=10)[0]
         assert out.output_tokens == [first]
+
+
+def test_output_file_telemetry(tiny, tmp_path):
+    """-output-file sink: per finished request, latency + decoding steps
+    + token ids are appended (reference request_manager.cc:417-440)."""
+    path = str(tmp_path / "out.txt")
+    rm = RequestManager(make_engine(tiny), output_file=path)
+    prompts = [[1, 2, 3, 4], [5, 6, 7]]
+    outs = rm.generate(prompts, max_new_tokens=5)
+    text = open(path).read()
+    lines = [l for l in text.splitlines() if l.startswith("[Profile]")]
+    assert len(lines) == 2
+    for o, line in zip(outs, lines):
+        assert f"guid({o.request_id})" in line
+        assert f"llm_decoding_steps({o.profile.llm_decoding_steps})" in line
+        assert "latency(" in line
+        # the token line carries prompt + output ids
+        full = " ".join(str(t) for t in o.input_tokens + o.output_tokens)
+        assert full in text
