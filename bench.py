@@ -109,7 +109,7 @@ def run_phase(name, budget_s, fn, *args, **kw):
 # backend guard
 
 
-def _ensure_backend(probe_timeout=240, retries=2):
+def _ensure_backend(probe_timeout=180, retries=2):
     """Initialize the TPU backend in a subprocess first: jax.devices()
     has been observed to raise UNAVAILABLE (rounds 1/3) or hang outright
     when the tunnelled backend is down. Probing out-of-process lets us

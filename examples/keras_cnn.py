@@ -1,0 +1,50 @@
+"""Keras-frontend CNN training app (reference
+``examples/python/keras/seq_cifar10_cnn.py`` /
+``func_cifar10_cnn_*.py``: the same Conv-Pool-Dense stack through the
+Keras Sequential API). Synthetic CIFAR-shaped blobs keep the CPU-mesh
+smoke fast.
+
+Run: python examples/keras_cnn.py [--devices N]
+"""
+import argparse
+
+import numpy as np
+
+
+def main(num_devices=1, epochs=2, batch_size=32, image_size=12,
+         n_samples=256, num_classes=4):
+    import flexflow_tpu as ff
+    from flexflow_tpu.keras import layers, models, optimizers
+
+    cfg = ff.FFConfig(batch_size=batch_size, num_devices=num_devices)
+    model = models.Sequential([
+        layers.Input(shape=(3, image_size, image_size)),
+        layers.Conv2D(8, (3, 3), padding="same", activation="relu"),
+        layers.MaxPooling2D((2, 2)),
+        layers.Conv2D(16, (3, 3), padding="same", activation="relu"),
+        layers.Flatten(),
+        layers.Dense(32, activation="relu"),
+        layers.Dense(num_classes),
+        layers.Activation("softmax"),
+    ], config=cfg)
+    model.compile(
+        optimizer=optimizers.SGD(learning_rate=0.02, momentum=0.9),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, num_classes, size=n_samples).astype(np.int32)
+    x = rng.normal(size=(n_samples, 3, image_size, image_size)).astype(
+        np.float32
+    )
+    x += y[:, None, None, None].astype(np.float32) / 3
+    hist = model.fit(x, y, epochs=epochs, batch_size=batch_size)
+    return {k: v[-1] for k, v in hist.history.items()}
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=2)
+    a = p.parse_args()
+    print(main(num_devices=a.devices, epochs=a.epochs))

@@ -10,7 +10,9 @@ immediately trainable/servable on the mesh.
 """
 def load_imported_weights(ffmodel) -> None:
     """Overwrite compiled params with frontend-converted weights stored
-    on ``ffmodel._imported_params`` (shared by all importers)."""
+    on ``ffmodel._imported_params``, and non-trainable state (batch-norm
+    running stats) from ``ffmodel._imported_state`` (shared by all
+    importers)."""
     import jax.numpy as jnp
 
     assert ffmodel.params is not None, "compile() the model first"
@@ -20,6 +22,16 @@ def load_imported_weights(ffmodel) -> None:
                 k: jnp.asarray(v, ffmodel.params[name][k].dtype)
                 for k, v in w.items()
             }
+    imported_state = getattr(ffmodel, "_imported_state", {})
+    if imported_state:
+        by_name = {n.name: n.id for n in ffmodel.graph.nodes}
+        for name, st in imported_state.items():
+            nid = by_name.get(name)
+            if nid is not None and nid in ffmodel.model_state:
+                ffmodel.model_state[nid] = {
+                    k: jnp.asarray(v, ffmodel.model_state[nid][k].dtype)
+                    for k, v in st.items()
+                }
 
 
 from .torch_fx import PyTorchModel

@@ -71,6 +71,7 @@ class ONNXModel:
         for gi, t in zip(graph_inputs, input_tensors):
             env[gi.name] = t
         self._weights: Dict[str, Dict[str, np.ndarray]] = {}
+        self._state: Dict[str, Dict[str, np.ndarray]] = {}
 
         for node in self.model.graph.node:
             handler = getattr(self, f"_op_{node.op_type.lower()}", None)
@@ -84,6 +85,8 @@ class ONNXModel:
 
         ffmodel._imported_params = getattr(ffmodel, "_imported_params", {})
         ffmodel._imported_params.update(self._weights)
+        ffmodel._imported_state = getattr(ffmodel, "_imported_state", {})
+        ffmodel._imported_state.update(self._state)
         return [env[o.name] for o in self.model.graph.output]
 
     def load_weights(self, ffmodel) -> None:
@@ -225,3 +228,125 @@ class ONNXModel:
 
     def _op_identity(self, ff, node, env):
         return env[node.input[0]]
+
+    # -- widened op set (reference handle* coverage,
+    #    python/flexflow/onnx/model.py handleBatchNormalization etc.) --
+
+    def _op_batchnormalization(self, ff, node, env):
+        name = self._name(node)
+        out = ff.batch_norm(env[node.input[0]], relu=False,
+                            eps=_attr_map(node).get("epsilon", 1e-5),
+                            name=name)
+        self._weights[name] = {
+            "scale": self.initializers[node.input[1]],
+            "bias": self.initializers[node.input[2]],
+        }
+        # trained running stats (inputs 3/4) go to the model's
+        # non-trainable STATE, not the params — without them inference
+        # would silently normalise with mean=0/var=1
+        if len(node.input) > 4:
+            self._state[name] = {
+                "mean": self.initializers[node.input[3]],
+                "var": self.initializers[node.input[4]],
+            }
+        return out
+
+    def _op_layernormalization(self, ff, node, env):
+        name = self._name(node)
+        out = ff.layer_norm(env[node.input[0]],
+                            eps=_attr_map(node).get("epsilon", 1e-5),
+                            name=name)
+        w = {"gamma": self.initializers[node.input[1]]}
+        if len(node.input) > 2:
+            w["beta"] = self.initializers[node.input[2]]
+        self._weights[name] = w
+        return out
+
+    def _op_globalaveragepool(self, ff, node, env):
+        return ff.mean(env[node.input[0]], axes=(2, 3), keepdims=True,
+                       name=self._name(node))
+
+    def _op_gather(self, ff, node, env):
+        # embedding lookup: data is an initializer table, indices a tensor
+        if node.input[0] in self.initializers:
+            table = self.initializers[node.input[0]]
+            name = self._name(node)
+            out = ff.embedding(env[node.input[1]], table.shape[0],
+                               table.shape[1], name=name)
+            self._weights[name] = {"table": table}
+            return out
+        # general tensor Gather: ONNX semantics are np.take along axis
+        # (default 0); the framework's gather op is take_along_axis, so
+        # only same-rank index tensors translate — refuse anything else
+        # rather than silently compute the wrong gather
+        data, idx = env[node.input[0]], env[node.input[1]]
+        if len(idx.shape) != len(data.shape):
+            raise NotImplementedError(
+                "ONNX Gather with indices rank != data rank (np.take "
+                "semantics) is only supported for initializer tables"
+            )
+        return ff.gather(data, idx,
+                         axis=_attr_map(node).get("axis", 0),
+                         name=self._name(node))
+
+    def _op_split(self, ff, node, env):
+        x = env[node.input[0]]
+        attrs = _attr_map(node)
+        axis = attrs.get("axis", 0)
+        sizes = attrs.get("split")
+        if sizes is None and len(node.input) > 1:
+            sizes = self.initializers[node.input[1]].astype(int).tolist()
+        if sizes is None:
+            n = len(node.output)
+            sizes = [x.shape[axis] // n] * n
+        return ff.split(x, list(sizes), axis=axis, name=self._name(node))
+
+    # onnx.TensorProto dtype enum → numpy name (the onnx package is
+    # optional; proto-shaped stand-ins must import too)
+    _CAST_DTYPES = {
+        1: "float32", 6: "int32", 7: "int64", 9: "bool",
+        10: "float16", 11: "float64", 16: "bfloat16",
+    }
+
+    def _op_cast(self, ff, node, env):
+        to = int(_attr_map(node)["to"])
+        return ff.cast(env[node.input[0]], self._CAST_DTYPES[to],
+                       name=self._name(node))
+
+    def _op_reducemean(self, ff, node, env):
+        attrs = _attr_map(node)
+        axes = attrs.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = self.initializers[node.input[1]].astype(int).tolist()
+        if axes is None:  # ONNX default: reduce over ALL dims
+            axes = tuple(range(len(env[node.input[0]].shape)))
+        return ff.mean(env[node.input[0]], axes=tuple(axes),
+                       keepdims=bool(attrs.get("keepdims", 1)),
+                       name=self._name(node))
+
+    def _op_gelu(self, ff, node, env):
+        return ff.gelu(env[node.input[0]], name=self._name(node))
+
+    def _op_unsqueeze(self, ff, node, env):
+        x = env[node.input[0]]
+        attrs = _attr_map(node)
+        axes = attrs.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = self.initializers[node.input[1]].astype(int).tolist()
+        shape = list(x.shape)
+        for a in sorted(int(a) % (len(shape) + 1) for a in axes):
+            shape.insert(a, 1)
+        return ff.reshape(x, tuple(shape), name=self._name(node))
+
+    def _op_squeeze(self, ff, node, env):
+        x = env[node.input[0]]
+        attrs = _attr_map(node)
+        axes = attrs.get("axes")
+        if axes is None and len(node.input) > 1:
+            axes = self.initializers[node.input[1]].astype(int).tolist()
+        if axes is None:
+            shape = [d for d in x.shape if d != 1]
+        else:
+            drop = {int(a) % len(x.shape) for a in axes}
+            shape = [d for i, d in enumerate(x.shape) if i not in drop]
+        return ff.reshape(x, tuple(shape), name=self._name(node))

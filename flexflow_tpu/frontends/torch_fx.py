@@ -263,15 +263,12 @@ class PyTorchModel:
                 return folded
 
         if t in (operator.add, torch.add, "add"):
-            if self._is_ff(args[0]) and self._is_ff(args[1]):
-                return ff.add(args[0], args[1], name=name)
-            if not self._is_ff(args[1]) and np.ndim(args[1]) > 0:
-                return ff.add(
-                    args[0], self._ensure_ff(ff, args[1], name), name=name
-                )
-            if not self._is_ff(args[0]):  # scalar + tensor
-                return ff.scalar_add(args[1], float(args[0]), name=name)
-            return ff.scalar_add(args[0], float(args[1]), name=name)
+            a, b = args[0], args[1]
+            if not self._is_ff(a):
+                a, b = b, a  # commutative: tensor first
+            if self._is_ff(b) or np.ndim(b) > 0:
+                return ff.add(a, self._ensure_ff(ff, b, name), name=name)
+            return ff.scalar_add(a, float(b), name=name)
         if t in (operator.mul, torch.mul, "mul"):
             a, b = args[0], args[1]
             if not self._is_ff(a):

@@ -285,8 +285,11 @@ class FFModel:
             name,
         )
 
-    def batch_norm(self, input: Tensor, relu: bool = True, name: str = "") -> Tensor:
-        return self._add("batch_norm", dict(relu=relu), [input], name)
+    def batch_norm(
+        self, input: Tensor, relu: bool = True, eps: float = 1e-5,
+        name: str = "",
+    ) -> Tensor:
+        return self._add("batch_norm", dict(relu=relu, eps=eps), [input], name)
 
     def layer_norm(
         self,

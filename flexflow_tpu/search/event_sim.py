@@ -24,7 +24,10 @@ fwd and bwd separately), and releases each op's DP gradient all-reduce
 onto the comm channel the moment its backward completes — exactly the
 bucketed overlap XLA/GSPMD produces, leaving only the tail exposed.
 Resharding collectives occupy the comm channel between producer finish
-and consumer start on both sweeps.
+and consumer start on the forward sweep only — matching the additive
+estimator's once-per-edge pricing so the two stay byte-comparable (the
+backward's mirrored collectives are deliberately not double-priced by
+either model).
 """
 from __future__ import annotations
 

@@ -50,20 +50,42 @@ STATES = ("REP", "DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR")
 
 
 class _GraphUnpickler(pickle.Unpickler):
-    """Unpickler restricted to the types a serialized :class:`Graph` can
-    legitimately contain — a strategy file is an interchange format
-    (``--import-strategy``), so a crafted ``graph_pkl`` must not be able
-    to execute arbitrary code via pickle's default class resolution."""
+    """Unpickler restricted to the EXACT types a serialized
+    :class:`Graph` can legitimately contain — a strategy file is an
+    interchange format (``--import-strategy``), so a crafted
+    ``graph_pkl`` must not be able to execute arbitrary code via
+    pickle's class resolution. Prefix allowlists are not enough (any
+    admitted *callable* is invocable through pickle REDUCE), so only a
+    closed set of data classes resolves, plus Initializer subclasses
+    (constructing one is inert)."""
 
-    _SAFE_PREFIXES = ("flexflow_tpu.", "numpy", "jax.numpy")
-    _SAFE_BUILTINS = {"set", "frozenset", "slice", "complex", "bytearray"}
+    _SAFE = {
+        ("flexflow_tpu.core.graph", "Graph"),
+        ("flexflow_tpu.core.graph", "OpNode"),
+        ("flexflow_tpu.core.graph", "TensorRef"),
+        ("flexflow_tpu.core.tensor", "TensorSpec"),
+        ("flexflow_tpu.core.tensor", "DimSharding"),
+        ("flexflow_tpu.core.dtypes", "DataType"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+        ("builtins", "set"),
+        ("builtins", "frozenset"),
+        ("builtins", "slice"),
+        ("builtins", "complex"),
+        ("builtins", "bytearray"),
+    }
 
     def find_class(self, module, name):
-        if module.split(".")[0] == "builtins":
-            if name in self._SAFE_BUILTINS:
-                return super().find_class(module, name)
-        elif module.startswith(self._SAFE_PREFIXES):
+        if (module, name) in self._SAFE:
             return super().find_class(module, name)
+        if module == "flexflow_tpu.initializers":
+            from .. import initializers as ffinit
+
+            obj = getattr(ffinit, name, None)
+            if isinstance(obj, type) and issubclass(obj, ffinit.Initializer):
+                return obj
         raise pickle.UnpicklingError(
             f"strategy graph_pkl references forbidden type {module}.{name}"
         )

@@ -122,3 +122,10 @@ def test_bert_proxy_example():
     # MLM-style task is learnable; require clearly-above-chance
     final = bert_proxy.main(num_devices=1, epochs=6, n_samples=128)
     assert final["accuracy"] > 0.05  # epoch-average; chance ~0.016
+
+
+def test_keras_cnn_example():
+    import keras_cnn
+
+    final = keras_cnn.main(num_devices=8, epochs=3, n_samples=128)
+    assert final["accuracy"] > 0.3  # 4-class blobs, clearly above chance

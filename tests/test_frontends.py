@@ -230,3 +230,120 @@ def test_torch_fx_hf_bert_alignment():
             attention_mask=torch.from_numpy(mask),
         ).last_hidden_state.numpy()
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_onnx_importer_widened_ops():
+    """Widened ONNX set: BatchNormalization, GlobalAveragePool, Gather
+    (embedding), Split, ReduceMean, Unsqueeze, Cast, Gelu (reference
+    python/flexflow/onnx/model.py handle* coverage)."""
+    from flexflow_tpu.frontends import ONNXModel
+
+    rng = np.random.default_rng(5)
+    table = rng.normal(size=(16, 8)).astype(np.float32)
+    scale = rng.normal(size=(4,)).astype(np.float32)
+    bias = rng.normal(size=(4,)).astype(np.float32)
+    model = _NS(graph=_NS(
+        node=[
+            # image branch: BN (inference stats) -> GAP -> flatten dims
+            _NS(op_type="BatchNormalization", name="bn",
+                input=["img", "scale", "bias"], output=["n"],
+                attribute=[_onnx_attr("epsilon", 1e-5)]),
+            _NS(op_type="GlobalAveragePool", name="gap", input=["n"],
+                output=["g"], attribute=[]),
+            _NS(op_type="Squeeze", name="sq", input=["g"], output=["gs"],
+                attribute=[_onnx_attr("axes", [2, 3])]),
+            # id branch: embedding lookup + mean over the bag dim
+            _NS(op_type="Gather", name="emb", input=["table", "ids"],
+                output=["e"], attribute=[]),
+            _NS(op_type="ReduceMean", name="rm", input=["e"], output=["ep"],
+                attribute=[_onnx_attr("axes", [1]),
+                           _onnx_attr("keepdims", 0)]),
+            _NS(op_type="Gelu", name="gel", input=["ep"], output=["eg"],
+                attribute=[]),
+            # merge, split in two, keep the first half
+            _NS(op_type="Concat", name="cat", input=["gs", "eg"],
+                output=["c"], attribute=[_onnx_attr("axis", -1)]),
+            _NS(op_type="Split", name="sp", input=["c"],
+                output=["s0", "s1"],
+                attribute=[_onnx_attr("axis", 1), _onnx_attr("split", [6, 6])]),
+            _NS(op_type="Unsqueeze", name="un", input=["s0"], output=["u"],
+                attribute=[_onnx_attr("axes", [1])]),
+            _NS(op_type="Cast", name="ca", input=["u"], output=["out"],
+                attribute=[_onnx_attr("to", 1)]),
+        ],
+        initializer=[_onnx_tensor("table", table),
+                     _onnx_tensor("scale", scale),
+                     _onnx_tensor("bias", bias)],
+        input=[_NS(name="img"), _NS(name="ids"), _NS(name="table"),
+               _NS(name="scale"), _NS(name="bias")],
+        output=[_NS(name="out")],
+    ))
+
+    B = 4
+    cfg = ff.FFConfig(batch_size=B, num_devices=1)
+    m = ff.FFModel(cfg)
+    img_t = m.create_tensor((B, 4, 6, 6), name="img")
+    ids_t = m.create_tensor((B, 3), dtype="int32", name="ids")
+    om = ONNXModel(model)
+    (out,) = om.to_ff(m, [img_t, ids_t])
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.01), output=out,
+              loss_type="mean_squared_error", metrics=())
+    om.load_weights(m)
+
+    img = rng.normal(size=(B, 4, 6, 6)).astype(np.float32)
+    ids = rng.integers(0, 16, size=(B, 3)).astype(np.int32)
+    got = np.asarray(m.forward({"img": img, "ids": ids}))
+
+    # numpy reference (BN with inference stats mean=0, var=1)
+    n = img / np.sqrt(1 + 1e-5) * scale[None, :, None, None] \
+        + bias[None, :, None, None]
+    gs = n.mean(axis=(2, 3))                       # (B, 4)
+    e = table[ids]                                 # (B, 3, 8)
+    ep = e.mean(axis=1)
+    import jax.nn
+
+    eg = np.asarray(jax.nn.gelu(jnp.asarray(ep)))
+    c = np.concatenate([gs, eg], axis=-1)          # (B, 12)
+    want = c[:, :6][:, None, :]                    # (B, 1, 6)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_onnx_batchnorm_running_stats_imported():
+    """Trained BN running mean/var (inputs 3/4) must reach the model's
+    state collection — inference with stats != (0,1) has to match the
+    numpy reference."""
+    from flexflow_tpu.frontends import ONNXModel
+
+    rng = np.random.default_rng(7)
+    C = 3
+    scale = rng.normal(size=(C,)).astype(np.float32)
+    bias = rng.normal(size=(C,)).astype(np.float32)
+    mean = rng.normal(size=(C,)).astype(np.float32)
+    var = (rng.uniform(0.5, 2.0, size=(C,))).astype(np.float32)
+    model = _NS(graph=_NS(
+        node=[
+            _NS(op_type="BatchNormalization", name="bn",
+                input=["x", "scale", "bias", "mean", "var"], output=["out"],
+                attribute=[_onnx_attr("epsilon", 1e-5)]),
+        ],
+        initializer=[_onnx_tensor("scale", scale), _onnx_tensor("bias", bias),
+                     _onnx_tensor("mean", mean), _onnx_tensor("var", var)],
+        input=[_NS(name="x"), _NS(name="scale"), _NS(name="bias"),
+               _NS(name="mean"), _NS(name="var")],
+        output=[_NS(name="out")],
+    ))
+    B = 2
+    cfg = ff.FFConfig(batch_size=B, num_devices=1)
+    m = ff.FFModel(cfg)
+    x_t = m.create_tensor((B, C, 4, 4), name="x")
+    om = ONNXModel(model)
+    (out,) = om.to_ff(m, [x_t])
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.0), output=out,
+              loss_type="mean_squared_error", metrics=())
+    om.load_weights(m)
+    x = rng.normal(size=(B, C, 4, 4)).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    want = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-5
+    ) * scale[None, :, None, None] + bias[None, :, None, None]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
