@@ -28,6 +28,7 @@ def build_searched_lm(
     seq: int,
     dtype,
     attention: str = "xla",
+    remat_policy=None,
     config=None,
 ):
     """FFModel: tokens (B, S) → embed → fused decoder stack → logits."""
@@ -49,6 +50,7 @@ def build_searched_lm(
         num_heads=num_heads,
         intermediate_size=intermediate_size,
         attention=attention,
+        remat_policy=remat_policy,
         name="decoder",
     )
     ff.dense(x, vocab_size, use_bias=False, name="lm_head")
@@ -72,17 +74,19 @@ def searched_train_mfu(on_tpu: bool, iters: int = 10) -> Dict[str, Any]:
         V, D, F, L, H = 32000, 2048, 5504, 16, 16
         B, S = 8, 1024
         dt, attention = jnp.bfloat16, "flash"
+        remat_policy = "dots"
     else:
         V, D, F, L, H = 256, 64, 128, 2, 4
         B, S = 2, 32
         dt, attention = jnp.float32, "xla"
+        remat_policy = None
         iters = 2
 
     cfg = FFConfig(batch_size=B, num_devices=1, search_budget=8)
     ff = build_searched_lm(
         vocab_size=V, hidden_size=D, intermediate_size=F, num_layers=L,
         num_heads=H, batch=B, seq=S, dtype=dt, attention=attention,
-        config=cfg,
+        remat_policy=remat_policy, config=cfg,
     )
     ff.compile(
         optimizer=AdamOptimizer(lr=1e-4),

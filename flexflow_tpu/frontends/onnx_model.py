@@ -266,36 +266,53 @@ class ONNXModel:
         return ff.mean(env[node.input[0]], axes=(2, 3), keepdims=True,
                        name=self._name(node))
 
+    def _int_list(self, node, key, input_idx=1):
+        """Opset-13+ int-list decode: attribute form, else an
+        initializer input; None when neither is present. Dynamic
+        (non-initializer) list inputs are refused loudly."""
+        val = _attr_map(node).get(key)
+        if val is not None:
+            return [int(v) for v in val]
+        if len(node.input) > input_idx:
+            iname = node.input[input_idx]
+            if iname not in self.initializers:
+                raise NotImplementedError(
+                    f"{node.op_type}: dynamic (non-initializer) "
+                    f"{key!r} input {iname!r} is not supported"
+                )
+            return self.initializers[iname].astype(int).tolist()
+        return None
+
     def _op_gather(self, ff, node, env):
-        # embedding lookup: data is an initializer table, indices a tensor
-        if node.input[0] in self.initializers:
+        axis = _attr_map(node).get("axis", 0)
+        # embedding lookup: axis-0 row gather from an initializer table
+        if node.input[0] in self.initializers and axis == 0:
             table = self.initializers[node.input[0]]
             name = self._name(node)
             out = ff.embedding(env[node.input[1]], table.shape[0],
                                table.shape[1], name=name)
             self._weights[name] = {"table": table}
             return out
-        # general tensor Gather: ONNX semantics are np.take along axis
-        # (default 0); the framework's gather op is take_along_axis, so
-        # only same-rank index tensors translate — refuse anything else
-        # rather than silently compute the wrong gather
+        # ONNX Gather is np.take (output rank = data.rank-1+idx.rank);
+        # the framework's gather op is take_along_axis — the two only
+        # coincide for rank-1 data with rank-1 indices. Refuse the rest
+        # rather than silently compute the wrong gather.
         data, idx = env[node.input[0]], env[node.input[1]]
-        if len(idx.shape) != len(data.shape):
-            raise NotImplementedError(
-                "ONNX Gather with indices rank != data rank (np.take "
-                "semantics) is only supported for initializer tables"
-            )
-        return ff.gather(data, idx,
-                         axis=_attr_map(node).get("axis", 0),
-                         name=self._name(node))
+        if self._is_ff_rank1(data) and self._is_ff_rank1(idx):
+            return ff.gather(data, idx, axis=0, name=self._name(node))
+        raise NotImplementedError(
+            "general ONNX Gather (np.take semantics) is only supported "
+            "for axis-0 initializer tables (embedding) or rank-1 inputs"
+        )
+
+    @staticmethod
+    def _is_ff_rank1(t) -> bool:
+        return len(t.shape) == 1
 
     def _op_split(self, ff, node, env):
         x = env[node.input[0]]
-        attrs = _attr_map(node)
-        axis = attrs.get("axis", 0)
-        sizes = attrs.get("split")
-        if sizes is None and len(node.input) > 1:
-            sizes = self.initializers[node.input[1]].astype(int).tolist()
+        axis = _attr_map(node).get("axis", 0)
+        sizes = self._int_list(node, "split")
         if sizes is None:
             n = len(node.output)
             sizes = [x.shape[axis] // n] * n
@@ -314,14 +331,11 @@ class ONNXModel:
                        name=self._name(node))
 
     def _op_reducemean(self, ff, node, env):
-        attrs = _attr_map(node)
-        axes = attrs.get("axes")
-        if axes is None and len(node.input) > 1:
-            axes = self.initializers[node.input[1]].astype(int).tolist()
+        axes = self._int_list(node, "axes")
         if axes is None:  # ONNX default: reduce over ALL dims
             axes = tuple(range(len(env[node.input[0]].shape)))
         return ff.mean(env[node.input[0]], axes=tuple(axes),
-                       keepdims=bool(attrs.get("keepdims", 1)),
+                       keepdims=bool(_attr_map(node).get("keepdims", 1)),
                        name=self._name(node))
 
     def _op_gelu(self, ff, node, env):
@@ -329,21 +343,22 @@ class ONNXModel:
 
     def _op_unsqueeze(self, ff, node, env):
         x = env[node.input[0]]
-        attrs = _attr_map(node)
-        axes = attrs.get("axes")
-        if axes is None and len(node.input) > 1:
-            axes = self.initializers[node.input[1]].astype(int).tolist()
-        shape = list(x.shape)
-        for a in sorted(int(a) % (len(shape) + 1) for a in axes):
-            shape.insert(a, 1)
+        axes = self._int_list(node, "axes")
+        # ONNX: axes are relative to the OUTPUT rank (input rank +
+        # number of inserted dims) — e.g. axes=[2,3] on (B,C) must give
+        # (B,C,1,1), not an input-rank-relative insertion
+        out_rank = len(x.shape) + len(axes)
+        where = sorted(int(a) % out_rank for a in axes)
+        assert len(set(where)) == len(where), f"duplicate axes {axes}"
+        shape = []
+        it = iter(x.shape)
+        for i in range(out_rank):
+            shape.append(1 if i in where else next(it))
         return ff.reshape(x, tuple(shape), name=self._name(node))
 
     def _op_squeeze(self, ff, node, env):
         x = env[node.input[0]]
-        attrs = _attr_map(node)
-        axes = attrs.get("axes")
-        if axes is None and len(node.input) > 1:
-            axes = self.initializers[node.input[1]].astype(int).tolist()
+        axes = self._int_list(node, "axes")
         if axes is None:
             shape = [d for d in x.shape if d != 1]
         else:

@@ -197,6 +197,7 @@ class FFModel:
         eps: float = 1e-6,
         rope_theta: float = 10000.0,
         remat: bool = True,
+        remat_policy: Optional[str] = None,  # None (full) | "dots"
         attention: str = "xla",
         name: str = "",
     ) -> Tensor:
@@ -217,6 +218,7 @@ class FFModel:
                 eps=eps,
                 rope_theta=rope_theta,
                 remat=remat,
+                remat_policy=remat_policy,
                 attention=attention,
             ),
             [input],

@@ -298,6 +298,19 @@ def make_sp_attention(mesh, impl: str = "ring"):
     return attn_fn
 
 
+def _remat_policy(name):
+    """Named jax.checkpoint policies: ``None`` reverts to full remat;
+    "dots" saves MXU matmul outputs and recomputes only the cheap
+    elementwise/norm work in backward — less recompute than full remat
+    at slightly more memory (the standard transformer training
+    tradeoff; reference has no analog, Legion keeps everything)."""
+    if name is None:
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat policy {name!r}")
+
+
 def forward(
     params: Dict[str, Any],
     tokens: jnp.ndarray,  # (B, S) int32
@@ -305,6 +318,7 @@ def forward(
     *,
     positions: Optional[jnp.ndarray] = None,
     remat: bool = False,
+    remat_policy: Optional[str] = None,
     shard_activations: bool = False,
     attn_fn=None,
 ) -> jnp.ndarray:
@@ -331,7 +345,7 @@ def forward(
 
     blk = functools.partial(block, cfg, attn_fn=attn_fn)
     if remat:
-        blk = jax.checkpoint(blk)
+        blk = jax.checkpoint(blk, policy=_remat_policy(remat_policy))
 
     def scan_body(carry, p_l):
         y, _ = blk(p_l, carry, cos, sin, mask)
@@ -359,6 +373,7 @@ def make_train_step(
     *,
     num_microbatches: int = 1,
     remat: bool = True,
+    remat_policy: Optional[str] = None,  # None (full) | "dots"
     shard_activations: bool = True,
     attention: str = "xla",  # "xla" | "flash" (Pallas, ops/flash_attention)
 ):
@@ -413,6 +428,7 @@ def make_train_step(
                 tokens,
                 cfg,
                 remat=remat,
+                remat_policy=remat_policy,
                 shard_activations=shard_activations and sp,
                 attn_fn=attn_fn,
             )
@@ -430,7 +446,7 @@ def make_train_step(
             block, cfg, attn_fn=make_flash_attention() if flash else None
         )
         if remat:
-            blk = jax.checkpoint(blk)
+            blk = jax.checkpoint(blk, policy=_remat_policy(remat_policy))
 
         def loss_fn(params, tokens):
             B, S = tokens.shape

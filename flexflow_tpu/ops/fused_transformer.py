@@ -100,7 +100,9 @@ class TransformerDecoderStackOp(OpDef):
         mask = None if attn_fn is not None else llama.causal_mask(S)
         blk = functools.partial(llama.block, cfg, attn_fn=attn_fn)
         if attrs.get("remat", True):
-            blk = jax.checkpoint(blk)
+            blk = jax.checkpoint(
+                blk, policy=llama._remat_policy(attrs.get("remat_policy"))
+            )
 
         def body(carry, p_l):
             y, _ = blk(p_l, carry, cos, sin, mask)

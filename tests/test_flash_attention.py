@@ -111,3 +111,29 @@ def test_make_train_step_flash_smoke():
             losses[attn] = float(loss)
     assert np.isfinite(losses["flash"])
     assert losses["flash"] == pytest.approx(losses["xla"], rel=1e-4)
+
+
+def test_remat_policy_dots_same_numerics():
+    """remat_policy='dots' changes what backward recomputes, not the
+    math: loss must match full remat bitwise-ish."""
+    from flexflow_tpu.core.mesh import MachineSpec
+    from flexflow_tpu.optimizers import SGDOptimizer
+
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32)
+    mesh = MachineSpec().make_mesh(jax.devices()[:1])
+    tokens = np.random.default_rng(1).integers(
+        0, cfg.vocab_size, size=(2, 24)
+    ).astype(np.int32)
+    losses = {}
+    with jax.set_mesh(mesh):
+        for pol in (None, "dots"):
+            init_fn, step, ds = llama.make_train_step(
+                cfg, mesh, SGDOptimizer(lr=0.1), remat=True,
+                remat_policy=pol, shard_activations=False,
+            )
+            params, opt = init_fn(jax.random.PRNGKey(0))
+            # two steps so the optimizer update (i.e. the grads) matters
+            params, opt, _ = step(params, opt, jax.device_put(tokens, ds))
+            _, _, loss = step(params, opt, jax.device_put(tokens, ds))
+            losses[pol] = float(loss)
+    assert losses["dots"] == pytest.approx(losses[None], rel=1e-5)
