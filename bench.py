@@ -46,6 +46,7 @@ import time
 import traceback
 
 A100_SPECINFER_TOKS_PER_SEC = 60.0
+A100_INCR_TOKS_PER_SEC = 30.0
 TRAIN_MFU_TARGET = 0.40
 
 _RESULTS = {}
@@ -173,6 +174,55 @@ def _llm_cfg(on_tpu):
         max_position_embeddings=256,
         dtype=jnp.float32,
     )
+
+
+def _serve_workload(on_tpu):
+    """The ONE serving workload both the fp and int8 phases measure —
+    shared so their tokens/sec stay apples-to-apples."""
+    cfg = _llm_cfg(on_tpu)
+    n_new = 48 if on_tpu else 16
+    n_req = 4
+    prompt_len = 64 if on_tpu else 12
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_req)
+    ]
+
+    def make_sc(kern):
+        from flexflow_tpu.serve import ServingConfig
+
+        return ServingConfig(
+            max_requests_per_batch=n_req,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=32 if on_tpu else 8,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kern,
+        )
+
+    return cfg, prompts, n_new, n_req, make_sc
+
+
+def _make_rm(model_mod, cfg, params, make_sc, prompts, kernels):
+    """Engine + RequestManager, warmed; falls back pallas→xla with the
+    exception REPORTED if the flagship shapes trip a Mosaic limit the
+    parity phase's small config never hit. Returns (rm, kernels)."""
+    from flexflow_tpu.serve import InferenceEngine, RequestManager
+
+    try:
+        rm = RequestManager(InferenceEngine(model_mod, cfg, params,
+                                            make_sc(kernels)))
+        rm.generate(prompts, max_new_tokens=4)  # compile
+        return rm, kernels
+    except Exception as e:
+        if kernels == "xla":
+            raise
+        _log(f"kernels=pallas failed on flagship shapes, retrying xla: {e!r}")
+        traceback.print_exc(file=sys.stderr)
+        rm = RequestManager(InferenceEngine(model_mod, cfg, params,
+                                            make_sc("xla")))
+        rm.generate(prompts, max_new_tokens=4)
+        return rm, "xla"
 
 
 def _layer_skip_draft(cfg, params, k):
@@ -343,51 +393,12 @@ def serve_bench(on_tpu, kernels):
     import jax
 
     from flexflow_tpu.models import llama
-    from flexflow_tpu.serve import (
-        InferenceEngine,
-        RequestManager,
-        ServingConfig,
-        SpecConfig,
-        SpecInferManager,
-    )
+    from flexflow_tpu.serve import InferenceEngine, SpecConfig, SpecInferManager
 
-    cfg = _llm_cfg(on_tpu)
+    cfg, prompts, n_new, n_req, make_sc = _serve_workload(on_tpu)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    n_new = 48 if on_tpu else 16
-    n_req = 4
-    prompt_len = 64 if on_tpu else 12
-    prompts = [
-        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
-        for i in range(n_req)
-    ]
-
-    def make_sc(kern):
-        return ServingConfig(
-            max_requests_per_batch=n_req,
-            max_sequence_length=prompt_len + n_new + 8,
-            prefill_chunk=32 if on_tpu else 8,
-            max_spec_tree_tokens=16,
-            cache_dtype=cfg.dtype,
-            kernels=kern,
-        )
-
-    # Parity proved the kernels on a small config; the flagship shapes
-    # could still trip a Mosaic/VMEM limit — fall back to XLA with the
-    # exception REPORTED (never silently) rather than lose both serving
-    # metrics.
-    try:
-        eng = InferenceEngine(llama, cfg, params, make_sc(kernels))
-        rm = RequestManager(eng)
-        rm.generate(prompts, max_new_tokens=4)  # compile
-    except Exception as e:
-        if kernels == "xla":
-            raise
-        _log(f"kernels=pallas failed on flagship shapes, retrying xla: {e!r}")
-        traceback.print_exc(file=sys.stderr)
-        kernels = "xla"
-        eng = InferenceEngine(llama, cfg, params, make_sc(kernels))
-        rm = RequestManager(eng)
-        rm.generate(prompts, max_new_tokens=4)
+    rm, kernels = _make_rm(llama, cfg, params, make_sc, prompts, kernels)
+    eng = rm.engine
 
     # --- incremental decoding, steady state (same engine, warmed) ---
     t0 = time.perf_counter()
@@ -400,7 +411,7 @@ def serve_bench(on_tpu, kernels):
         "incr_decode_tokens_per_sec_per_chip",
         round(incr_tps, 2),
         "tokens/sec/chip",
-        vs_baseline=incr_tps / 30.0,  # ~30 tok/s incremental A100 baseline
+        vs_baseline=incr_tps / A100_INCR_TOKS_PER_SEC,
         kernels=kernels,
         n_requests=n_req,
         new_tokens_per_request=n_new,
@@ -446,33 +457,17 @@ def serve_int8_bench(on_tpu, kernels):
     """Weight-only int8 serving (reference --8bit-quantization,
     file_loader.cc:651 + decompress kernels): decode is bandwidth-bound
     on the params read, so int8 weights should ~2x tokens/sec/chip —
-    the beyond-parity headline when measured on chip."""
+    the beyond-parity headline when measured on chip. Same workload as
+    serve_bench (shared _serve_workload) so fp vs int8 is one variable."""
     import jax
 
     from flexflow_tpu.models import llama
     from flexflow_tpu.quantization import quantize_params
-    from flexflow_tpu.serve import InferenceEngine, RequestManager, ServingConfig
 
-    cfg = _llm_cfg(on_tpu)
+    cfg, prompts, n_new, n_req, make_sc = _serve_workload(on_tpu)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     qparams = quantize_params(params, bits=8)
-    n_new = 48 if on_tpu else 16
-    n_req = 4
-    prompt_len = 64 if on_tpu else 12
-    prompts = [
-        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
-        for i in range(n_req)
-    ]
-    sc = ServingConfig(
-        max_requests_per_batch=n_req,
-        max_sequence_length=prompt_len + n_new + 8,
-        prefill_chunk=32 if on_tpu else 8,
-        max_spec_tree_tokens=16,
-        cache_dtype=cfg.dtype,
-        kernels=kernels,
-    )
-    rm = RequestManager(InferenceEngine(llama, cfg, qparams, sc))
-    rm.generate(prompts, max_new_tokens=4)  # compile
+    rm, kernels = _make_rm(llama, cfg, qparams, make_sc, prompts, kernels)
     t0 = time.perf_counter()
     outs = rm.generate(prompts, max_new_tokens=n_new)
     dt = time.perf_counter() - t0
@@ -482,7 +477,7 @@ def serve_int8_bench(on_tpu, kernels):
         "incr_decode_tokens_per_sec_int8",
         round(tps, 2),
         "tokens/sec/chip",
-        vs_baseline=tps / 30.0,
+        vs_baseline=tps / A100_INCR_TOKS_PER_SEC,
         kernels=kernels,
         quantization="int8",
         model_params_b=round(llama.num_params(cfg) / 1e9, 3),
@@ -529,7 +524,7 @@ def main():
             on_tpu,
         )
     kernels = "xla"
-    if args.metric in ("all", "parity", "serve"):
+    if args.metric in ("all", "parity", "serve", "serve_int8"):
         ok = run_phase("kernel_parity", 300 if on_tpu else 180,
                        kernel_parity, on_tpu)
         kernels = "pallas" if ok else "xla"

@@ -1,0 +1,22 @@
+"""Named rematerialisation policies shared by every model family and
+the fused graph-IR ops.
+
+``None`` is full per-block remat (save only block boundaries); "dots"
+saves MXU matmul outputs and recomputes just the cheap elementwise/norm
+work in backward — less recompute at slightly more memory, the standard
+transformer training tradeoff. (The reference has no analog: Legion
+keeps every activation.)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def resolve_remat_policy(name: Optional[str]):
+    if name is None:
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat policy {name!r}")

@@ -299,16 +299,11 @@ def make_sp_attention(mesh, impl: str = "ring"):
 
 
 def _remat_policy(name):
-    """Named jax.checkpoint policies: ``None`` reverts to full remat;
-    "dots" saves MXU matmul outputs and recomputes only the cheap
-    elementwise/norm work in backward — less recompute than full remat
-    at slightly more memory (the standard transformer training
-    tradeoff; reference has no analog, Legion keeps everything)."""
-    if name is None:
-        return None
-    if name == "dots":
-        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-    raise ValueError(f"unknown remat policy {name!r}")
+    """See :func:`flexflow_tpu.core.remat.resolve_remat_policy` (shared
+    across model families and the fused graph-IR ops)."""
+    from ..core.remat import resolve_remat_policy
+
+    return resolve_remat_policy(name)
 
 
 def forward(

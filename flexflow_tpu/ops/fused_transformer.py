@@ -100,8 +100,10 @@ class TransformerDecoderStackOp(OpDef):
         mask = None if attn_fn is not None else llama.causal_mask(S)
         blk = functools.partial(llama.block, cfg, attn_fn=attn_fn)
         if attrs.get("remat", True):
+            from ..core.remat import resolve_remat_policy
+
             blk = jax.checkpoint(
-                blk, policy=llama._remat_policy(attrs.get("remat_policy"))
+                blk, policy=resolve_remat_policy(attrs.get("remat_policy"))
             )
 
         def body(carry, p_l):
@@ -142,17 +144,21 @@ class TransformerDecoderStackOp(OpDef):
         return B * S * (2 * L * per_layer_params + 4 * L * D * S)
 
     def activation_bytes(self, in_specs, attrs, training: bool) -> float:
-        """Live activation bytes for the memory model: with per-block
-        remat only the L inter-block boundaries are saved for backward
-        (plus one block's working set, dominated by the boundaries for
-        realistic L)."""
+        """Live activation bytes for the memory model: with full
+        per-block remat only the L inter-block boundaries are saved for
+        backward (plus one block's working set, dominated by the
+        boundaries for realistic L). The "dots" policy additionally
+        keeps every matmul output, so its footprint is modelled like
+        no-remat (a conservative upper bound — softmax/norm
+        intermediates are the recomputed part)."""
         (x,) = in_specs
         xb = float(x.size_bytes)
         if not training:
             return xb
-        if attrs.get("remat", True):
+        full_remat = attrs.get("remat", True) and not attrs.get("remat_policy")
+        if full_remat:
             return (attrs["num_layers"] + 1) * xb
-        # no remat: every block keeps hidden + qkv + ffn intermediates
+        # no remat / dots policy: blocks keep hidden + qkv + ffn dots
         F = attrs["intermediate_size"]
         D = x.shape[-1]
         return attrs["num_layers"] * xb * (4 + 2 * F / D)

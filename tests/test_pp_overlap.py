@@ -83,7 +83,10 @@ def test_overlap_reduces_total_work():
     PP=1's work on every stage."""
     ndev = 2
     mesh = MachineSpec(pipe=2).make_mesh(jax.devices()[:2])
-    R, C, D, L = 8, 8, 512, 8
+    # big enough that per-tick compute dwarfs the per-tick dispatch/
+    # ppermute overhead (M=2 runs MORE, smaller ticks — at small sizes
+    # overhead parity masks the 25% work reduction)
+    R, C, D, L = 8, 32, 1024, 8
     key = jax.random.PRNGKey(0)
     layers = jax.random.normal(key, (L, D, D), jnp.float32) * 0.1
     h = jax.random.normal(jax.random.fold_in(key, 1), (R, C, D), jnp.float32)
@@ -103,10 +106,14 @@ def test_overlap_reduces_total_work():
             piped = jax.jit(_make(mesh, M))
             out = piped(*args)  # compile + warm
             jax.block_until_ready(out)
-            t0 = time.perf_counter()
-            for _ in range(8):
-                out = piped(*args)
-            jax.block_until_ready(out)
-            times[M] = time.perf_counter() - t0
+            # min over repeated blocks: robust to CI scheduling noise
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(4):
+                    out = piped(*args)
+                jax.block_until_ready(out)
+                best = min(best, time.perf_counter() - t0)
+            times[M] = best
     # theoretical work ratio 0.75; allow noise up to 0.95
     assert times[2] < times[1] * 0.95, times
