@@ -19,6 +19,34 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 
+def _cmp_ops():
+    """Module-level comparison dispatch table (torch import deferred —
+    the module must import without torch installed)."""
+    import torch
+
+    return {
+        operator.gt: "gt", torch.gt: "gt", "gt": "gt",
+        operator.lt: "lt", torch.lt: "lt", "lt": "lt",
+        operator.ge: "ge", torch.ge: "ge", "ge": "ge",
+        operator.le: "le", torch.le: "le", "le": "le",
+        operator.eq: "eq", torch.eq: "eq", "eq": "eq",
+    }
+
+
+class _LazyCmpOps:
+    """Dict-like built on first use (after torch is importable)."""
+
+    _table = None
+
+    def get(self, key):
+        if _LazyCmpOps._table is None:
+            _LazyCmpOps._table = _cmp_ops()
+        return _LazyCmpOps._table.get(key)
+
+
+_CMP_OPS = _LazyCmpOps()
+
+
 class PyTorchModel:
     """Wraps a ``torch.nn.Module``; ``to_ff(ffmodel, input_tensors)``
     replays its fx graph as FFModel layers and returns the outputs
@@ -362,6 +390,17 @@ class PyTorchModel:
             return ff.transpose(args[0], tuple(int(p) for p in perm), name=name)
         if t in (torch.matmul, torch.bmm, "matmul", "bmm"):
             return ff.batch_matmul(args[0], args[1], name=name)
+        cmp = _CMP_OPS.get(t)
+        if (
+            cmp is not None
+            and self._is_ff(args[0])
+            and not self._is_ff(args[1])
+            and np.ndim(args[1]) == 0
+        ):
+            # traced masks: (x > 0).float() — 0/1 in x's dtype, so the
+            # following .float()/.bool() casts are identities. (Array
+            # comparands fall through to the loud unsupported error.)
+            return ff.scalar_compare(args[0], cmp, float(args[1]), name=name)
         if t in (F.relu, torch.relu, "relu"):
             return ff.relu(args[0], name=name)
         if t in (F.gelu, "gelu"):

@@ -593,6 +593,11 @@ class FFModel:
     def scalar_truediv(self, x, scalar, name=""):
         return self._unary("scalar_truediv", x, name, scalar=scalar)
 
+    def scalar_compare(self, x, op: str, scalar, name=""):
+        """Elementwise compare against a scalar → 0/1 mask in x's dtype
+        (op in gt/lt/ge/le/eq)."""
+        return self._unary(f"scalar_{op}", x, name, scalar=scalar)
+
     def add(self, a, b, name=""):
         return self._binary("add", a, b, name)
 

@@ -619,6 +619,13 @@ _UNARY_SCALAR = {
     "scalar_sub": lambda x, s: x - s,
     "scalar_truediv": lambda x, s: x / s,
     "pow": lambda x, s: jnp.power(x, s),
+    # comparisons yield 0/1 in the input dtype (frontends import traced
+    # masks like `(x > 0).float()` through these)
+    "scalar_gt": lambda x, s: (x > s).astype(x.dtype),
+    "scalar_lt": lambda x, s: (x < s).astype(x.dtype),
+    "scalar_ge": lambda x, s: (x >= s).astype(x.dtype),
+    "scalar_le": lambda x, s: (x <= s).astype(x.dtype),
+    "scalar_eq": lambda x, s: (x == s).astype(x.dtype),
 }
 
 

@@ -347,3 +347,79 @@ def test_onnx_batchnorm_running_stats_imported():
         var[None, :, None, None] + 1e-5
     ) * scale[None, :, None, None] + bias[None, :, None, None]
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_torch_fx_masked_fill_inf_and_array_ops():
+    """Review-fix regressions: masked_fill(-inf) must clamp (no NaN),
+    array+tensor / scalar-tensor rsub / array-first add import cleanly."""
+    from flexflow_tpu.frontends import PyTorchModel
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("off", torch.arange(4).float())
+
+        def forward(self, x):
+            m = (x > 0).float()
+            y = x.masked_fill(m.bool(), float("-inf"))  # clamp path
+            y = y.masked_fill(m.bool(), 0.0) + self.off  # array add
+            z = 1.0 - y                                  # rsub path
+            return self.off + z                          # array-first add
+
+    net = M().eval()
+    pt = PyTorchModel(net, batch_size=2)
+    cfg = ff.FFConfig(batch_size=2, num_devices=1)
+    m = ff.FFModel(cfg)
+    x_t = m.create_tensor((2, 4), name="x")
+    (out,) = pt.to_ff(m, [x_t])
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.0), output=out,
+              loss_type="mean_squared_error", metrics=())
+    pt.load_weights(m)
+    x = np.array([[-1.0, 2.0, -3.0, 4.0], [0.5, -0.5, 1.5, -1.5]],
+                 np.float32)
+    got = np.asarray(m.forward(x))
+    with torch.no_grad():
+        # torch reference with the same clamp the importer applies
+        mm = (torch.from_numpy(x) > 0).float()
+        y = torch.from_numpy(x).masked_fill(mm.bool(), -1e30)
+        y = y.masked_fill(mm.bool(), 0.0) + net.off
+        ref = (net.off + (1.0 - y)).numpy()
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_torch_fx_sdpa_positional_is_causal():
+    """scaled_dot_product_attention with is_causal passed POSITIONALLY
+    must apply the causal mask (review fix)."""
+    import torch.nn.functional as F
+
+    from flexflow_tpu.frontends import PyTorchModel
+
+    class M(torch.nn.Module):
+        def forward(self, q, k, v):
+            return torch._C._nn.scaled_dot_product_attention(
+                q, k, v, None, 0.0, True  # positional is_causal=True
+            )
+
+    net = M().eval()
+    pt = PyTorchModel(net)
+    cfg = ff.FFConfig(batch_size=1, num_devices=1)
+    m = ff.FFModel(cfg)
+    B, H, S, dk = 1, 2, 6, 8
+    qt = m.create_tensor((B, H, S, dk), name="q")
+    kt = m.create_tensor((B, H, S, dk), name="k")
+    vt = m.create_tensor((B, H, S, dk), name="v")
+    (out,) = pt.to_ff(m, [qt, kt, vt])
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.0), output=out,
+              loss_type="mean_squared_error", metrics=())
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(B, H, S, dk)).astype(np.float32)
+    k = rng.normal(size=(B, H, S, dk)).astype(np.float32)
+    v = rng.normal(size=(B, H, S, dk)).astype(np.float32)
+    got = np.asarray(m.forward({"q": q, "k": k, "v": v}))
+    with torch.no_grad():
+        ref = F.scaled_dot_product_attention(
+            torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+            is_causal=True,
+        ).numpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
