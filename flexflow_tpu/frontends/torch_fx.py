@@ -19,9 +19,13 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
 def _cmp_ops():
-    """Module-level comparison dispatch table (torch import deferred —
-    the module must import without torch installed)."""
+    """Comparison dispatch table, built on first use (torch import
+    deferred — the module must import without torch installed)."""
     import torch
 
     return {
@@ -31,20 +35,6 @@ def _cmp_ops():
         operator.le: "le", torch.le: "le", "le": "le",
         operator.eq: "eq", torch.eq: "eq", "eq": "eq",
     }
-
-
-class _LazyCmpOps:
-    """Dict-like built on first use (after torch is importable)."""
-
-    _table = None
-
-    def get(self, key):
-        if _LazyCmpOps._table is None:
-            _LazyCmpOps._table = _cmp_ops()
-        return _LazyCmpOps._table.get(key)
-
-
-_CMP_OPS = _LazyCmpOps()
 
 
 class PyTorchModel:
@@ -390,7 +380,7 @@ class PyTorchModel:
             return ff.transpose(args[0], tuple(int(p) for p in perm), name=name)
         if t in (torch.matmul, torch.bmm, "matmul", "bmm"):
             return ff.batch_matmul(args[0], args[1], name=name)
-        cmp = _CMP_OPS.get(t)
+        cmp = _cmp_ops().get(t)
         if (
             cmp is not None
             and self._is_ff(args[0])

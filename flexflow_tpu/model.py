@@ -768,6 +768,7 @@ class FFModel:
                 measured=cfgf.search_measured,
                 enable_sample=cfgf.enable_sample_parallel,
                 enable_attribute=cfgf.enable_attribute_parallel,
+                enable_parameter=cfgf.enable_parameter_parallel,
                 # a user-fixed expert degree was already carved out of
                 # the searched device count — don't enumerate it again
                 allow_expert=cfgf.expert_parallelism_degree == 1,

@@ -167,6 +167,15 @@ class DenseOp(OpDef):
             specs = {"kernel": P(model_axis, None)}
             if attrs.get("use_bias", True):
                 specs["bias"] = P()
+        elif tp == "param":
+            # parameter-parallel (ZeRO-style): weights shard over the
+            # DATA axis and GSPMD all-gathers them per step; activations
+            # stay batch-sharded (reference enable_parameter_parallel)
+            from ..core.mesh import DATA_AXIS
+
+            specs = {"kernel": P(DATA_AXIS, None)}
+            if attrs.get("use_bias", True):
+                specs["bias"] = P()
         else:
             specs = {"kernel": P()}
             if attrs.get("use_bias", True):
@@ -215,6 +224,10 @@ class EmbeddingOp(OpDef):
     def weight_pspecs(self, in_specs, attrs, model_axis):
         if attrs.get("tp_shard") == "col":
             return {"table": P(None, model_axis)}
+        if attrs.get("tp_shard") == "param":
+            from ..core.mesh import DATA_AXIS
+
+            return {"table": P(DATA_AXIS, None)}
         return {"table": P()}
 
     def flops(self, in_specs, attrs):

@@ -110,6 +110,10 @@ def event_sim_cost(
             if nbytes > 0.0:
                 if states[node.id] in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
                     nbytes /= cm.machine.model
+                elif states[node.id] == "PARAM":
+                    # ZeRO grads reduce-scatter: half an all-reduce
+                    # (mirrors grad_sync_cost's accounting)
+                    nbytes /= 2.0
                 bw = cm.topo.axis_bandwidth(DATA_AXIS)
                 r = 2.0 * (d - 1) / d * nbytes / bw  # bandwidth-only term
                 start = max(compute_free, comm_free)

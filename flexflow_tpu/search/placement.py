@@ -54,6 +54,7 @@ def placement_dp(
             machine,
             enable_sample=cost_model.enable_sample,
             enable_attribute=cost_model.enable_attribute,
+            enable_parameter=cost_model.enable_parameter,
         )
         dp[node.id] = {}
         back[node.id] = {}

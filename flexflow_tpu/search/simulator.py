@@ -81,6 +81,10 @@ _TP_STATES = {
 }
 _ANY = ("REP", "DP")
 
+# Ops that implement the PARAM (ZeRO-style weight-sharding) state in
+# their weight_pspecs (tp_shard="param").
+_PARAM_OK = {"dense", "embedding"}
+
 # Ops whose batch dim can split past the data axis (sample parallelism)
 # and whose dim-1 attribute can split over model (attribute parallelism)
 # — weight-free / elementwise-ish ops where replicated weights make the
@@ -120,10 +124,17 @@ def candidate_states(
     *,
     enable_sample: bool = True,
     enable_attribute: bool = True,
+    enable_parameter: bool = True,
 ) -> Tuple[str, ...]:
     if node.op_type == "input":
         return ("DP",) if machine.data > 1 else ("REP",)
     states = _ANY
+    if (
+        enable_parameter
+        and machine.data > 1
+        and node.op_type in _PARAM_OK
+    ):
+        states = states + ("PARAM",)
     if machine.model > 1:
         if node.op_type in _TP_STATES:
             states = states + tuple(
@@ -147,9 +158,11 @@ class CostModel:
     training: bool = True
     # measured-mode memo: (op_type, attrs, shapes, state) -> seconds
     measured: Optional[Dict] = None
-    # reference --enable-sample/attribute-parallel (config.h:160-162)
+    # reference --enable-sample/attribute/parameter-parallel
+    # (config.h:160-162)
     enable_sample: bool = True
     enable_attribute: bool = True
+    enable_parameter: bool = True
 
     def __post_init__(self):
         self.coll = CollectiveModel(self.topo)
@@ -173,7 +186,8 @@ class CostModel:
             bytes_moved *= 2.0
         # work divides over the axes this state shards
         div = 1
-        if state in ("DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR"):
+        if state in ("DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "PARAM",
+                     "SAMPLE", "ATTR"):
             div *= self.machine.data
         if state in ("TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR"):
             div *= self.machine.model
@@ -201,6 +215,13 @@ class CostModel:
         # single-device measurements never include the multi-device
         # collectives a sharded state implies — always price them on top
         t += self._internal_comm_cost(node, in_specs, state)
+        if state == "PARAM" and self.machine.data > 1:
+            # ZeRO-style weight all-gather per forward (backward's
+            # reduce-scatter replaces the DP grad all-reduce and is
+            # priced in grad_sync_cost)
+            t += self.coll.all_gather(
+                weight_bytes(graph, node), self.machine.data, DATA_AXIS
+            )
         return t
 
     def _internal_comm_cost(self, node: OpNode, in_specs, state: str) -> float:
@@ -244,11 +265,11 @@ class CostModel:
         """Collective cost of moving one activation between two op
         sharding states (the priced equivalents of the reference's
         Repartition/Combine/Replicate/Reduction/AllReduce nodes)."""
-        # TP_MEGATRON's boundary activations are batch-sharded
-        # full-feature tensors — exactly a DP edge
-        if producer_state == "TP_MEGATRON":
+        # TP_MEGATRON's and PARAM's boundary activations are
+        # batch-sharded full-feature tensors — exactly a DP edge
+        if producer_state in ("TP_MEGATRON", "PARAM"):
             producer_state = "DP"
-        if consumer_state == "TP_MEGATRON":
+        if consumer_state in ("TP_MEGATRON", "PARAM"):
             consumer_state = "DP"
         if producer_state == consumer_state:
             rule = _RESHARD.get((producer_state, consumer_state))
@@ -300,14 +321,16 @@ class CostModel:
     def op_memory_bytes(self, graph: Graph, node: OpNode, state: str) -> float:
         """Per-device HBM bytes attributable to one op under ``state``:
         parameters (+grads+optimizer state when training) + activations
-        saved for the backward pass. Weights shard over ``model`` only in
-        TP states (DP replicates them); activations shard over whatever
-        the state shards."""
+        saved for the backward pass. Weights shard over ``model`` in TP
+        states and over ``data`` in PARAM (DP replicates them);
+        activations shard over whatever the state shards."""
         if node.op_type == "input":
             return 0.0
         w = weight_bytes(graph, node)
         if state in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
             w /= self.machine.model
+        elif state == "PARAM":
+            w /= self.machine.data  # ZeRO: params+grads+opt all shard
         if self.training:
             w *= 1.0 + self.opt_state_mult
         op = get_op(node.op_type)
@@ -318,7 +341,8 @@ class CostModel:
         else:
             act = float(sum(_nbytes(s) for s in node.out_specs))
         div = 1
-        if state in ("DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR"):
+        if state in ("DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "PARAM",
+                     "SAMPLE", "ATTR"):
             div *= self.machine.data
         if state in ("SAMPLE", "ATTR", "TP_COL"):
             div *= self.machine.model
@@ -357,6 +381,10 @@ class CostModel:
             state = strategy.choices.get(node.id, "DP")
             if state in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
                 nbytes /= self.machine.model  # sharded grads all-reduce less
+            elif state == "PARAM":
+                # ZeRO grads reduce-scatter (half an all-reduce): fold
+                # the factor into the byte count of the shared ring
+                nbytes /= 2.0
             total += nbytes
         return self.coll.all_reduce(total, self.machine.data, DATA_AXIS)
 

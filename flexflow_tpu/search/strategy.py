@@ -46,7 +46,13 @@ from ..core.mesh import DATA_AXIS, MODEL_AXIS, MachineSpec
 # enable_attribute_parallel, reference config.h:160-162): SAMPLE splits
 # the batch over BOTH mesh axes (weights replicated), ATTR splits a
 # non-batch activation dim (spatial/sequence) over the model axis.
-STATES = ("REP", "DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "SAMPLE", "ATTR")
+# PARAM is the reference's parameter-parallel dim
+# (enable_parameter_parallel) realised the GSPMD way: weights (and grads
+# + optimizer state) shard over the DATA axis and are all-gathered per
+# step — the ZeRO-style memory/time tradeoff the memory search can pick
+# when replicated weights blow HBM.
+STATES = ("REP", "DP", "TP_COL", "TP_ROW", "TP_MEGATRON", "PARAM",
+          "SAMPLE", "ATTR")
 
 
 class _GraphUnpickler(pickle.Unpickler):
@@ -139,7 +145,7 @@ class ParallelStrategy:
             if not w:
                 continue
             state = self.choices.get(node.id, "DP")
-            if state in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
+            if state in ("TP_COL", "TP_ROW", "TP_MEGATRON", "PARAM"):
                 attrs = node.attrs_dict
                 attrs["tp_shard"] = self._tp_kind(node.op_type, state)
                 out[node.name] = op.weight_pspecs(in_specs, attrs, MODEL_AXIS)
@@ -151,6 +157,8 @@ class ParallelStrategy:
     def _tp_kind(op_type: str, state: str) -> str:
         if state == "TP_MEGATRON":
             return "megatron"
+        if state == "PARAM":
+            return "param"
         if op_type == "multihead_attention":
             return "heads"
         return "col" if state == "TP_COL" else "row"
@@ -163,7 +171,7 @@ class ParallelStrategy:
         (model.cc:3347-3349)."""
         for node in graph.nodes:
             state = self.choices.get(node.id)
-            if state in ("TP_COL", "TP_ROW", "TP_MEGATRON"):
+            if state in ("TP_COL", "TP_ROW", "TP_MEGATRON", "PARAM"):
                 d = dict(node.attrs)
                 d["tp_shard"] = self._tp_kind(node.op_type, state)
                 node.attrs = tuple(sorted(d.items()))
@@ -174,10 +182,10 @@ class ParallelStrategy:
         pad = (None,) * max(0, rank - 2)
         if state == "TP_COL":  # features (last dim) sharded
             return P(data, *pad, MODEL_AXIS)
-        if state in ("DP", "TP_ROW", "TP_MEGATRON"):
-            # TP_MEGATRON keeps boundary activations full-feature; the
-            # model-axis sharding lives inside the op (GSPMD-propagated
-            # from the Megatron weight pspecs)
+        if state in ("DP", "TP_ROW", "TP_MEGATRON", "PARAM"):
+            # TP_MEGATRON/PARAM keep boundary activations batch-sharded
+            # full-feature; the weight sharding lives inside the op
+            # (GSPMD inserts the Megatron psums / the ZeRO all-gather)
             return P(data)
         if state == "SAMPLE":  # batch over both axes
             both = tuple(a for a in (data, MODEL_AXIS) if a)

@@ -117,6 +117,7 @@ def optimize(
     measured: bool = False,
     enable_sample: bool = True,
     enable_attribute: bool = True,
+    enable_parameter: bool = True,
     allow_expert: bool = True,
     extra_rules: Optional[List] = None,
     memory_budget: Optional[float] = None,
@@ -166,6 +167,7 @@ def optimize(
         cm = CostModel(
             topo=topo, machine=machine, training=training,
             enable_sample=enable_sample, enable_attribute=enable_attribute,
+            enable_parameter=enable_parameter,
             measured=shared_measured,
         )
 
@@ -229,6 +231,7 @@ def mcmc_optimize(
             machine,
             enable_sample=cost_model.enable_sample,
             enable_attribute=cost_model.enable_attribute,
+            enable_parameter=cost_model.enable_parameter,
         )
         new_state = rng.choice(states)
         old_state = choices.get(node.id, "DP")

@@ -185,7 +185,10 @@ def _fat_mlp(num_devices=4):
 def test_memory_search_rejects_oom_strategy():
     g = _fat_mlp().graph
     topo = TPUTopology(chip=TPUChip.v5e(), num_chips=4)
-    cm = CostModel(topo=topo, machine=MachineSpec(data=4, model=1))
+    # parameter-parallel disabled: the ONLY memory lever on a data-only
+    # machine is gone, so infeasibility must be detected
+    cm = CostModel(topo=topo, machine=MachineSpec(data=4, model=1),
+                   enable_parameter=False)
     cm_tp = CostModel(topo=topo, machine=MachineSpec(data=1, model=4))
 
     # weights: 2 * (1024*4096*4B) * (1+opt) ≈ 134 MB replicated
@@ -195,9 +198,17 @@ def test_memory_search_rejects_oom_strategy():
     full = cm.strategy_memory_bytes(g, unconstrained)
     budget = full * 0.5  # DP cannot fit; TP (weights/4) can
 
-    # pure-DP machine: even λ=1 can't shard weights → infeasible
+    # pure-DP machine without parameter-parallel: even λ=1 can't shard
+    # weights → infeasible
     s_dp, lam_dp = memory_search(g, cm, budget)
     assert cm.strategy_memory_bytes(g, s_dp) > budget
+
+    # same machine WITH parameter-parallel: the λ sweep finds a fitting
+    # ZeRO-style strategy (weights/grads/opt shard over the data axis)
+    cm_zero = CostModel(topo=topo, machine=MachineSpec(data=4, model=1))
+    s_zero, _ = memory_search(g, cm_zero, budget)
+    assert cm_zero.strategy_memory_bytes(g, s_zero) <= budget
+    assert any(s == "PARAM" for s in s_zero.choices.values())
 
     # TP machine: the λ sweep finds a fitting strategy
     s_tp, lam_tp = memory_search(g, cm_tp, budget)
@@ -231,3 +242,53 @@ def test_fused_stack_activation_bytes_reflect_remat():
     without = op.activation_bytes([spec], dict(base, remat=False), True)
     assert with_remat < without
     assert op.activation_bytes([spec], dict(base, remat=True), False) < with_remat
+
+
+def test_param_state_executes_and_matches_dp():
+    """PARAM (ZeRO-style weight sharding over the data axis) must
+    execute via GSPMD and produce the same loss as plain DP (reference
+    enable_parameter_parallel, config.h:160-162)."""
+    import flexflow_tpu.search as search
+
+    def build():
+        cfg = ff.FFConfig(batch_size=8, num_devices=8)
+        m = ff.FFModel(cfg)
+        t = m.create_tensor((8, 16), name="x")
+        t = m.dense(t, 32, activation="relu", name="d0")
+        t = m.dense(t, 4, name="d1")
+        m.softmax(t, name="sm")
+        return m
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    y = rng.integers(0, 4, size=8).astype(np.int32)
+
+    losses = {}
+    for states in ("DP", "PARAM"):
+        m = build()
+        machine = MachineSpec(data=8, model=1)
+        strat = search.ParallelStrategy(
+            machine=machine,
+            choices={
+                n.id: (states if n.op_type == "dense" else "DP")
+                for n in m.graph.nodes
+            },
+        )
+        strat.stamp(m.graph)
+        m._strategy = strat
+        m._param_pspecs = strat.weight_pspecs(m.graph)
+        m.config.data_parallelism_degree = 8
+        m.compile(optimizer=SGDOptimizer(lr=0.0), metrics=())
+        with jax.set_mesh(m.mesh):
+            batch = m._shard_batch({"x": x})
+            yb = m._shard_batch({"y": y})["y"]
+            *_, loss, _mv = m._train_step(
+                m.params, m.opt_state, m.model_state,
+                jax.random.PRNGKey(0), batch, yb,
+            )
+            losses[states] = float(loss)
+        if states == "PARAM":
+            # the kernels really are sharded over the data axis
+            k = m.params["d0"]["kernel"]
+            assert "data" in str(k.sharding.spec)
+    assert losses["PARAM"] == pytest.approx(losses["DP"], rel=1e-5)
