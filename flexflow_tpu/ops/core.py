@@ -21,6 +21,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.dtypes import DataType
+from ..core.mesh import DATA_AXIS
 from ..core.tensor import TensorSpec
 from .. import initializers as ffinit
 from .registry import OpDef, OpContext, register
@@ -171,8 +172,6 @@ class DenseOp(OpDef):
             # parameter-parallel (ZeRO-style): weights shard over the
             # DATA axis and GSPMD all-gathers them per step; activations
             # stay batch-sharded (reference enable_parameter_parallel)
-            from ..core.mesh import DATA_AXIS
-
             specs = {"kernel": P(DATA_AXIS, None)}
             if attrs.get("use_bias", True):
                 specs["bias"] = P()
@@ -225,8 +224,6 @@ class EmbeddingOp(OpDef):
         if attrs.get("tp_shard") == "col":
             return {"table": P(None, model_axis)}
         if attrs.get("tp_shard") == "param":
-            from ..core.mesh import DATA_AXIS
-
             return {"table": P(DATA_AXIS, None)}
         return {"table": P()}
 

@@ -216,10 +216,14 @@ class CostModel:
         # collectives a sharded state implies — always price them on top
         t += self._internal_comm_cost(node, in_specs, state)
         if state == "PARAM" and self.machine.data > 1:
-            # ZeRO-style weight all-gather per forward (backward's
-            # reduce-scatter replaces the DP grad all-reduce and is
-            # priced in grad_sync_cost)
-            t += self.coll.all_gather(
+            # ZeRO-style weight all-gathers: one per forward and — since
+            # params are never persisted gathered — one more for the
+            # backward. (The grad reduce-scatter replaces the DP grad
+            # all-reduce and is priced in grad_sync_cost.) Without the
+            # backward gather PARAM would price exactly like DP and the
+            # search would be time-indifferent between them.
+            gathers = 2.0 if self.training else 1.0
+            t += gathers * self.coll.all_gather(
                 weight_bytes(graph, node), self.machine.data, DATA_AXIS
             )
         return t
