@@ -14,7 +14,7 @@ src/runtime/graph.cc:2108 + model.cc:3347).
 from __future__ import annotations
 
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 
 def build_searched_lm(
@@ -57,7 +57,9 @@ def build_searched_lm(
     return ff
 
 
-def searched_train_mfu(on_tpu: bool, iters: int = 10) -> Dict[str, Any]:
+def searched_train_mfu(
+    on_tpu: bool, iters: int = 10, attention_override: Optional[str] = None
+) -> Dict[str, Any]:
     """Compile the flagship LM with auto_parallel=True, time the searched
     step, and return MFU + the search-fidelity ratio from
     ``validate_search`` (predicted/measured ∈ [0.5, 2] is the
@@ -81,6 +83,8 @@ def searched_train_mfu(on_tpu: bool, iters: int = 10) -> Dict[str, Any]:
         dt, attention = jnp.float32, "xla"
         remat_policy = None
         iters = 2
+    if attention_override is not None:
+        attention = attention_override
 
     cfg = FFConfig(batch_size=B, num_devices=1, search_budget=8)
     ff = build_searched_lm(
