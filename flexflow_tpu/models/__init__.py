@@ -1,7 +1,8 @@
-from . import llama, transformer, opt, falcon, mpt, starcoder, hf_utils
+from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, hf_utils
 
 # Model-family registry (reference python/flexflow/serve/models/__init__.py
-# maps HF architectures to FlexFlow builders).
+# maps HF architectures to FlexFlow builders; qwen2 goes beyond the
+# reference's five-family zoo).
 FAMILIES = {
     "llama": llama,
     "opt": opt,
@@ -9,9 +10,10 @@ FAMILIES = {
     "mpt": mpt,
     "starcoder": starcoder,
     "gpt_bigcode": starcoder,
+    "qwen2": qwen2,
 }
 
 __all__ = [
-    "llama", "transformer", "opt", "falcon", "mpt", "starcoder",
+    "llama", "transformer", "opt", "falcon", "mpt", "starcoder", "qwen2",
     "hf_utils", "FAMILIES",
 ]

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 import flexflow_tpu.models as zoo
-from flexflow_tpu.models import falcon, llama, mpt, opt, starcoder
+from flexflow_tpu.models import falcon, llama, mpt, opt, qwen2, starcoder
 
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
@@ -77,8 +77,20 @@ def _hf_starcoder():
     ), starcoder
 
 
+def _hf_qwen2():
+    cfg = transformers.Qwen2Config(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+    )
+    return transformers.Qwen2ForCausalLM(cfg), qwen2.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), qwen2
+
+
 BUILDERS = {
     "llama": _hf_llama,
+    "qwen2": _hf_qwen2,
     "opt": _hf_opt,
     "falcon": _hf_falcon,
     "mpt": _hf_mpt,
