@@ -144,7 +144,19 @@ def test_serve_matches_forward(family):
 
 
 def test_family_registry():
-    assert set(zoo.FAMILIES) >= {"llama", "opt", "falcon", "mpt", "starcoder"}
+    assert set(zoo.FAMILIES) >= {
+        "llama", "opt", "falcon", "mpt", "starcoder", "qwen2",
+    }
+    # non-dense Qwen2 variants must be rejected loudly, not misrouted
+    # through the substring fallback into the dense converter
+    import pytest as _pytest
+
+    from flexflow_tpu.models import qwen2 as _q
+
+    with _pytest.raises(NotImplementedError):
+        _q.from_hf({"model_type": "qwen2_moe", "hidden_size": 64,
+                    "intermediate_size": 128, "num_hidden_layers": 2,
+                    "num_attention_heads": 4})
 
 
 def test_llm_from_pretrained_e2e(tmp_path):
