@@ -70,6 +70,23 @@ class Graph:
         self.nodes: List[OpNode] = []
         self._sig_index: Dict[Tuple, int] = {}
         self._used_names: Dict[str, int] = {}
+        # Names of nodes DROPPED by substitution rewrites, mapped to the
+        # (surviving_name, out_idx) their output was redirected to —
+        # lets compile re-resolve an output whose op got fused away
+        # (chains resolve lazily via resolve_name).
+        self.name_aliases: Dict[str, Tuple[str, int]] = {}
+
+    def resolve_name(self, name: str, out_idx: int = 0):
+        """Follow rewrite aliases until a live node name; returns
+        (node, out_idx) or (None, out_idx) when unresolvable."""
+        live = {n.name: n for n in self.nodes}
+        seen = set()
+        while name not in live:
+            if name in seen or name not in self.name_aliases:
+                return None, out_idx
+            seen.add(name)
+            name, out_idx = self.name_aliases[name]
+        return live[name], out_idx
 
     def add_node(
         self,

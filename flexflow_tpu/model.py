@@ -709,18 +709,30 @@ class FFModel:
         spec = self.config.machine_spec()
         return spec.make_mesh()
 
-    def _run_unity_search(self, output: Optional[Tensor], comp_mode: str) -> bool:
+    def _run_unity_search(
+        self, output: Optional[Tensor], comp_mode: str
+    ) -> Optional[TensorRef]:
         """Unity-style auto-parallelization (reference compile step 2:
         GRAPH_OPTIMIZE_TASK_ID → graph_optimize_task, model.cc:3337,
         graph.cc:2108). Rewrites self.graph, sets mesh degrees and the
         weight-sharding override from the found strategy; honors the
-        import/export strategy files (config.h:171-172). Returns True
-        when the graph was rewritten (node ids re-numbered)."""
+        import/export strategy files (config.h:171-172).
+
+        Returns the ``output`` re-resolved against the (possibly
+        rewritten) graph, or None when no output was given. Rewrites
+        re-number node ids but preserve NAMES (substitutions.rebuild),
+        so mid-graph outputs — metric taps, multi-head graphs — survive
+        the search by name."""
         from . import search as unity
         from .core.mesh import MachineSpec
 
         cfgf = self.config
-        rewritten = False
+        out_name = (
+            self.graph.nodes[output.ref.node_id].name
+            if output is not None
+            else None
+        )
+        out_idx = output.ref.out_idx if output is not None else 0
         if cfgf.import_strategy_file:
             strategy = unity.ParallelStrategy.load(cfgf.import_strategy_file)
             if strategy.graph is not None:
@@ -728,16 +740,11 @@ class FFModel:
                 # rewritten graph so the imported per-node choices bind
                 # to the node ids they were searched for (reference
                 # deserializes graph + views together, graph.cc:2225).
-                rewritten = strategy.graph is not self.graph
                 self.graph = strategy.graph
                 self.input_nodes = [
                     n.id for n in self.graph.nodes if n.op_type == "input"
                 ]
         else:
-            assert output is None or output.ref.node_id == len(self.graph.nodes) - 1, (
-                "auto_parallel currently requires the output to be the "
-                "final graph node (rewrites re-number nodes)"
-            )
             # The search owns the ICI axes not explicitly configured:
             # fixed pipeline/expert/sequence degrees carve the device
             # count down first (the reference likewise fixes inference
@@ -774,7 +781,6 @@ class FFModel:
                 allow_expert=cfgf.expert_parallelism_degree == 1,
                 extra_rules=extra_rules,
             )
-            rewritten = graph2 is not self.graph
             self.graph = graph2
             self._search_report = report
         strategy.stamp(self.graph)
@@ -793,7 +799,18 @@ class FFModel:
         )
         if cfgf.export_strategy_file:
             strategy.save(cfgf.export_strategy_file, graph=self.graph)
-        return rewritten
+        if out_name is None:
+            return None
+        # follow rewrite aliases: a fused-away output (e.g. relu folded
+        # into dense) resolves to the node its value was redirected to
+        node, out_idx = self.graph.resolve_name(out_name, out_idx)
+        if node is None:
+            raise ValueError(
+                f"output node {out_name!r} was rewritten away by the "
+                "search with no redirect; name an op the substitutions "
+                "keep so the output can be re-resolved after rewrites"
+            )
+        return TensorRef(node.id, out_idx)
 
     def _param_shardings(self):
         """PartitionSpec tree matching params, from per-op TP rules (or the
@@ -823,6 +840,7 @@ class FFModel:
         comp_mode: str = TRAINING,
         output: Optional[Tensor] = None,
         auto_parallel: bool = False,
+        _output_name: Optional[Tuple[str, int]] = None,
     ):
         """Lower the graph to jitted step functions (reference
         ``FFModel::compile``, model.cc:3314). With ``auto_parallel`` the
@@ -839,28 +857,40 @@ class FFModel:
                 "quantization=/offload= to serve.LLM.compile (training "
                 "quantization is not supported, matching the reference)"
             )
+        self.optimizer = optimizer or SGDOptimizer(lr=self.config.learning_rate)
+        self.loss_type = loss_type
+        self.metrics_names = tuple(metrics)
+        if output is None and _output_name is not None:
+            # recompile path: the Tensor handle is long stale — the
+            # declared output survives by NAME (+ rewrite aliases)
+            node, idx = self.graph.resolve_name(*_output_name)
+            if node is not None:
+                output = Tensor(self, TensorRef(node.id, idx))
+        out_ref = output.ref if output is not None else None
+        if auto_parallel or self.config.import_strategy_file:
+            # rewrites re-number node ids; the search re-resolves the
+            # output by NAME (mid-graph outputs / metric taps supported)
+            out_ref = self._run_unity_search(output, comp_mode)
         self._compile_args = dict(
             optimizer=optimizer, loss_type=loss_type, metrics=metrics,
             comp_mode=comp_mode,
             # the output Tensor's node ref goes stale once a search (or
-            # a recompile alter) rewrites the graph; a recompile always
-            # re-resolves to the final node instead
+            # a recompile alter) rewrites the graph; recompiles pass the
+            # NAME and re-resolve against the current graph instead
             output=None,
+            _output_name=(
+                (self.graph.nodes[out_ref.node_id].name, out_ref.out_idx)
+                if out_ref is not None
+                else None
+            ),
             auto_parallel=auto_parallel,
         )
-        self.optimizer = optimizer or SGDOptimizer(lr=self.config.learning_rate)
-        self.loss_type = loss_type
-        self.metrics_names = tuple(metrics)
-        if auto_parallel or self.config.import_strategy_file:
-            rewritten = self._run_unity_search(output, comp_mode)
-            if rewritten:
-                output = None  # output ref re-resolved against rewritten graph
         self.mesh = self._make_mesh()
         if self._param_pspecs is None and self.config.tensor_parallelism_degree > 1:
             from .parallel.tp import apply_tensor_parallel
 
             apply_tensor_parallel(self.graph, self.config.tensor_parallelism_degree)
-        self._output_ref = output.ref if output is not None else TensorRef(
+        self._output_ref = out_ref if out_ref is not None else TensorRef(
             len(self.graph.nodes) - 1, 0
         )
 

@@ -70,6 +70,15 @@ def rebuild(
             out_specs = get_op(op_type).infer(in_specs, attrs)
         new = out.add_node(op_type, attrs, new_inputs, out_specs, name=node.name)
         id_map[node.id] = new.id
+    # Dropped nodes whose output was redirected leave a name alias so a
+    # compile output naming the fused-away op still resolves (chained
+    # rewrites compose through Graph.resolve_name).
+    out.name_aliases = dict(getattr(graph, "name_aliases", {}) or {})
+    for ref, target in redirect.items():
+        src = graph.nodes[ref.node_id]
+        if src.id in drop and target.node_id in id_map:
+            tgt = out.nodes[id_map[target.node_id]]
+            out.name_aliases[src.name] = (tgt.name, target.out_idx)
     return out
 
 

@@ -74,3 +74,34 @@ def test_no_trigger_no_recompile():
     x, y = _data()
     m.fit(x, y, verbose=False)
     assert m._recompile_state.recompilations == 0
+
+
+def test_recompile_preserves_mid_graph_output():
+    """A declared mid-graph output (metric tap follows it) must survive
+    recompile_on_condition — the recompile re-resolves it by NAME
+    instead of silently reverting to the final node."""
+    cfg = ff.FFConfig(batch_size=32, epochs=1, num_devices=1)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((32, 16), name="x")
+    t = m.dense(t, 32, activation="relu", name="d0")
+    t = m.dense(t, 4, name="d1")
+    out = m.softmax(t, name="sm")
+    m.exp(out, name="metric_tap")  # extra sink AFTER the output
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05), output=out)
+
+    fired = {}
+
+    def trigger(model):
+        return model._step_count >= 1 and not fired
+
+    def alter(model):
+        fired["yes"] = True
+
+    m.recompile_on_condition(trigger, alter)
+    x, y = _data()
+    m.fit(x, y, batch_size=32, verbose=False)
+    assert fired
+    # output still the softmax, NOT the metric tap
+    assert m.graph.nodes[m._output_ref.node_id].name == "sm"
+    probs = np.asarray(m.forward(x[:32]))
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-5)
