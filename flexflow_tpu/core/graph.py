@@ -70,23 +70,30 @@ class Graph:
         self.nodes: List[OpNode] = []
         self._sig_index: Dict[Tuple, int] = {}
         self._used_names: Dict[str, int] = {}
-        # Names of nodes DROPPED by substitution rewrites, mapped to the
-        # (surviving_name, out_idx) their output was redirected to —
-        # lets compile re-resolve an output whose op got fused away
-        # (chains resolve lazily via resolve_name).
-        self.name_aliases: Dict[str, Tuple[str, int]] = {}
+        # Rewrite redirect history: one dict PER REWRITE, chronological,
+        # each mapping (old_name, old_out_idx) -> the post-rewrite
+        # (name, out_idx) that value moved to. Covers dropped nodes
+        # (fused-away relu) and REPLACED survivors whose outputs changed
+        # meaning (merge_sibling_dense: old a.0 lives at the split's
+        # out 0). Generations matter: one rewrite's redirects are
+        # SIMULTANEOUS (old b.0 -> new b.1 must not re-apply to a value
+        # that just arrived at b.0), so resolution applies each dict at
+        # most once, in order.
+        self.name_aliases: List[Dict[Tuple[str, int], Tuple[str, int]]] = []
 
     def resolve_name(self, name: str, out_idx: int = 0):
-        """Follow rewrite aliases until a live node name; returns
-        (node, out_idx) or (None, out_idx) when unresolvable."""
-        live = {n.name: n for n in self.nodes}
-        seen = set()
-        while name not in live:
-            if name in seen or name not in self.name_aliases:
-                return None, out_idx
-            seen.add(name)
-            name, out_idx = self.name_aliases[name]
-        return live[name], out_idx
+        """Resolve where a pre-rewrite (name, out_idx) value lives now;
+        returns (node, out_idx) or (None, out_idx) when unresolvable.
+        getattr guard: graphs unpickled from strategy files saved before
+        this attribute existed lack it."""
+        generations = getattr(self, "name_aliases", None) or []
+        if isinstance(generations, dict):  # pre-generations format
+            generations = [generations]
+        for gen in generations:
+            if (name, out_idx) in gen:
+                name, out_idx = gen[(name, out_idx)]
+        node = next((n for n in self.nodes if n.name == name), None)
+        return node, out_idx
 
     def add_node(
         self,

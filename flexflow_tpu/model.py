@@ -862,10 +862,17 @@ class FFModel:
         self.metrics_names = tuple(metrics)
         if output is None and _output_name is not None:
             # recompile path: the Tensor handle is long stale — the
-            # declared output survives by NAME (+ rewrite aliases)
+            # declared output survives by NAME (+ rewrite aliases).
+            # Unresolvable = the alter() renamed it away: raising beats
+            # silently reverting to the final node (a metric tap).
             node, idx = self.graph.resolve_name(*_output_name)
-            if node is not None:
-                output = Tensor(self, TensorRef(node.id, idx))
+            if node is None:
+                raise ValueError(
+                    f"declared output {_output_name[0]!r} no longer "
+                    "resolves after the graph was altered; keep the "
+                    "output op's name stable across recompiles"
+                )
+            output = Tensor(self, TensorRef(node.id, idx))
         out_ref = output.ref if output is not None else None
         if auto_parallel or self.config.import_strategy_file:
             # rewrites re-number node ids; the search re-resolves the

@@ -70,15 +70,24 @@ def rebuild(
             out_specs = get_op(op_type).infer(in_specs, attrs)
         new = out.add_node(op_type, attrs, new_inputs, out_specs, name=node.name)
         id_map[node.id] = new.id
-    # Dropped nodes whose output was redirected leave a name alias so a
-    # compile output naming the fused-away op still resolves (chained
-    # rewrites compose through Graph.resolve_name).
-    out.name_aliases = dict(getattr(graph, "name_aliases", {}) or {})
+    # Every redirected output leaves a (name, out_idx) alias — dropped
+    # nodes (fused-away relu) AND replaced survivors whose outputs
+    # changed meaning (sibling-dense merge re-points a.0 to the split) —
+    # so a compile output declared before the rewrite still resolves.
+    # Appended as a NEW generation: this rewrite's redirects are
+    # simultaneous, later rewrites compose (Graph.resolve_name).
+    prior = getattr(graph, "name_aliases", None) or []
+    if isinstance(prior, dict):  # pre-generations format
+        prior = [prior]
+    out.name_aliases = list(prior)
+    gen = {}
     for ref, target in redirect.items():
-        src = graph.nodes[ref.node_id]
-        if src.id in drop and target.node_id in id_map:
+        if target.node_id in id_map:
+            src = graph.nodes[ref.node_id]
             tgt = out.nodes[id_map[target.node_id]]
-            out.name_aliases[src.name] = (tgt.name, target.out_idx)
+            gen[(src.name, ref.out_idx)] = (tgt.name, target.out_idx)
+    if gen:
+        out.name_aliases.append(gen)
     return out
 
 

@@ -549,3 +549,30 @@ def test_auto_parallel_output_fused_away_follows_alias():
     got = np.asarray(m.forward(x))
     assert got.shape == (8, 16)
     assert (got >= 0).all()  # the relu survived inside the fused dense
+
+
+def test_rewrite_aliases_track_sibling_merge_outputs():
+    """merge_sibling_dense re-points BOTH siblings' outputs (a.0 → the
+    split's out 0, b.0 → out 1); resolve_name must land each old name on
+    the right split slot, not the widened GEMM."""
+    cfg = ff.FFConfig(batch_size=8, num_devices=1)
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((8, 8), name="x")
+    m.dense(t, 6, use_bias=False, name="head_a")
+    m.dense(t, 10, use_bias=False, name="head_b")
+    g2 = _merge_sibling_dense(m.graph)
+    assert g2 is not None and "split" in [n.op_type for n in g2.nodes]
+    na, ia = g2.resolve_name("head_a", 0)
+    nb, ib = g2.resolve_name("head_b", 0)
+    assert na is not None and na.op_type == "split" and ia == 0
+    assert nb is not None and nb.op_type == "split" and ib == 1
+    assert na.out_specs[0].shape == (8, 6)
+    assert nb.out_specs[1].shape == (8, 10)
+    # a fused-away node (dense+relu drop) aliases too, and chains
+    m2 = ff.FFModel(cfg)
+    t = m2.create_tensor((8, 8), name="x")
+    t = m2.dense(t, 16, name="d0")
+    m2.relu(t, name="r0")
+    g3 = _fuse_dense_activation(m2.graph)
+    node, idx = g3.resolve_name("r0", 0)
+    assert node is not None and node.name == "d0" and idx == 0
