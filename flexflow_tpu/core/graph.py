@@ -81,15 +81,34 @@ class Graph:
         # most once, in order.
         self.name_aliases: List[Dict[Tuple[str, int], Tuple[str, int]]] = []
 
-    def resolve_name(self, name: str, out_idx: int = 0):
-        """Resolve where a pre-rewrite (name, out_idx) value lives now;
-        returns (node, out_idx) or (None, out_idx) when unresolvable.
-        getattr guard: graphs unpickled from strategy files saved before
-        this attribute existed lack it."""
+    def alias_generation(self) -> int:
+        """Number of rewrite generations recorded so far — coordinates
+        minted NOW are valid from this generation on (pass it back to
+        :meth:`resolve_name` as ``start_gen`` so later resolution skips
+        redirects that predate the coordinate)."""
+        return len(self._alias_generations())
+
+    def _alias_generations(self):
         generations = getattr(self, "name_aliases", None) or []
         if isinstance(generations, dict):  # pre-generations format
-            generations = [generations]
-        for gen in generations:
+            generations = [
+                {
+                    (k if isinstance(k, tuple) else (k, 0)): v
+                    for k, v in generations.items()
+                }
+            ]
+        return generations
+
+    def resolve_name(self, name: str, out_idx: int = 0, start_gen: int = 0):
+        """Resolve where a (name, out_idx) value minted at rewrite
+        generation ``start_gen`` lives now; returns (node, out_idx) or
+        (None, out_idx) when unresolvable. Generations BEFORE start_gen
+        are skipped — a post-rewrite coordinate must not be re-run
+        through the rewrite that minted it (e.g. the sibling-merge's
+        simultaneous b.0→b.1 redirect). getattr guard: graphs unpickled
+        from strategy files saved before this attribute existed lack
+        it; their bare-str keys mean out_idx 0."""
+        for gen in self._alias_generations()[start_gen:]:
             if (name, out_idx) in gen:
                 name, out_idx = gen[(name, out_idx)]
         node = next((n for n in self.nodes if n.name == name), None)

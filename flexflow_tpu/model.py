@@ -733,6 +733,9 @@ class FFModel:
             else None
         )
         out_idx = output.ref.out_idx if output is not None else 0
+        # the output coordinate is minted against the PRE-search graph:
+        # only rewrite generations from here on may redirect it
+        out_gen = self.graph.alias_generation()
         if cfgf.import_strategy_file:
             strategy = unity.ParallelStrategy.load(cfgf.import_strategy_file)
             if strategy.graph is not None:
@@ -803,7 +806,9 @@ class FFModel:
             return None
         # follow rewrite aliases: a fused-away output (e.g. relu folded
         # into dense) resolves to the node its value was redirected to
-        node, out_idx = self.graph.resolve_name(out_name, out_idx)
+        node, out_idx = self.graph.resolve_name(
+            out_name, out_idx, start_gen=out_gen
+        )
         if node is None:
             raise ValueError(
                 f"output node {out_name!r} was rewritten away by the "
@@ -840,7 +845,7 @@ class FFModel:
         comp_mode: str = TRAINING,
         output: Optional[Tensor] = None,
         auto_parallel: bool = False,
-        _output_name: Optional[Tuple[str, int]] = None,
+        _output_name: Optional[Tuple[str, int, int]] = None,
     ):
         """Lower the graph to jitted step functions (reference
         ``FFModel::compile``, model.cc:3314). With ``auto_parallel`` the
@@ -862,15 +867,18 @@ class FFModel:
         self.metrics_names = tuple(metrics)
         if output is None and _output_name is not None:
             # recompile path: the Tensor handle is long stale — the
-            # declared output survives by NAME (+ rewrite aliases).
+            # declared output survives by NAME (+ rewrite aliases from
+            # its minting generation on: re-running the rewrite that
+            # produced this coordinate would mis-redirect it).
             # Unresolvable = the alter() renamed it away: raising beats
             # silently reverting to the final node (a metric tap).
-            node, idx = self.graph.resolve_name(*_output_name)
+            o_name, o_idx, o_gen = _output_name
+            node, idx = self.graph.resolve_name(o_name, o_idx, o_gen)
             if node is None:
                 raise ValueError(
-                    f"declared output {_output_name[0]!r} no longer "
-                    "resolves after the graph was altered; keep the "
-                    "output op's name stable across recompiles"
+                    f"declared output {o_name!r} no longer resolves "
+                    "after the graph was altered; keep the output op's "
+                    "name stable across recompiles"
                 )
             output = Tensor(self, TensorRef(node.id, idx))
         out_ref = output.ref if output is not None else None
@@ -885,8 +893,14 @@ class FFModel:
             # a recompile alter) rewrites the graph; recompiles pass the
             # NAME and re-resolve against the current graph instead
             output=None,
+            # name + out_idx + the generation the coordinate is valid
+            # from (it refers to the CURRENT, post-search graph)
             _output_name=(
-                (self.graph.nodes[out_ref.node_id].name, out_ref.out_idx)
+                (
+                    self.graph.nodes[out_ref.node_id].name,
+                    out_ref.out_idx,
+                    self.graph.alias_generation(),
+                )
                 if out_ref is not None
                 else None
             ),

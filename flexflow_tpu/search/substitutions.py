@@ -77,8 +77,13 @@ def rebuild(
     # Appended as a NEW generation: this rewrite's redirects are
     # simultaneous, later rewrites compose (Graph.resolve_name).
     prior = getattr(graph, "name_aliases", None) or []
-    if isinstance(prior, dict):  # pre-generations format
-        prior = [prior]
+    if isinstance(prior, dict):  # pre-generations format (bare-str keys)
+        prior = [
+            {
+                (k if isinstance(k, tuple) else (k, 0)): v
+                for k, v in prior.items()
+            }
+        ]
     out.name_aliases = list(prior)
     gen = {}
     for ref, target in redirect.items():

@@ -91,6 +91,14 @@ def refine_strategy(
     simulator (graph.cc:1600 graph_cost memoisation + simulate).
     Monotone: never returns a worse event-sim cost than it was given."""
     best_cost = event_sim_cost(graph, strategy, cm)
+    # per-node memory is independent (strategy_memory_bytes is a plain
+    # sum), so a state flip updates the total in O(1) instead of a full
+    # O(nodes) resum per candidate
+    mem_terms = {
+        n.id: cm.op_memory_bytes(graph, n, strategy.choices.get(n.id, "DP"))
+        for n in graph.nodes
+    }
+    mem_total = sum(mem_terms.values())
     for _ in range(passes):
         improved = False
         for node in graph.nodes:
@@ -104,16 +112,17 @@ def refine_strategy(
             ):
                 if s == cur:
                     continue
-                strategy.choices[node.id] = s
+                new_term = cm.op_memory_bytes(graph, node, s)
                 if (
-                    budget_bytes != float("inf")
-                    and cm.strategy_memory_bytes(graph, strategy)
+                    mem_total - mem_terms[node.id] + new_term
                     > budget_bytes
                 ):
-                    strategy.choices[node.id] = cur
                     continue
+                strategy.choices[node.id] = s
                 c = event_sim_cost(graph, strategy, cm)
                 if c < best_cost * (1 - 1e-9):
+                    mem_total += new_term - mem_terms[node.id]
+                    mem_terms[node.id] = new_term
                     best_cost, cur, improved = c, s, True
                 else:
                     strategy.choices[node.id] = cur
@@ -244,7 +253,11 @@ def optimize(
     s_best = refine_strategy(
         g_best, s_best, cm_best, budget_bytes=memory_budget
     )
+    # refinement can shrink memory (or the winner was infeasible and a
+    # cheaper-AND-smaller flip landed it in budget): recompute BOTH the
+    # footprint and the feasibility verdict together
     mem = cm_best.strategy_memory_bytes(g_best, s_best)
+    feasible = mem <= memory_budget
     report = SearchReport(
         best_cost=s_best.estimated_step_time,
         machine=s_best.machine,

@@ -292,3 +292,49 @@ def test_param_state_executes_and_matches_dp():
             k = m.params["d0"]["kernel"]
             assert "data" in str(k.sharding.spec)
     assert losses["PARAM"] == pytest.approx(losses["DP"], rel=1e-5)
+
+
+def test_param_state_embedding_matches_dp():
+    """PARAM on an embedding table (rows sharded over data) must equal
+    the DP loss — the second op family that implements tp_shard='param'."""
+    import flexflow_tpu.search as search
+
+    def build():
+        cfg = ff.FFConfig(batch_size=8, num_devices=8)
+        m = ff.FFModel(cfg)
+        t = m.create_tensor((8, 4), dtype="int32", name="ids")
+        t = m.embedding(t, 64, 16, aggr="sum", name="emb")
+        t = m.dense(t, 4, name="head")
+        m.softmax(t, name="sm")
+        return m
+
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 64, size=(8, 4)).astype(np.int32)
+    y = rng.integers(0, 4, size=8).astype(np.int32)
+    losses = {}
+    for state in ("DP", "PARAM"):
+        m = build()
+        machine = MachineSpec(data=8, model=1)
+        strat = search.ParallelStrategy(
+            machine=machine,
+            choices={
+                n.id: (state if n.op_type == "embedding" else "DP")
+                for n in m.graph.nodes
+            },
+        )
+        strat.stamp(m.graph)
+        m._strategy = strat
+        m._param_pspecs = strat.weight_pspecs(m.graph)
+        m.config.data_parallelism_degree = 8
+        m.compile(optimizer=SGDOptimizer(lr=0.0), metrics=())
+        with jax.set_mesh(m.mesh):
+            batch = m._shard_batch({"ids": x})
+            yb = m._shard_batch({"y": y})["y"]
+            *_, loss, _mv = m._train_step(
+                m.params, m.opt_state, m.model_state,
+                jax.random.PRNGKey(0), batch, yb,
+            )
+            losses[state] = float(loss)
+        if state == "PARAM":
+            assert "data" in str(m.params["emb"]["table"].sharding.spec)
+    assert losses["PARAM"] == pytest.approx(losses["DP"], rel=1e-5)

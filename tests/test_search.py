@@ -568,6 +568,13 @@ def test_rewrite_aliases_track_sibling_merge_outputs():
     assert nb is not None and nb.op_type == "split" and ib == 1
     assert na.out_specs[0].shape == (8, 6)
     assert nb.out_specs[1].shape == (8, 10)
+    # a coordinate minted AFTER the rewrite must skip its generation:
+    # post-rewrite ('head_b', 0) IS the split's out 0 and must not be
+    # re-redirected to out 1 (the recompile-path bug)
+    n_post, i_post = g2.resolve_name(
+        "head_b", 0, start_gen=g2.alias_generation()
+    )
+    assert n_post.op_type == "split" and i_post == 0
     # a fused-away node (dense+relu drop) aliases too, and chains
     m2 = ff.FFModel(cfg)
     t = m2.create_tensor((8, 8), name="x")
