@@ -6,29 +6,36 @@ decode/verify kernels, alongside incremental decoding and the
 spec-vs-incremental LLM-step reduction (the comparison the reference's
 inference tests print, tests/inference/python_inference_tests.sh:57-123).
 Secondary: hand-sharded single-chip training MFU vs the 40% north star,
-and Unity-searched training MFU (compile(auto_parallel=True)).
+Unity-searched training MFU (compile(auto_parallel=True)), weight-only
+int8/int4 serving, and a true LLaMA-7B-shape int4 serving phase (the
+BASELINE.json headline model, inference/models/llama.cc:23 — int4
+weights ~3.5 GB fit the single 16 GB chip).
 
 Robustness contract (a bench that dies mid-run must still leave data):
-* every metric is printed the moment it is measured (flushed), cheapest
-  phase first, so a timeout or crash later loses only later phases;
-* the TPU backend is probed in a SUBPROCESS with retries before the
-  main process touches jax — backend init has been observed both to
-  raise UNAVAILABLE and to hang outright; on failure the bench falls
-  back to CPU (platform is recorded per metric, so a CPU number can
-  never masquerade as a TPU number);
-* each phase runs under a SIGALRM budget and an exception in one phase
-  never aborts the others;
+* the ORCHESTRATOR process never imports jax — backend init has been
+  observed to raise UNAVAILABLE and to hang outright (rounds 1/3/4), so
+  no backend failure can ever kill the whole bench;
+* the TPU backend is probed in a subprocess with long retries (the
+  tunnel flaps) — and probed even when JAX_PLATFORMS is preset, since
+  the container sitecustomize overrides the env var programmatically;
+* every phase runs in its OWN subprocess under a parent-enforced
+  timeout (kills wedged native compiles, which SIGALRM cannot); each
+  metric is printed/flushed the moment the child emits it, so a crash
+  or timeout later loses only later phases;
+* a phase child that fails on TPU is retried once on CPU (forced via
+  jax.config.update — the env var alone is ignored here); platform is
+  recorded per metric and a CPU retry can never overwrite a number
+  already measured on TPU;
 * the Pallas kernels are used only after an on-device parity phase
   proves they compile AND match the XLA path token-for-token; fallback
   to XLA is reported with the exception, never silent.
 
 Model: the largest LLaMA-family config that comfortably fits one 16 GB
-v5e chip in bf16 (~3.5 B params; the 7 B headline target needs the
-v5e-16 pod of BASELINE.json's north star). The draft model is a
-layer-skip self-draft (first K layers + shared embed/head) so the bench
-needs no external weights; on random weights it still yields a real
-~1.3-1.5x step reduction, and with trained weights the acceptance only
-improves.
+v5e chip in bf16 (~3.5 B params); the 7 B phase uses int4 weights. The
+draft model is a layer-skip self-draft (first K layers + shared
+embed/head) so the bench needs no external weights; on random weights
+it still yields a real step reduction, and with trained weights the
+acceptance only improves.
 
 vs_baseline for the headline compares SpecInfer tokens/sec/chip against
 an A100 running LLaMA-7B SpecInfer (~60 tok/s/device: the reference
@@ -36,12 +43,11 @@ reports 1.3-2.0x over ~30 tok/s incremental serving baselines,
 reference SERVE.md:10).
 """
 import argparse
-import contextlib
 import json
 import os
-import signal
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -67,85 +73,194 @@ def emit(metric, value, unit, vs_baseline=None, **detail):
     return line
 
 
-class PhaseTimeout(Exception):
-    pass
-
-
-@contextlib.contextmanager
-def _alarm(seconds):
-    """Best-effort phase budget. SIGALRM interrupts Python-level work;
-    a blocked native XLA compile only notices on return, so this bounds
-    the common hangs (retry loops, iteration) not a wedged compiler —
-    the driver's outer timeout plus incremental emission covers that."""
-
-    def handler(signum, frame):
-        raise PhaseTimeout(f"phase exceeded {seconds}s budget")
-
-    old = signal.signal(signal.SIGALRM, handler)
-    signal.alarm(int(seconds))
-    try:
-        yield
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
-
-
-def run_phase(name, budget_s, fn, *args, **kw):
-    t0 = time.perf_counter()
-    _log(f"phase {name} start (budget {budget_s}s)")
-    try:
-        with _alarm(budget_s):
-            out = fn(*args, **kw)
-        _log(f"phase {name} done in {time.perf_counter() - t0:.1f}s")
-        return out
-    except BaseException as e:  # noqa: BLE001 — bench must keep going
-        if isinstance(e, (KeyboardInterrupt, SystemExit)):
-            raise
-        _log(f"phase {name} FAILED after {time.perf_counter() - t0:.1f}s: {e!r}")
-        traceback.print_exc(file=sys.stderr)
-        return None
-
-
 # ----------------------------------------------------------------------
-# backend guard
+# orchestrator: probe + per-phase subprocesses (never imports jax)
 
 
-def _ensure_backend(probe_timeout=180, retries=2):
-    """Initialize the TPU backend in a subprocess first: jax.devices()
-    has been observed to raise UNAVAILABLE (rounds 1/3) or hang outright
-    when the tunnelled backend is down. Probing out-of-process lets us
-    time out a hang and drop to CPU so every metric still gets measured
-    (with platform honestly recorded as cpu)."""
+def _probe_backend(attempts=None, timeout=None):
+    """Out-of-process backend probe. Returns the platform a fresh child
+    will see ("tpu"/"cpu"). Long patience with backoff: the tunnelled
+    backend flaps — a failed attempt now can succeed two minutes later.
+    Runs even when JAX_PLATFORMS is preset: sitecustomize sets
+    jax_platforms programmatically, overriding the env var, so a preset
+    value says nothing about what a child process actually gets."""
+    attempts = attempts or int(os.environ.get("BENCH_PROBE_ATTEMPTS", "5"))
+    timeout = timeout or int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
     if os.environ.get("JAX_PLATFORMS"):
-        _log(f"JAX_PLATFORMS preset to {os.environ['JAX_PLATFORMS']!r}")
-        return
+        _log(f"JAX_PLATFORMS preset to {os.environ['JAX_PLATFORMS']!r} "
+             "(probing anyway — sitecustomize overrides it)")
     code = "import jax; print(jax.devices()[0].platform)"
-    for attempt in range(retries):
-        t0 = time.perf_counter()
+    for attempt in range(attempts):
+        t0 = time.monotonic()
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
-                capture_output=True,
-                text=True,
-                timeout=probe_timeout,
+                capture_output=True, text=True, timeout=timeout,
             )
         except subprocess.TimeoutExpired:
-            _log(f"backend probe {attempt}: hung >{probe_timeout}s")
+            _log(f"backend probe {attempt}: hung >{timeout}s")
             continue
-        dt = time.perf_counter() - t0
+        dt = time.monotonic() - t0
         plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "?"
-        if r.returncode == 0:
+        if r.returncode == 0 and plat in ("tpu", "cpu", "gpu"):
             _log(f"backend probe {attempt}: platform={plat} in {dt:.1f}s")
-            return
+            return plat
         err = r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ""
         _log(f"backend probe {attempt}: rc={r.returncode} in {dt:.1f}s: {err}")
-        time.sleep(15)
-    _log("TPU backend unavailable — falling back to CPU")
-    os.environ["JAX_PLATFORMS"] = "cpu"
+        time.sleep(min(15 * (attempt + 1), 60))
+    _log("TPU backend unavailable after all probes — using CPU")
+    return "cpu"
+
+
+def _record_child_line(line):
+    """Parse+relay one child stdout line. Metric lines are re-emitted on
+    the orchestrator's stdout and recorded for headline selection; a CPU
+    retry may never overwrite a metric already measured on TPU (both
+    lines still print — the record just keeps the TPU one)."""
+    try:
+        obj = json.loads(line)
+        assert isinstance(obj, dict) and "metric" in obj
+    except Exception:
+        print(line, file=sys.stderr, flush=True)
+        return
+    print(json.dumps(obj), flush=True)
+    name = obj["metric"]
+    prev = _RESULTS.get(name)
+    if prev is not None:
+        prev_plat = (prev.get("detail") or {}).get("platform")
+        new_plat = (obj.get("detail") or {}).get("platform")
+        if prev_plat == "tpu" and new_plat != "tpu":
+            _log(f"keeping TPU record for {name} over {new_plat} retry")
+            return
+    _RESULTS[name] = obj
+
+
+def _run_phase_child(phase, platform, kernels, budget_s):
+    """Run one phase in a subprocess, streaming its stdout. Returns the
+    child's rc (or -9 on parent-enforced timeout)."""
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--child", phase, "--platform", platform, "--kernels", kernels,
+    ]
+    _log(f"phase {phase} [{platform}] start (budget {budget_s}s)")
+    t0 = time.monotonic()
+    p = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=None, text=True, bufsize=1,
+    )
+
+    def reader():
+        for raw in p.stdout:
+            _record_child_line(raw.rstrip("\n"))
+
+    th = threading.Thread(target=reader, daemon=True)
+    th.start()
+    try:
+        rc = p.wait(timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        _log(f"phase {phase} [{platform}] exceeded {budget_s}s — killing")
+        p.kill()
+        p.wait()
+        rc = -9
+    th.join(5)
+    _log(f"phase {phase} [{platform}] rc={rc} in {time.monotonic() - t0:.1f}s")
+    return rc
+
+
+# (phase, tpu_budget_s, cpu_budget_s, needs_kernels, cpu_ok) —
+# needs_kernels phases depend on the parity gate's pallas/xla verdict
+_PHASES = [
+    ("train", 600, 300, False, True),
+    ("parity", 900, 300, False, True),
+    ("serve", 1800, 600, True, True),
+    ("serve_int8", 900, 400, True, True),
+    ("searched", 900, 400, False, True),
+    ("serve_int4", 900, 400, True, True),
+    # 7B-shape int4: only meaningful on the chip (13.5 GB-of-flops model
+    # on the 1-core CPU box would time out without informing anything)
+    ("serve_7b", 1500, 0, True, False),
+]
+_NEEDS_KERNELS = {p for p, _, _, nk, _ in _PHASES if nk}
+
+
+def orchestrate(which):
+    platform = _probe_backend()
+    kernels = "xla"
+    # A single requested serve phase still needs the parity gate first —
+    # otherwise it would silently measure the XLA path under the same
+    # metric name an --metric all run reports for Pallas.
+    wanted = {which} if which != "all" else {p for p, *_ in _PHASES}
+    if wanted & _NEEDS_KERNELS:
+        wanted.add("parity")
+    for phase, tpu_b, cpu_b, needs_kernels, cpu_ok in _PHASES:
+        if phase not in wanted:
+            continue
+        if platform != "tpu" and not cpu_ok:
+            _log(f"phase {phase}: skipped (needs TPU)")
+            continue
+        budget = tpu_b if platform == "tpu" else cpu_b
+        rc = _run_phase_child(phase, platform, kernels, budget)
+        if rc != 0 and platform == "tpu" and cpu_ok:
+            _log(f"phase {phase}: TPU child failed — one CPU retry")
+            _run_phase_child(phase, "cpu", kernels, cpu_b)
+        if phase == "parity":
+            # Pallas is enabled only by a parity PASS measured on the
+            # SAME platform the serve phases will run on: a CPU-retry
+            # pass (interpret mode) must not gate Mosaic kernels onto
+            # TPU serve children that never proved they compile.
+            rec = _RESULTS.get("pallas_kernel_parity", {})
+            ok = (rec.get("value") == 1.0
+                  and (rec.get("detail") or {}).get("platform") == platform)
+            kernels = "pallas" if ok else "xla"
+            if not ok:
+                _log("pallas parity did not pass on the serving platform"
+                     " — serve phases run kernels=xla")
+
+    # Derived: the int8-vs-fp uplift on the identical workload (the
+    # reference's --8bit-quantization claim, file_loader.cc:651).
+    fp = _RESULTS.get("incr_decode_tokens_per_sec_per_chip")
+    q8 = _RESULTS.get("incr_decode_tokens_per_sec_int8")
+    if fp and q8 and fp["value"]:
+        fp_plat = (fp.get("detail") or {}).get("platform")
+        q8_plat = (q8.get("detail") or {}).get("platform")
+        if fp_plat == q8_plat:
+            emit(
+                "int8_speedup_vs_fp",
+                round(q8["value"] / fp["value"], 3),
+                "ratio",
+                platform=fp_plat,
+            )
+
+    # Headline line LAST (the "one JSON line" the driver records):
+    # SpecInfer if measured, else the best metric that did land — but a
+    # metric measured on the real chip ALWAYS outranks a CPU-retry
+    # number, whatever its name (first pass: TPU-only; second: any).
+    order = (
+        "specinfer_tokens_per_sec_per_chip",
+        "incr_decode_tokens_per_sec_per_chip",
+        "specinfer_tokens_per_sec_7b_int4",
+        "incr_decode_tokens_per_sec_int8",
+        "unity_searched_train_mfu",
+        "llama_train_mfu",
+        "pallas_kernel_parity",
+    )
+    for tpu_only in (True, False):
+        for name in order:
+            rec = _RESULTS.get(name)
+            if rec is None:
+                continue
+            if tpu_only and (rec.get("detail") or {}).get("platform") != "tpu":
+                continue
+            print(json.dumps(rec), flush=True)
+            return
+    # Nothing landed at all — still print a parseable line.
+    print(json.dumps({
+        "metric": "bench_failed", "value": 0, "unit": "none",
+        "vs_baseline": 0,
+    }), flush=True)
 
 
 # ----------------------------------------------------------------------
-# model configs
+# model configs (child side)
 
 
 def _llm_cfg(on_tpu):
@@ -176,8 +291,15 @@ def _llm_cfg(on_tpu):
     )
 
 
+def _llm_cfg_7b():
+    """True LLaMA-7B shape (reference inference/models/llama.cc:23)."""
+    from flexflow_tpu.models import llama
+
+    return llama.LLaMAConfig.llama_7b()
+
+
 def _serve_workload(on_tpu):
-    """The ONE serving workload both the fp and int8 phases measure —
+    """The ONE serving workload the fp and quantized phases all measure —
     shared so their tokens/sec stay apples-to-apples."""
     cfg = _llm_cfg(on_tpu)
     n_new = 48 if on_tpu else 16
@@ -227,17 +349,77 @@ def _make_rm(model_mod, cfg, params, make_sc, prompts, kernels):
 
 def _layer_skip_draft(cfg, params, k):
     """First-k-layers self-draft (shares embed/norm/head) — no external
-    weights needed; LayerSkip-style speculation."""
+    weights needed; LayerSkip-style speculation. Handles quantized
+    {"q","scale"} layer leaves (both are stacked along the layer dim)."""
     import dataclasses
+
+    from flexflow_tpu.quantization import is_quantized
+
+    def take(v):
+        if is_quantized(v):
+            return {"q": v["q"][:k], "scale": v["scale"][:k]}
+        return v[:k]
 
     dcfg = dataclasses.replace(cfg, num_hidden_layers=k)
     dparams = dict(params)
-    dparams["layers"] = {n: v[:k] for n, v in params["layers"].items()}
+    dparams["layers"] = {n: take(v) for n, v in params["layers"].items()}
     return dcfg, dparams
 
 
+def _random_quantized_params(cfg, bits, seed=0):
+    """Directly materialize a quantized param tree WITHOUT ever holding
+    the dense fp weights (a 7B bf16 tree is ~13.5 GB — quantizing it on
+    a 16 GB chip would OOM). Layer matmul kernels become random packed
+    codes + constant scales; embeddings/norms/head init dense as usual
+    from per-leaf shapes. Numerically arbitrary (bench uses random
+    weights anyway) but byte- and layout-exact vs quantize_params."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.quantization import _leaf_names
+
+    key = jax.random.PRNGKey(seed)
+    shapes = jax.eval_shape(lambda k: llama.init_params(k, cfg), key)
+    qnames = set(_leaf_names({
+        n: v for n, v in shapes["layers"].items()
+    }))
+
+    leaves, treedef = jax.tree.flatten_with_path(shapes)
+
+    def build(path, sds, k):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        in_layers = any(
+            getattr(p, "key", None) == "layers" for p in path[:-1]
+        )
+        if in_layers and name in qnames:
+            L, In, Out = sds.shape
+            # generate at the storage dtype directly — an int32 staging
+            # array for a 7B leaf is a multi-GB transient this function
+            # exists to avoid
+            if bits == 8:
+                q = jax.random.randint(k, (L, In, Out), -127, 128, jnp.int8)
+            else:
+                q = jax.random.randint(
+                    k, (L, In // 2, Out), 0, 256, jnp.uint8
+                )
+            scale = jnp.full((L, 1, Out), 0.02 / max(1, In) ** 0.5,
+                             jnp.float32)
+            return {"q": q, "scale": scale}
+        if jnp.issubdtype(sds.dtype, jnp.integer):
+            return jnp.zeros(sds.shape, sds.dtype)
+        return (jax.random.normal(k, sds.shape, jnp.float32) * 0.02
+                ).astype(sds.dtype)
+
+    ks = jax.random.split(key, len(leaves))
+    return jax.tree.unflatten(
+        treedef,
+        [build(path, sds, k) for (path, sds), k in zip(leaves, ks)],
+    )
+
+
 # ----------------------------------------------------------------------
-# phases
+# phases (each runs in its own child process)
 
 
 def train_bench(on_tpu):
@@ -312,8 +494,6 @@ def searched_train_bench(on_tpu):
 
     try:
         res = bench_search.searched_train_mfu(on_tpu)
-    except PhaseTimeout:
-        raise  # the budget is spent — retrying would run unbounded
     except Exception as e:
         if not on_tpu:
             raise
@@ -466,12 +646,12 @@ def serve_bench(on_tpu, kernels):
     return spec_tps
 
 
-def serve_int8_bench(on_tpu, kernels):
-    """Weight-only int8 serving (reference --8bit-quantization,
-    file_loader.cc:651 + decompress kernels): decode is bandwidth-bound
-    on the params read, so int8 weights should ~2x tokens/sec/chip —
-    the beyond-parity headline when measured on chip. Same workload as
-    serve_bench (shared _serve_workload) so fp vs int8 is one variable."""
+def serve_quantized_bench(on_tpu, kernels, bits):
+    """Weight-only int8/int4 serving (reference --8bit/4bit-quantization,
+    file_loader.cc:651,710 + decompress kernels): decode is
+    bandwidth-bound on the params read, so int8 weights should ~2x
+    tokens/sec/chip. Same workload as serve_bench (shared
+    _serve_workload) so fp vs quantized is one variable."""
     import jax
 
     from flexflow_tpu.models import llama
@@ -479,7 +659,8 @@ def serve_int8_bench(on_tpu, kernels):
 
     cfg, prompts, n_new, n_req, make_sc = _serve_workload(on_tpu)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    qparams = quantize_params(params, bits=8)
+    qparams = quantize_params(params, bits=bits)
+    del params
     rm, kernels = _make_rm(llama, cfg, qparams, make_sc, prompts, kernels)
     t0 = time.perf_counter()
     outs = rm.generate(prompts, max_new_tokens=n_new)
@@ -487,16 +668,95 @@ def serve_int8_bench(on_tpu, kernels):
     tokens = sum(len(o.output_tokens) for o in outs)
     tps = tokens / dt
     emit(
-        "incr_decode_tokens_per_sec_int8",
+        f"incr_decode_tokens_per_sec_int{bits}",
         round(tps, 2),
         "tokens/sec/chip",
         vs_baseline=tps / A100_INCR_TOKS_PER_SEC,
         kernels=kernels,
-        quantization="int8",
+        quantization=f"int{bits}",
         model_params_b=round(llama.num_params(cfg) / 1e9, 3),
         platform=_platform(),
     )
     return tps
+
+
+def serve_7b_bench(on_tpu, kernels):
+    """True LLaMA-7B-shape serving on one chip via int4 weights
+    (~3.5 GB) — the BASELINE.json headline model
+    (reference inference/models/llama.cc:23). Weights are materialized
+    directly in quantized form (a dense 7B bf16 tree would not leave
+    room to quantize on-chip). Emits incremental first, then SpecInfer
+    with the layer-skip draft."""
+    import jax
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import (
+        InferenceEngine, RequestManager, SpecConfig, SpecInferManager,
+        ServingConfig,
+    )
+
+    cfg = _llm_cfg_7b()
+    qparams = _random_quantized_params(cfg, bits=4)
+    n_new, n_req, prompt_len = 48, 4, 64
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_req)
+    ]
+
+    def make_sc(kern):
+        return ServingConfig(
+            max_requests_per_batch=n_req,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=32,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kern,
+        )
+
+    rm, kernels = _make_rm(llama, cfg, qparams, make_sc, prompts, kernels)
+    t0 = time.perf_counter()
+    outs = rm.generate(prompts, max_new_tokens=n_new)
+    dt = time.perf_counter() - t0
+    tokens = sum(len(o.output_tokens) for o in outs)
+    incr_steps = sum(o.profile.llm_decoding_steps for o in outs)
+    incr_tps = tokens / dt
+    emit(
+        "incr_decode_tokens_per_sec_7b_int4",
+        round(incr_tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=incr_tps / A100_INCR_TOKS_PER_SEC,
+        kernels=kernels,
+        quantization="int4",
+        model="llama-7b-shape",
+        platform=_platform(),
+    )
+
+    dcfg, dparams = _layer_skip_draft(cfg, qparams, 2)
+    spec = SpecConfig(beam_width=2, beam_depth=3)
+    mgr = SpecInferManager(
+        rm.engine, InferenceEngine(llama, dcfg, dparams, make_sc(kernels)),
+        spec,
+    )
+    mgr.generate(prompts, max_new_tokens=4)
+    t0 = time.perf_counter()
+    outs = mgr.generate(prompts, max_new_tokens=n_new)
+    spec_dt = time.perf_counter() - t0
+    spec_tokens = sum(len(o.output_tokens) for o in outs)
+    spec_steps = sum(o.profile.llm_decoding_steps for o in outs)
+    spec_tps = spec_tokens / spec_dt
+    emit(
+        "specinfer_tokens_per_sec_7b_int4",
+        round(spec_tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=spec_tps / A100_SPECINFER_TOKS_PER_SEC,
+        kernels=kernels,
+        quantization="int4",
+        model="llama-7b-shape",
+        spec_step_reduction=round(incr_steps / max(1, spec_steps), 3),
+        incr_tokens_per_sec=round(incr_tps, 2),
+        platform=_platform(),
+    )
+    return spec_tps
 
 
 def _platform():
@@ -505,78 +765,61 @@ def _platform():
     return jax.devices()[0].platform
 
 
+# ----------------------------------------------------------------------
+# child entry
+
+
+def child_main(phase, platform, kernels):
+    import jax
+
+    if platform == "cpu":
+        # sitecustomize sets jax_platforms programmatically, overriding
+        # the env var — the config API is the only reliable override.
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:
+        _log(f"child backend init failed ({e!r}) — forcing CPU")
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    _log(f"child {phase}: backend {dev.platform}")
+    if phase == "train":
+        train_bench(on_tpu)
+    elif phase == "searched":
+        searched_train_bench(on_tpu)
+    elif phase == "parity":
+        kernel_parity(on_tpu)
+    elif phase == "serve":
+        serve_bench(on_tpu, kernels)
+    elif phase == "serve_int8":
+        serve_quantized_bench(on_tpu, kernels, bits=8)
+    elif phase == "serve_int4":
+        serve_quantized_bench(on_tpu, kernels, bits=4)
+    elif phase == "serve_7b":
+        serve_7b_bench(on_tpu, kernels)
+    else:
+        raise SystemExit(f"unknown phase {phase}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--metric",
         default="all",
-        choices=["all", "train", "searched", "parity", "serve", "serve_int8"],
-        help="run a single phase (default: all, cheapest first)",
+        choices=["all", "train", "searched", "parity", "serve",
+                 "serve_int8", "serve_int4", "serve_7b"],
+        help="run a single phase (default: all, insurance-first order)",
     )
+    ap.add_argument("--child", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--platform", default="cpu", help=argparse.SUPPRESS)
+    ap.add_argument("--kernels", default="xla", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
-    _ensure_backend()
-    import jax
-
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # The container sitecustomize sets jax_platforms
-        # programmatically, which overrides the env var — force the
-        # fallback through the config API too (same as tests/conftest).
-        jax.config.update("jax_platforms", "cpu")
-
-    t0 = time.perf_counter()
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    _log(f"backend up: {dev.platform} ({time.perf_counter() - t0:.1f}s)")
-
-    if args.metric in ("all", "train"):
-        run_phase("train", 420 if on_tpu else 180, train_bench, on_tpu)
-    if args.metric in ("all", "searched"):
-        run_phase(
-            "searched_train", 420 if on_tpu else 240, searched_train_bench,
-            on_tpu,
-        )
-    kernels = "xla"
-    if args.metric in ("all", "parity", "serve", "serve_int8"):
-        ok = run_phase("kernel_parity", 300 if on_tpu else 180,
-                       kernel_parity, on_tpu)
-        kernels = "pallas" if ok else "xla"
-        if not ok:
-            _log("pallas parity failed — serve phase will run kernels=xla")
-    if args.metric in ("all", "serve"):
-        run_phase("serve", 1500 if on_tpu else 400, serve_bench, on_tpu,
-                  kernels)
-    if args.metric in ("all", "serve_int8"):
-        # beyond-parity extra: runs LAST so it can never cost the
-        # fp-serving headline its window
-        run_phase("serve_int8", 600 if on_tpu else 300, serve_int8_bench,
-                  on_tpu, kernels)
-
-    # Headline line LAST (the "one JSON line" the driver records):
-    # SpecInfer if measured, else the best metric that did land.
-    for name in (
-        "specinfer_tokens_per_sec_per_chip",
-        "incr_decode_tokens_per_sec_per_chip",
-        "incr_decode_tokens_per_sec_int8",
-        "unity_searched_train_mfu",
-        "llama_train_mfu",
-        "pallas_kernel_parity",
-    ):
-        if name in _RESULTS:
-            print(json.dumps(_RESULTS[name]), flush=True)
-            return
-    # Nothing landed at all — still print a parseable line.
-    print(
-        json.dumps(
-            {
-                "metric": "bench_failed",
-                "value": 0,
-                "unit": "none",
-                "vs_baseline": 0,
-            }
-        ),
-        flush=True,
-    )
+    if args.child:
+        child_main(args.child, args.platform, args.kernels)
+        return
+    orchestrate(args.metric)
 
 
 if __name__ == "__main__":

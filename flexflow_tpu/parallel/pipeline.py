@@ -145,6 +145,17 @@ def make_pipelined_serve(
         R = h.shape[0]  # local slots (data axis is manual)
         M = num_microbatches or num_stages
         if R % M:
+            if num_microbatches:
+                # an EXPLICITLY requested schedule is being dropped —
+                # say so (the default M=num_stages case may degrade
+                # silently, same as the flash/SP fallbacks)
+                from ..logging_utils import get_logger
+
+                get_logger("serve").warning(
+                    "pipelined serve: requested num_microbatches=%d does"
+                    " not divide local slot count %d — falling back to"
+                    " M=1 (no overlap)", num_microbatches, R,
+                )
             M = 1
         G = R // M
         S = num_stages

@@ -72,7 +72,17 @@ class TPUTopology:
     DCN (multi-slice). Mesh axes map onto ICI first (innermost axes) —
     matching ``core.mesh.AXIS_ORDER``'s convention that ``model`` rides
     the fastest links — and any axis marked in ``dcn_axes`` pays DCN
-    bandwidth instead."""
+    bandwidth instead.
+
+    ``torus`` is the slice's physical ICI torus shape (e.g. ``(4, 4)``
+    for v5e-16): a real slice is a 2-D/3-D torus with two links per
+    dimension (one per direction), not a single 1-D ring, so a
+    collective over an axis laid out on the torus stripes over several
+    links at once — the analog of the reference's multi-link
+    ``nic_persocket``/routing model (machine_config_example:22,
+    machine_model.cc). When ``torus`` is unset the model stays the
+    conservative single-ring formula. ``axis_links`` pins an explicit
+    per-axis link multiplicity, overriding the torus derivation."""
 
     chip: TPUChip
     num_chips: int = 1
@@ -80,12 +90,113 @@ class TPUTopology:
     dcn_axes: tuple = ()            # mesh axes that cross slice boundaries
     per_hop_latency: float = 1e-6   # ICI hop latency (s)
     dcn_latency: float = 10e-6
+    torus: tuple = ()               # physical ICI torus shape, innermost first
+    axis_links: Optional[Dict[str, int]] = None
 
     def axis_bandwidth(self, axis: str) -> float:
         return self.dcn_bandwidth if axis in self.dcn_axes else self.chip.ici_bandwidth
 
     def axis_latency(self, axis: str) -> float:
         return self.dcn_latency if axis in self.dcn_axes else self.per_hop_latency
+
+    def axis_link_multiplicity(self, axis: str, degree: int = 0) -> int:
+        """How many ICI links a ring collective over ``axis`` can stripe
+        across. DCN axes get 1 (one NIC path). On a physical torus, an
+        axis covering k torus dimensions rides 2k links (bidirectional
+        ring per dimension): a model-axis all-reduce on a v5e 4x4 slice
+        is ~2x the single-ring estimate, and a whole-slice axis ~4x."""
+        if axis in self.dcn_axes:
+            return 1
+        if self.axis_links and axis in self.axis_links:
+            return max(1, int(self.axis_links[axis]))
+        if self.torus and degree > 1:
+            covered, dims = 1, 0
+            for d in self.torus:
+                if covered >= degree:
+                    break
+                covered *= d
+                dims += 1
+            return 2 * max(1, dims)
+        return 1
+
+    @classmethod
+    def from_file(cls, path: str) -> "TPUTopology":
+        """Parse a user-editable machine config (the TPU analog of the
+        reference's ``machine_config_example`` + ``--machine-model-file``,
+        machine_model.cc:1-1287). ``key = value`` lines, ``#`` comments:
+
+            chip = v5e            # preset: v5e | v5p | v4 | custom
+            num_chips = 16
+            torus = 4x4           # physical ICI torus shape
+            dcn_axes = data       # comma-separated mesh axes over DCN
+            # optional overrides of the chip preset / topology numbers:
+            ici_bandwidth = 45e9
+            mxu_efficiency = 0.55
+            ...
+        """
+        kv: Dict[str, str] = {}
+        with open(path) as f:
+            for raw in f:
+                line = raw.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                if "=" not in line:
+                    raise ValueError(f"bad machine-config line: {raw!r}")
+                k, v = (s.strip() for s in line.split("=", 1))
+                kv[k.lower()] = v
+
+        presets = {"v5e": TPUChip.v5e, "v5p": TPUChip.v5p, "v4": TPUChip.v4}
+        chip_name = kv.pop("chip", "v5e").lower()
+        if chip_name in presets:
+            chip = presets[chip_name]()
+        elif chip_name == "custom":
+            chip = TPUChip(
+                name="custom", bf16_flops=0.0, hbm_bandwidth=0.0,
+                hbm_capacity=0.0, ici_bandwidth=0.0,
+            )
+        else:
+            raise ValueError(f"unknown chip preset {chip_name!r}")
+        chip_fields = {f.name for f in dataclasses.fields(TPUChip)} - {"name"}
+        chip_over = {
+            k: float(kv.pop(k)) for k in list(kv) if k in chip_fields
+        }
+        if chip_over:
+            chip = dataclasses.replace(chip, **chip_over)
+        if chip_name == "custom":
+            # fail at parse time, next to the file — not with a
+            # ZeroDivisionError deep inside the search roofline
+            missing = [
+                k for k in ("bf16_flops", "hbm_bandwidth", "hbm_capacity",
+                            "ici_bandwidth")
+                if getattr(chip, k) <= 0
+            ]
+            if missing:
+                raise ValueError(
+                    f"chip = custom requires positive values for {missing}"
+                )
+
+        topo_kw: Dict[str, object] = {"chip": chip}
+        if "num_chips" in kv:
+            topo_kw["num_chips"] = int(float(kv.pop("num_chips")))
+        if "torus" in kv:
+            topo_kw["torus"] = tuple(
+                int(x) for x in kv.pop("torus").lower().split("x")
+            )
+        if "dcn_axes" in kv:
+            topo_kw["dcn_axes"] = tuple(
+                a.strip() for a in kv.pop("dcn_axes").split(",") if a.strip()
+            )
+        for k in ("dcn_bandwidth", "per_hop_latency", "dcn_latency"):
+            if k in kv:
+                topo_kw[k] = float(kv.pop(k))
+        if kv:
+            raise ValueError(f"unknown machine-config keys: {sorted(kv)}")
+        topo = cls(**topo_kw)
+        if topo.torus and math.prod(topo.torus) != topo.num_chips:
+            raise ValueError(
+                f"torus {topo.torus} does not cover num_chips={topo.num_chips}"
+            )
+        return topo
 
 
 class CollectiveModel:
@@ -103,7 +214,11 @@ class CollectiveModel:
     def _ring(self, bytes_total: float, degree: int, axis: str, factor: float) -> float:
         if degree <= 1 or bytes_total <= 0:
             return 0.0
-        bw = self.topo.axis_bandwidth(axis)
+        # stripe over every ICI link the axis's torus layout provides
+        # (2 per covered torus dim); 1 when no torus info is available
+        bw = self.topo.axis_bandwidth(axis) * self.topo.axis_link_multiplicity(
+            axis, degree
+        )
         lat = self.topo.axis_latency(axis) * (degree - 1)
         return factor * (degree - 1) / degree * bytes_total / bw + lat
 

@@ -198,6 +198,7 @@ class CostModel:
         ):
             div *= self.machine.expert
         t = None
+        measured_state = False
         if self.measured:
             mult = 3.0 if self.training else 1.0
             shapes = tuple(s.shape for s in in_specs)
@@ -208,12 +209,22 @@ class CostModel:
             base_key = (node.op_type, node.attrs, shapes, "REP")
             if state_key in self.measured:
                 t = self.measured[state_key] * mult
+                # a per-state measurement taken on real multi-device
+                # hardware already includes the state's internal
+                # collectives — adding _internal_comm_cost on top would
+                # double-count them (calibrate() only writes REP keys,
+                # but externally supplied measured dicts carry state keys)
+                measured_state = True
             elif base_key in self.measured:
                 t = self.measured[base_key] * mult / div
         if t is None:
             t = compute_time(self.topo.chip, flops / div, bytes_moved / div)
-        # single-device measurements never include the multi-device
-        # collectives a sharded state implies — always price them on top
+        if measured_state:
+            # a state-keyed end-to-end measurement already paid ALL of
+            # its state's collectives — internal comm AND PARAM gathers
+            return t
+        # single-device estimates never include the multi-device
+        # collectives a sharded state implies — price them on top
         t += self._internal_comm_cost(node, in_specs, state)
         if state == "PARAM" and self.machine.data > 1:
             # ZeRO-style weight all-gathers: one per forward and — since
@@ -397,7 +408,19 @@ class CostModel:
 
     def measure_op(self, graph: Graph, node: OpNode, state: str, iters: int = 5):
         """Time the op's jitted forward on the current default device and
-        memoize. Used to calibrate the analytic model on real hardware."""
+        memoize. Used to calibrate the analytic model on real hardware.
+
+        Only ``state="REP"`` may be measured here: this times the
+        UNSHARDED forward on one device, and op_cost scales REP entries
+        by the shard division and prices collectives analytically on
+        top. Non-REP keys in ``measured`` are reserved for externally
+        supplied END-TO-END per-device times (real multi-device runs,
+        collectives included) — op_cost uses those verbatim."""
+        assert state == "REP", (
+            "measure_op times an unsharded single-device forward; "
+            f"storing it under state {state!r} would be misread as an "
+            "end-to-end sharded measurement (see op_cost)"
+        )
         import jax
         import jax.numpy as jnp
 

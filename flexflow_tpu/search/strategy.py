@@ -83,10 +83,21 @@ class _GraphUnpickler(pickle.Unpickler):
         ("builtins", "bytearray"),
     }
 
+    # Closed list of initializer class names Graph attrs can actually
+    # contain — NOT issubclass(Initializer): pickle REDUCE invokes the
+    # resolved class's constructor with attacker-controlled args, so a
+    # future initializer subclass with side effects (file/RNG/device
+    # access) must not silently join the attack surface.
+    _SAFE_INITIALIZERS = {
+        "Initializer", "GlorotUniform", "Zero", "Constant", "Uniform",
+        "Normal",
+    }
+
     def find_class(self, module, name):
         if (module, name) in self._SAFE:
             return super().find_class(module, name)
-        if module == "flexflow_tpu.initializers":
+        if (module == "flexflow_tpu.initializers"
+                and name in self._SAFE_INITIALIZERS):
             from .. import initializers as ffinit
 
             obj = getattr(ffinit, name, None)
@@ -214,7 +225,7 @@ class ParallelStrategy:
         colors = {
             "REP": "gray80", "DP": "lightblue", "TP_COL": "salmon",
             "TP_ROW": "orange", "TP_MEGATRON": "gold",
-            "SAMPLE": "palegreen", "ATTR": "plum",
+            "SAMPLE": "palegreen", "ATTR": "plum", "PARAM": "khaki",
         }
         lines = ["digraph strategy {", "  node [style=filled];"]
         for n in graph.nodes:

@@ -89,7 +89,11 @@ def refine_strategy(
     fan-out amortisation heuristic (placement_dp docstring) — the
     reference similarly refines DP placements against its full
     simulator (graph.cc:1600 graph_cost memoisation + simulate).
-    Monotone: never returns a worse event-sim cost than it was given."""
+    Monotone in time once feasible: never returns a worse event-sim
+    cost than it was given — except that an over-budget input first
+    gets a dedicated memory-descent pass (which may trade time for
+    footprint) so the budget can be met by multiple flips, not just
+    one."""
     best_cost = event_sim_cost(graph, strategy, cm)
     # per-node memory is independent (strategy_memory_bytes is a plain
     # sum), so a state flip updates the total in O(1) instead of a full
@@ -99,6 +103,32 @@ def refine_strategy(
         for n in graph.nodes
     }
     mem_total = sum(mem_terms.values())
+    if mem_total > budget_bytes:
+        # An over-budget winner cannot be rescued by the time-descent
+        # gate below (it would need a SINGLE flip to clear the whole
+        # overage): walk memory down first — take each node's
+        # smallest-footprint state until the budget is met, then let
+        # the time passes improve within budget.
+        for node in graph.nodes:
+            if mem_total <= budget_bytes:
+                break
+            cur = strategy.choices.get(node.id, "DP")
+            best_s, best_term = cur, mem_terms[node.id]
+            for s in candidate_states(
+                node,
+                cm.machine,
+                enable_sample=cm.enable_sample,
+                enable_attribute=cm.enable_attribute,
+                enable_parameter=cm.enable_parameter,
+            ):
+                t = cm.op_memory_bytes(graph, node, s)
+                if t < best_term:
+                    best_s, best_term = s, t
+            if best_s != cur:
+                strategy.choices[node.id] = best_s
+                mem_total += best_term - mem_terms[node.id]
+                mem_terms[node.id] = best_term
+        best_cost = event_sim_cost(graph, strategy, cm)
     for _ in range(passes):
         improved = False
         for node in graph.nodes:
