@@ -86,7 +86,13 @@ def searched_train_mfu(
     if attention_override is not None:
         attention = attention_override
 
-    cfg = FFConfig(batch_size=B, num_devices=1, search_budget=8)
+    # On the chip, swap the preset efficiency guesses for measured ones
+    # (machine_model.calibrate_chip) so validate_search judges the
+    # calibrated model, not the guesses.
+    cfg = FFConfig(
+        batch_size=B, num_devices=1, search_budget=8,
+        search_calibrate_chip=on_tpu,
+    )
     ff = build_searched_lm(
         vocab_size=V, hidden_size=D, intermediate_size=F, num_layers=L,
         num_heads=H, batch=B, seq=S, dtype=dt, attention=attention,
@@ -142,4 +148,12 @@ def searched_train_mfu(
         "search_predicted_ms": round(fidelity["predicted_s"] * 1e3, 3),
         "search_measured_ms": round(fidelity["measured_s"] * 1e3, 3),
         "attention": attention,
+        **(
+            {
+                "calibrated_mxu_eff": round(chip.mxu_efficiency, 3),
+                "calibrated_hbm_eff": round(chip.hbm_efficiency, 3),
+            }
+            if (chip := getattr(ff, "_calibrated_chip", None)) is not None
+            else {}
+        ),
     }

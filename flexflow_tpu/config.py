@@ -49,6 +49,14 @@ class FFConfig:
     # Calibrate the search cost model with on-device op timings
     # (reference inner_measure_operator_cost, model.cu:38).
     search_measured: bool = False
+    # Replace the chip preset's mxu/hbm efficiency guesses with measured
+    # roofline fractions (search.machine_model.calibrate_chip) before
+    # searching — the other half of the fidelity loop.
+    search_calibrate_chip: bool = False
+    # User-editable machine config for the search topology (reference
+    # --machine-model-file + machine_config_example); overrides the
+    # default v5e preset via TPUTopology.from_file.
+    machine_config_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     # extra declarative rewrite rules (reference --substitution-json)

@@ -25,7 +25,7 @@ from .layers import (
 )
 from .models import Model, Sequential
 from .optimizers import SGD, Adam
-from . import callbacks, datasets, preprocessing  # noqa: F401
+from . import callbacks, datasets, preprocessing, regularizers  # noqa: F401
 
 __all__ = [
     "Input", "Dense", "Conv2D", "MaxPooling2D", "AveragePooling2D",

@@ -11,6 +11,30 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 _counter = itertools.count()
 
+# keras initializer names → the names flexflow_tpu.initializers.resolve
+# understands (Initializer instances pass through untouched)
+_INIT_NAMES = {
+    "glorot_uniform": "glorot_uniform",
+    "zeros": "zeros",
+    "zero": "zero",
+    "random_normal": "normal",
+    "random_uniform": "uniform",
+    "normal": "normal",
+    "uniform": "uniform",
+}
+
+
+def _init_attr(init):
+    """Layer-kwarg initializer → op attr (string name or Initializer)."""
+    if init is None or not isinstance(init, str):
+        return init
+    try:
+        return _INIT_NAMES[init]
+    except KeyError:
+        raise ValueError(
+            f"unknown initializer {init!r}; known: {sorted(_INIT_NAMES)}"
+        ) from None
+
 
 class KTensor:
     """Symbolic tensor in the Keras graph (pre-FFModel)."""
@@ -55,23 +79,55 @@ class Layer:
 
 class Dense(Layer):
     def __init__(self, units: int, activation: Optional[str] = None,
-                 use_bias: bool = True, name: str = ""):
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform",
+                 bias_initializer="zeros",
+                 kernel_regularizer=None,
+                 bias_regularizer=None,
+                 activity_regularizer=None,
+                 name: str = ""):
+        # kernel knobs match the reference's Dense surface
+        # (python/flexflow/keras/layers/core.py:26-40); like the
+        # reference, only the kernel regularizer is supported
         super().__init__(name)
+        if bias_regularizer is not None or activity_regularizer is not None:
+            raise NotImplementedError(
+                "bias/activity regularizers are not supported (the "
+                "reference rejects them too)"
+            )
         self.units, self.activation, self.use_bias = units, activation, use_bias
+        self.kernel_initializer = _init_attr(kernel_initializer)
+        self.bias_initializer = _init_attr(bias_initializer)
+        from . import regularizers as _reg
+
+        self.kernel_regularizer = _reg.resolve(kernel_regularizer)
 
     def output_shape(self, s):
         return s[0][:-1] + (self.units,)
 
     def emit(self, ff, inputs):
         return ff.dense(inputs[0], self.units, activation=self.activation,
-                        use_bias=self.use_bias, name=self.name)
+                        use_bias=self.use_bias,
+                        kernel_initializer=self.kernel_initializer,
+                        bias_initializer=self.bias_initializer,
+                        kernel_regularizer=self.kernel_regularizer,
+                        name=self.name)
 
 
 class Conv2D(Layer):
     def __init__(self, filters: int, kernel_size, strides=(1, 1),
                  padding="valid", activation: Optional[str] = None,
-                 use_bias: bool = True, groups: int = 1, name: str = ""):
+                 use_bias: bool = True, groups: int = 1,
+                 kernel_initializer="glorot_uniform",
+                 bias_initializer="zeros",
+                 kernel_regularizer=None,
+                 name: str = ""):
         super().__init__(name)
+        self.kernel_initializer = _init_attr(kernel_initializer)
+        self.bias_initializer = _init_attr(bias_initializer)
+        from . import regularizers as _reg
+
+        self.kernel_regularizer = _reg.resolve(kernel_regularizer)
         self.filters = filters
         self.kernel = (kernel_size,) * 2 if isinstance(kernel_size, int) else tuple(kernel_size)
         self.strides = (strides,) * 2 if isinstance(strides, int) else tuple(strides)
@@ -102,7 +158,11 @@ class Conv2D(Layer):
         return ff.conv2d(inputs[0], self.filters, self.kernel[0], self.kernel[1],
                          self.strides[0], self.strides[1], ph, pw,
                          activation=self.activation, groups=self.groups,
-                         use_bias=self.use_bias, name=self.name)
+                         use_bias=self.use_bias,
+                         kernel_initializer=self.kernel_initializer,
+                         bias_initializer=self.bias_initializer,
+                         kernel_regularizer=self.kernel_regularizer,
+                         name=self.name)
 
 
 class _Pool2D(Layer):

@@ -1,0 +1,47 @@
+"""Keras-style weight regularizers (reference
+``python/flexflow/keras/regularizers.py`` L1/L2 → REG_MODE_L1/L2).
+
+A regularizer lowers to the ``("l1"|"l2", λ)`` attr that the dense/conv
+ops turn into an aux-loss term inside the jitted train step."""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Regularizer:
+    kind: str = ""
+    lam: float = 0.0
+
+    def to_attr(self):
+        return (self.kind, float(self.lam)) if self.kind else None
+
+
+class L1(Regularizer):
+    def __init__(self, l1: float = 0.01):
+        super().__init__(kind="l1", lam=l1)
+
+
+class L2(Regularizer):
+    def __init__(self, l2: float = 0.01):
+        super().__init__(kind="l2", lam=l2)
+
+
+def l1(l1: float = 0.01) -> L1:  # noqa: A001 — keras-compatible names
+    return L1(l1)
+
+
+def l2(l2: float = 0.01) -> L2:  # noqa: A001
+    return L2(l2)
+
+
+def resolve(reg):
+    """Regularizer | ("l1"/"l2", λ) | "l1"/"l2" | None → attr tuple."""
+    if reg is None:
+        return None
+    if isinstance(reg, Regularizer):
+        return reg.to_attr()
+    if isinstance(reg, str):
+        return (reg, 0.01)
+    kind, lam = reg
+    return (str(kind), float(lam))

@@ -130,8 +130,12 @@ class FFModel:
         use_bias: bool = True,
         kernel_initializer=None,
         bias_initializer=None,
+        kernel_regularizer=None,
         name: str = "",
     ) -> Tensor:
+        """``kernel_regularizer``: ``("l1"|"l2", lambda)`` — the penalty
+        joins the loss through the op aux-loss channel (reference
+        Linear + REG_MODE_L1/L2, keras/regularizers.py)."""
         return self._add(
             "dense",
             dict(
@@ -140,6 +144,9 @@ class FFModel:
                 use_bias=use_bias,
                 kernel_initializer=kernel_initializer,
                 bias_initializer=bias_initializer,
+                kernel_regularizer=(
+                    tuple(kernel_regularizer) if kernel_regularizer else None
+                ),
             ),
             [input],
             name,
@@ -238,6 +245,9 @@ class FFModel:
         activation: Optional[str] = None,
         groups: int = 1,
         use_bias: bool = True,
+        kernel_initializer=None,
+        bias_initializer=None,
+        kernel_regularizer=None,
         name: str = "",
     ) -> Tensor:
         return self._add(
@@ -253,6 +263,11 @@ class FFModel:
                 activation=activation,
                 groups=groups,
                 use_bias=use_bias,
+                kernel_initializer=kernel_initializer,
+                bias_initializer=bias_initializer,
+                kernel_regularizer=(
+                    tuple(kernel_regularizer) if kernel_regularizer else None
+                ),
             ),
             [input],
             name,
@@ -769,9 +784,36 @@ class FFModel:
                 extra_rules = load_substitutions_json(
                     cfgf.substitution_json_file
                 )
+            topo = None
+            if cfgf.machine_config_file:
+                from .search.machine_model import TPUTopology
+
+                topo = TPUTopology.from_file(cfgf.machine_config_file)
+                if topo.num_chips != cfgf.num_devices // fixed:
+                    raise ValueError(
+                        f"machine config {cfgf.machine_config_file!r} "
+                        f"describes {topo.num_chips} chips but the "
+                        f"search places over {cfgf.num_devices // fixed} "
+                        "devices (num_devices / fixed pipe*expert*seq "
+                        "degrees) — the cost model would rank against a "
+                        "machine that doesn't exist"
+                    )
+            if cfgf.search_calibrate_chip:
+                import dataclasses as _dc
+
+                from .search.machine_model import (
+                    TPUChip, TPUTopology, calibrate_chip,
+                )
+
+                topo = topo or TPUTopology(
+                    chip=TPUChip.v5e(), num_chips=cfgf.num_devices // fixed
+                )
+                topo = _dc.replace(topo, chip=calibrate_chip(topo.chip))
+                self._calibrated_chip = topo.chip
             graph2, strategy, report = unity.optimize(
                 self.graph,
                 cfgf.num_devices // fixed,
+                topo,
                 training=(comp_mode == TRAINING),
                 budget=budget,
                 alpha=cfgf.search_alpha,

@@ -676,6 +676,44 @@ def serve_step(
     return logits, {"k": k_new, "v": v_new}
 
 
+def serve_debug_activations(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    cache_positions: Optional[jnp.ndarray] = None,
+    *,
+    cfg: LLaMAConfig,
+    kernels: str = "xla",
+):
+    """Per-layer hidden-state capture for ``inference_debugging``
+    (reference's per-op tensor dump mode, serve/__init__.py:48 —
+    saving all inputs/outputs to file for serving triage). Runs the
+    layer stack as an eager Python loop instead of ``lax.scan`` so every
+    layer's output survives as its own array; cache writes are computed
+    and DISCARDED (the caller's donating step does the real commit).
+    Deliberately slow — a triage tool, not a serving path."""
+    if cache_positions is None:
+        cache_positions = positions
+    S1 = cache["k"].shape[2]
+    x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+    cos, sin = rope_freqs(cfg, positions)
+    if mask is None:
+        key_pos = jnp.arange(S1, dtype=jnp.int32)
+        mask = key_pos[None, None, :] <= positions[:, :, None]
+        mask = mask & (key_pos[None, None, :] < S1 - 1)
+    acts = []
+    for l in range(cfg.num_hidden_layers):
+        p_l = jax.tree.map(lambda a: a[l], params["layers"])
+        x, _, _ = serve_block(
+            cfg, p_l, x, cos, sin, mask,
+            cache["k"][l], cache["v"][l], cache_positions, kernels,
+        )
+        acts.append(x)
+    return acts
+
+
 def commit_kv(
     cache: Dict[str, jnp.ndarray],
     src: jnp.ndarray,  # (R, K) int32 cache lines to keep (tree node lines)

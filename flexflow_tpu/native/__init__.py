@@ -18,9 +18,29 @@ from typing import Optional
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD = os.path.join(_DIR, "_build")
 
+def _python_embed_flags():
+    """Compile/link flags to embed CPython (the serve C ABI needs
+    Python.h + libpython; no pybind11 in this image)."""
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    flags = [f"-I{inc}"]
+    if libdir:
+        flags += [f"-L{libdir}", f"-Wl,-rpath,{libdir}"]
+    flags += [f"-lpython{ver}"]
+    return flags
+
+
 _SOURCES = {
     "ffdata": ("dataloader.cpp", ["-pthread"]),
     "fftok": ("gpt_tokenizer.cpp", []),
+    # embeddable C serving ABI (reference flexflow_c.cc analog); flags
+    # resolved lazily so import never pays sysconfig
+    "ffserve": ("serve_c_api.cpp", _python_embed_flags),
 }
 
 _loaded = {}
@@ -32,6 +52,8 @@ def load_library(name: str) -> Optional[ctypes.CDLL]:
     if name in _loaded:
         return _loaded[name]
     src_name, extra = _SOURCES[name]
+    if callable(extra):
+        extra = extra()
     src = os.path.join(_DIR, src_name)
     out = os.path.join(_BUILD, f"lib{name}.so")
     try:
@@ -41,8 +63,10 @@ def load_library(name: str) -> Optional[ctypes.CDLL]:
         ):
             os.makedirs(_BUILD, exist_ok=True)
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", *extra,
-                 src, "-o", out],
+                # source before the extra flags: -l libraries must
+                # follow the objects that need them for GNU ld
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 src, *extra, "-o", out],
                 check=True,
                 capture_output=True,
                 text=True,

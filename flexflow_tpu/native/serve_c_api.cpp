@@ -1,0 +1,134 @@
+// Embeddable C serving ABI — the TPU analog of the reference's C API
+// surface (reference src/c/flexflow_c.cc:1-2680, flexflow_serve_*
+// handles). A non-Python host links this library (+ libpython) and
+// drives continuous-batching serving through five functions; the
+// implementation embeds CPython and forwards into
+// flexflow_tpu.serve.c_backend, whose RequestManager does the actual
+// scheduling. Handles are plain ints (request guids), matching the
+// reference's guid-based RequestManager API rather than its per-object
+// opaque structs.
+//
+// Thread-model: every entry point takes the GIL (PyGILState_Ensure),
+// so the ABI is safe to call from any single host thread at a time.
+#include <Python.h>
+
+#include <cstdint>
+
+namespace {
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject* backend() {
+  static PyObject* mod = nullptr;  // borrowed forever (module cache)
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("flexflow_tpu.serve.c_backend");
+    if (mod == nullptr) PyErr_Print();
+  }
+  return mod;
+}
+
+long call_long(const char* fn, PyObject* args /* stolen, may be null */) {
+  PyObject* m = backend();
+  if (m == nullptr) {
+    Py_XDECREF(args);
+    return -1;
+  }
+  PyObject* f = PyObject_GetAttrString(m, fn);
+  if (f == nullptr) {
+    PyErr_Print();
+    Py_XDECREF(args);
+    return -1;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) {
+    PyErr_Print();
+    return -1;
+  }
+  long v = PyLong_Check(r) ? PyLong_AsLong(r) : 0;
+  Py_DECREF(r);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Initialize the engine from a JSON config (see c_backend docstring).
+// Returns 0 on success, -1 on error. Safe to call from a host with or
+// without a live interpreter (Py_IsInitialized is checked).
+int ff_serve_init(const char* config_json) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Release the GIL acquired by initialization so the Gil guards
+    // below (and any host threads) can take it normally.
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", config_json ? config_json : "{}");
+  return static_cast<int>(call_long("init", args));
+}
+
+// Queue a prompt of n int32 tokens; max_new <= 0 uses the config
+// default. Returns the request id (>= 0) or -1.
+int ff_serve_register_request(const int32_t* tokens, int n, int max_new) {
+  Gil gil;
+  PyObject* lst = PyList_New(n);
+  if (lst == nullptr) return -1;
+  for (int i = 0; i < n; ++i) {
+    PyList_SET_ITEM(lst, i, PyLong_FromLong(tokens[i]));
+  }
+  PyObject* args = Py_BuildValue("(Ni)", lst, max_new);  // N steals lst
+  return static_cast<int>(call_long("register_request", args));
+}
+
+// One continuous-batching scheduling step (prefill chunk or decode
+// round across all admitted requests). Returns 1 while work remains,
+// 0 when drained, -1 on error.
+int ff_serve_step(void) {
+  Gil gil;
+  return static_cast<int>(call_long("step", nullptr));
+}
+
+// Number of registered-but-not-completed requests.
+int ff_serve_num_active(void) {
+  Gil gil;
+  return static_cast<int>(call_long("num_active", nullptr));
+}
+
+// Copy a completed request's output tokens into out (capacity cap).
+// Returns the token count (may exceed cap; only cap are written), or
+// -1 while the request is still running / unknown.
+int ff_serve_fetch(int request_id, int32_t* out, int cap) {
+  Gil gil;
+  PyObject* m = backend();
+  if (m == nullptr) return -1;
+  PyObject* r = PyObject_CallMethod(m, "fetch", "i", request_id);
+  if (r == nullptr) {
+    PyErr_Print();
+    return -1;
+  }
+  if (r == Py_None) {
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n && i < cap; ++i) {
+    out[i] = static_cast<int32_t>(PyLong_AsLong(PyList_GET_ITEM(r, i)));
+  }
+  Py_DECREF(r);
+  return static_cast<int>(n);
+}
+
+// Drop the engine and all request state. Returns 0.
+int ff_serve_shutdown(void) {
+  Gil gil;
+  return static_cast<int>(call_long("shutdown", nullptr));
+}
+
+}  // extern "C"

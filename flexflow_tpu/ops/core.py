@@ -54,6 +54,23 @@ def _wdt(weights, x):
     return jax.tree.map(lambda w: w.astype(x.dtype) if jnp.issubdtype(w.dtype, jnp.floating) else w, weights)
 
 
+def _maybe_regularize(kernel, attrs, ctx):
+    """Weight-decay penalty through the aux-loss channel (reference
+    Linear REG_MODE_L1/L2, keras/regularizers.py + metrics_functions
+    loss accumulation). attrs["kernel_regularizer"] = ("l1"|"l2", λ)."""
+    reg = attrs.get("kernel_regularizer")
+    if not reg or not ctx.training or ctx.state_updates is None:
+        return
+    kind, lam = reg
+    if kernel is None or lam <= 0.0:
+        return
+    w = kernel.astype(jnp.float32)
+    pen = lam * (
+        jnp.sum(jnp.abs(w)) if kind == "l1" else jnp.sum(w * w)
+    )
+    ctx.state_updates.setdefault("__aux__", []).append(pen)
+
+
 # ---------------------------------------------------------------------------
 # Placeholders
 
@@ -156,6 +173,7 @@ class DenseOp(OpDef):
         y = y.astype(x.dtype)
         if "bias" in w:
             y = y + w["bias"]
+        _maybe_regularize(weights.get("kernel"), attrs, ctx)
         return [_act(y, attrs.get("activation"))]
 
     def weight_pspecs(self, in_specs, attrs, model_axis):
@@ -313,6 +331,7 @@ class Conv2DOp(OpDef):
         ).astype(x.dtype)
         if "bias" in w:
             y = y + w["bias"][None, :, None, None]
+        _maybe_regularize(weights.get("kernel"), attrs, ctx)
         return [_act(y, attrs.get("activation"))]
 
     def flops(self, in_specs, attrs):

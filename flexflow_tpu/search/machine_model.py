@@ -247,3 +247,52 @@ def compute_time(chip: TPUChip, flops: float, bytes_moved: float) -> float:
     t_flops = flops / (chip.bf16_flops * chip.mxu_efficiency)
     t_mem = bytes_moved / (chip.hbm_bandwidth * chip.hbm_efficiency)
     return max(t_flops, t_mem)
+
+
+def calibrate_chip(chip: TPUChip, *, iters: int = 5) -> TPUChip:
+    """Replace the preset ``mxu_efficiency``/``hbm_efficiency`` guesses
+    with MEASURED achieved fractions on the current default device — the
+    closing of the cost-model fidelity loop the reference gets from
+    ``inner_measure_operator_cost`` re-measurement (model.cu:38,
+    graph.cc:2108). Two microbenchmarks:
+
+    * MXU: a big square bf16 matmul (n=4096; ~137 GFLOP) — achieved
+      FLOP/s over ``bf16_flops``;
+    * HBM: an elementwise stream over ~256 MB (read + write) — achieved
+      bytes/s over ``hbm_bandwidth``.
+
+    Results clamp to [0.05, 1.0]; on a CPU host this measures the CPU
+    (meaningless vs the TPU peaks) — callers gate on platform."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    n = 4096
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (n, n), jnp.bfloat16)
+    mm = jax.jit(lambda a, b: a @ b)
+    mm(a, b).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = mm(a, b)
+    out.block_until_ready()
+    t_mm = (time.perf_counter() - t0) / iters
+    mxu = (2.0 * n**3 / t_mm) / chip.bf16_flops
+
+    m = 128 * 1024 * 1024 // 2  # bf16 elements ≈ 256 MB buffer
+    x = jax.random.normal(jax.random.fold_in(key, 2), (m,), jnp.bfloat16)
+    stream = jax.jit(lambda x: x * 1.0009765625 + 1.0)
+    stream(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = stream(x)
+    y.block_until_ready()
+    t_st = (time.perf_counter() - t0) / iters
+    hbm = (2.0 * x.nbytes / t_st) / chip.hbm_bandwidth  # read + write
+
+    clamp = lambda v: float(min(1.0, max(0.05, v)))  # noqa: E731
+    return dataclasses.replace(
+        chip, mxu_efficiency=clamp(mxu), hbm_efficiency=clamp(hbm)
+    )

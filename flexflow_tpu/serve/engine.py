@@ -41,6 +41,13 @@ class ServingConfig:
     # step behind (the reference's 4-deep batch-future pipeline,
     # request_manager.cc:2310-2325).
     dispatch_ahead: int = 4
+    # Serving-triage dump directory (reference inference_debugging,
+    # serve/__init__.py:48 — per-op inputs/outputs saved to file): every
+    # engine step additionally runs an eager per-layer forward and
+    # writes each layer's hidden states + the step's tokens/positions as
+    # .npy. None = off; the FF_INFERENCE_DEBUGGING env var (a directory
+    # path) switches it on without touching code.
+    inference_debugging: Optional[str] = None
 
     @property
     def cache_len(self) -> int:
@@ -67,9 +74,18 @@ class InferenceEngine:
         serving: Optional[ServingConfig] = None,
         mesh: Optional[Mesh] = None,
     ):
+        import os
+
         self.model = model
         self.cfg = cfg
         self.serving = serving or ServingConfig()
+        if self.serving.inference_debugging is None:
+            self.serving = dataclasses.replace(
+                self.serving,
+                inference_debugging=os.environ.get("FF_INFERENCE_DEBUGGING")
+                or None,
+            )
+        self._debug_step = 0
         self.mesh = mesh or MachineSpec().make_mesh(jax.devices()[:1])
         self.params = params
         # Key: (chunk, all_logits, with_mask) for plain steps, or a
@@ -296,10 +312,51 @@ class InferenceEngine:
             )
         return toks, parents, logps
 
+    def _dump_debug(self, bc: BatchConfig):
+        """inference_debugging: eager per-layer forward on the CURRENT
+        cache (read-only — must run before the donating step), each
+        layer's hidden states to .npy (reference per-op tensor dumps)."""
+        import os
+
+        fn = getattr(self.model, "serve_debug_activations", None)
+        if fn is None:
+            return
+        # per-engine subdirectory: a SpecInfer pair (LLM + SSM engines)
+        # shares the dump dir, and both counters start at 0 — same-named
+        # files would silently overwrite across engines
+        outdir = os.path.join(
+            self.serving.inference_debugging,
+            f"{self.model.__name__.rsplit('.', 1)[-1]}-"
+            f"L{self.cfg.num_hidden_layers}-{id(self) & 0xFFFF:04x}",
+        )
+        os.makedirs(outdir, exist_ok=True)
+        acts = fn(
+            self.params, self.cache, jnp.asarray(bc.tokens),
+            jnp.asarray(bc.positions),
+            jnp.asarray(bc.mask) if bc.mask is not None else None,
+            jnp.asarray(bc.cache_positions)
+            if bc.cache_positions is not None else None,
+            cfg=self.cfg, kernels=self.serving.kernels,
+        )
+        step = self._debug_step
+        np.save(os.path.join(outdir, f"step{step:05d}_tokens.npy"),
+                np.asarray(bc.tokens))
+        np.save(os.path.join(outdir, f"step{step:05d}_positions.npy"),
+                np.asarray(bc.positions))
+        for l, h in enumerate(acts):
+            np.save(
+                os.path.join(outdir, f"step{step:05d}_layer{l:03d}.npy"),
+                np.asarray(jax.device_get(h)),
+            )
+        self._debug_step += 1
+
     def run(self, bc: BatchConfig, all_logits: bool = False):
         """Dispatch one step (reference ``InferenceManager::inference``,
         inference_manager.cc:334). Returns logits on device; the cache is
         advanced in place (donated)."""
+        if self.serving.inference_debugging:
+            with jax.set_mesh(self.mesh):
+                self._dump_debug(bc)
         with jax.set_mesh(self.mesh):
             step = self._get_step(bc.chunk, all_logits, bc.mask is not None)
             logits, self.cache = step(

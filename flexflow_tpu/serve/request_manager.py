@@ -72,6 +72,12 @@ class RequestManager:
         output_file: Optional[str] = None,
     ):
         self.engine = engine
+        if engine.serving.inference_debugging:
+            # the dump hook lives in engine.run(): the dispatch-ahead
+            # fused decode pipeline bypasses it, so debugging forces
+            # every step through the sync path (triage mode is allowed
+            # to be slow — the reference's inference_debugging is too)
+            self.supports_fast_decode = False
         self.tokenizer = tokenizer
         self.eos_token_id = eos_token_id
         # Per-request telemetry sink (reference -output-file,

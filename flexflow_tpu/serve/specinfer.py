@@ -51,6 +51,11 @@ class TokenTree:
         self.parents: List[int] = [-1]
         self.depths: List[int] = [0]
         self.logprobs: List[float] = [0.0]
+        # O(1) dedup + child lookup: at reference scale (64 requests x
+        # 64-token trees, request_manager.h MAX_NUM_REQUESTS) the per-
+        # insert linear scan was O(n^2) per speculation round
+        self._index: dict = {}
+        self._children: List[List[int]] = [[]]
 
     def __len__(self) -> int:
         return len(self.tokens)
@@ -59,17 +64,38 @@ class TokenTree:
         """Add a child; duplicate (parent, token) pairs are merged (the
         analog of the reference's merge_dfs_trees dedup). Returns
         (node index, is_new)."""
-        for i, (p, t) in enumerate(zip(self.parents, self.tokens)):
-            if p == parent and t == int(token):
-                return i, False
+        key = (int(parent), int(token))
+        hit = self._index.get(key)
+        if hit is not None:
+            return hit, False
         self.tokens.append(int(token))
         self.parents.append(int(parent))
         self.depths.append(self.depths[parent] + 1)
         self.logprobs.append(float(logprob))
-        return len(self.tokens) - 1, True
+        idx = len(self.tokens) - 1
+        self._index[key] = idx
+        self._children.append([])
+        self._children[parent].append(idx)
+        return idx, True
+
+    def append_raw(self, token: int, parent: int, depth: int,
+                   logprob: float) -> int:
+        """Append WITHOUT dedup — the device-side growth has a fixed
+        (D, W) node layout where duplicate (parent, token) pairs are
+        legitimate (dedup happens later in merge_trees). Maintains the
+        child lists accept_greedy walks."""
+        self.tokens.append(int(token))
+        self.parents.append(int(parent))
+        self.depths.append(int(depth))
+        self.logprobs.append(float(logprob))
+        idx = len(self.tokens) - 1
+        self._index.setdefault((int(parent), int(token)), idx)
+        self._children.append([])
+        self._children[parent].append(idx)
+        return idx
 
     def children(self, node: int) -> List[int]:
-        return [i for i, p in enumerate(self.parents) if p == node]
+        return self._children[node]
 
     def ancestor_matrix(self) -> np.ndarray:
         """anc[i, j] = node j is an ancestor of i or i itself — the causal
@@ -260,12 +286,12 @@ class SpecInferManager(RequestManager):
             tree = TokenTree(int(root[s]))
             for d in range(D):
                 for w in range(W):
-                    tree.tokens.append(int(toks[d, s, w]))
-                    tree.parents.append(
-                        0 if d == 0 else 1 + (d - 1) * W + int(parents[d, s, w])
+                    tree.append_raw(
+                        int(toks[d, s, w]),
+                        0 if d == 0 else 1 + (d - 1) * W + int(parents[d, s, w]),
+                        d + 1,
+                        float(logps[d, s, w]),
                     )
-                    tree.depths.append(d + 1)
-                    tree.logprobs.append(float(logps[d, s, w]))
             trees[req.request_id] = tree
             req.profile.ssm_decoding_steps += D
         return trees

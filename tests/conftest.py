@@ -24,8 +24,10 @@ jax.config.update("jax_enable_x64", False)
 def pytest_addoption(parser):
     # Compile cost dominates the suite on the 1-core CPU box; a full run
     # exceeds a 10-minute window. `--shard i/n` deterministically
-    # partitions tests so N parallel/short invocations cover everything:
-    #   pytest tests/ -q --shard 1/2   &&   pytest tests/ -q --shard 2/2
+    # partitions tests so N short invocations cover everything. THREE
+    # shards keep each run well under 8 minutes on this box (r5 log:
+    # 1/3 = 6:24, 2/3 ≈ 6-7 min, 3/3 ≈ 6-7 min):
+    #   for i in 1 2 3; do pytest tests/ -q --shard $i/3; done
     parser.addoption(
         "--shard", default=None,
         help="deterministic test sharding as i/n (1-based)",

@@ -1,0 +1,84 @@
+"""inference_debugging dump switch (reference serve/__init__.py:48 —
+per-op inputs/outputs saved to file for serving triage)."""
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import (
+    InferenceEngine,
+    RequestManager,
+    ServingConfig,
+)
+
+
+def _tiny():
+    cfg = llama.LLaMAConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, dtype=jnp.float32,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_debug_dump_writes_per_layer_activations(tmp_path):
+    cfg, params = _tiny()
+    outdir = str(tmp_path / "dumps")
+    sc = ServingConfig(
+        max_requests_per_batch=2, max_sequence_length=32, prefill_chunk=4,
+        max_spec_tree_tokens=8, cache_dtype=jnp.float32,
+        inference_debugging=outdir,
+    )
+    rm = RequestManager(InferenceEngine(llama, cfg, params, sc))
+    outs = rm.generate([[3, 17, 91, 42]], max_new_tokens=3)
+    assert len(outs[0].output_tokens) == 3
+
+    # dumps land in a per-engine subdirectory (a SpecInfer LLM+SSM pair
+    # sharing the dir must not overwrite each other)
+    steps = sorted(glob.glob(os.path.join(outdir, "*", "step*_tokens.npy")))
+    assert len(steps) >= 2  # at least prefill + decode steps
+    # every step dumps all 3 layers + tokens + positions
+    layer_files = sorted(
+        glob.glob(os.path.join(outdir, "*", "step00000_layer*.npy"))
+    )
+    assert len(layer_files) == cfg.num_hidden_layers
+    h = np.load(layer_files[0])
+    assert h.shape[-1] == cfg.hidden_size
+    toks = np.load(steps[0])
+    assert toks.dtype == np.int32 or toks.dtype == np.int64
+
+
+def test_debug_dump_matches_real_step_tokens(tmp_path):
+    """Debugging must observe, not perturb: tokens with the switch on
+    match tokens with it off."""
+    cfg, params = _tiny()
+
+    def gen(dump):
+        sc = ServingConfig(
+            max_requests_per_batch=2, max_sequence_length=32,
+            prefill_chunk=4, max_spec_tree_tokens=8,
+            cache_dtype=jnp.float32, inference_debugging=dump,
+        )
+        rm = RequestManager(InferenceEngine(llama, cfg, params, sc))
+        return [o.output_tokens for o in rm.generate(
+            [[5, 9, 88], [3, 17, 91, 42]], max_new_tokens=4
+        )]
+
+    assert gen(None) == gen(str(tmp_path / "d"))
+
+
+def test_env_var_switch(tmp_path, monkeypatch):
+    outdir = str(tmp_path / "envdumps")
+    monkeypatch.setenv("FF_INFERENCE_DEBUGGING", outdir)
+    cfg, params = _tiny()
+    sc = ServingConfig(
+        max_requests_per_batch=1, max_sequence_length=32, prefill_chunk=4,
+        max_spec_tree_tokens=8, cache_dtype=jnp.float32,
+    )
+    rm = RequestManager(InferenceEngine(llama, cfg, params, sc))
+    rm.generate([[1, 2, 3]], max_new_tokens=2)
+    assert glob.glob(os.path.join(outdir, "*", "step*_layer*.npy"))

@@ -143,3 +143,73 @@ class TestPreprocessing:
         (xt, yt), _ = keras.datasets.reuters.load_data(num_words=200)
         x = keras.preprocessing.pad_sequences(xt[:128], maxlen=50)
         assert x.shape == (128, 50)
+
+
+class TestLayerKnobs:
+    """Initializer-string / regularizer parity with the reference's layer
+    surface (reference python/flexflow/keras/layers/core.py:26-40 +
+    keras/regularizers.py L1/L2)."""
+
+    def test_zeros_kernel_initializer_gives_zero_logits(self):
+        inp = keras.Input(shape=(16,))
+        out = keras.Dense(4, kernel_initializer="zeros",
+                          use_bias=False)(inp)
+        m = keras.Model(inp, out, batch_size=8)
+        m.compile(loss="sparse_categorical_crossentropy")
+        x = np.ones((8, 16), np.float32)
+        np.testing.assert_allclose(np.asarray(m.predict(x)), 0.0)
+
+    def test_unknown_initializer_rejected(self):
+        with pytest.raises(ValueError, match="unknown initializer"):
+            keras.Dense(4, kernel_initializer="he_normal")
+
+    def test_unsupported_regularizers_rejected(self):
+        with pytest.raises(NotImplementedError):
+            keras.Dense(4, bias_regularizer=keras.regularizers.L2(0.1))
+
+    def test_l2_regularizer_raises_training_loss_and_shrinks_weights(self):
+        """The penalty must actually join the loss AND its gradient must
+        reach the kernel (weight decay), not just inflate the metric."""
+        x, y = _blob_data(n=64)
+
+        def build(reg):
+            inp = keras.Input(shape=(16,))
+            h = keras.Dense(32, activation="relu",
+                            kernel_regularizer=reg, name="reg_dense")(inp)
+            out = keras.Activation("softmax")(keras.Dense(4)(h))
+            m = keras.Model(inp, out, batch_size=32)
+            m.compile(loss="sparse_categorical_crossentropy")
+            return m
+
+        plain = build(None)
+        reg = build(keras.regularizers.L2(0.05))
+        h_plain = plain.fit(x, y, epochs=1, verbose=False)
+        h_reg = reg.fit(x, y, epochs=1, verbose=False)
+        # same seed → same init; the regularized loss carries the Σw² term
+        assert h_reg.history["loss"][0] > h_plain.history["loss"][0]
+        w_plain = plain.ffmodel.get_weights("reg_dense")["kernel"]
+        w_reg = reg.ffmodel.get_weights("reg_dense")["kernel"]
+        assert float(np.sum(w_reg**2)) < float(np.sum(w_plain**2))
+
+    def test_l1_penalty_value_in_graph_mode(self):
+        """Exact penalty: zero-init kernel + L1 on a one-step fit keeps
+        the penalty 0; constant kernel gives λ·Σ|w| — checked through
+        FFModel directly for a closed-form assertion."""
+        import flexflow_tpu as ff
+        from flexflow_tpu.initializers import Constant
+
+        cfg = ff.FFConfig(batch_size=4, num_devices=1)
+        m = ff.FFModel(cfg)
+        t = m.create_tensor((4, 8), name="x")
+        t = m.dense(t, 2, use_bias=False,
+                    kernel_initializer=Constant(0.5),
+                    kernel_regularizer=("l1", 0.1))
+        m.softmax(t)
+        m.compile(optimizer=ff.SGDOptimizer(lr=0.0),
+                  loss_type="sparse_categorical_crossentropy")
+        x = np.zeros((4, 8), np.float32)
+        y = np.zeros((4,), np.int32)
+        perf = m.fit(x, y, epochs=1, verbose=False)
+        # zero inputs → logits 0 → CE = log(2); penalty = 0.1 * 8*2*0.5
+        expected = np.log(2.0) + 0.1 * 8 * 2 * 0.5
+        assert abs(perf.averages()["loss"] - expected) < 1e-3

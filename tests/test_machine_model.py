@@ -121,3 +121,42 @@ def test_search_accepts_file_loaded_topology(tmp_path):
     g2, strat, report = optimize(m.graph, num_devices=8, topo=topo, budget=4)
     assert report.best_cost > 0
     assert strat.machine.num_devices == 8
+
+
+def test_calibrate_chip_measures_and_clamps():
+    """calibrate_chip must return measured efficiencies in (0, 1] —
+    on this CPU host the fractions-of-TPU-peak are tiny, so they clamp
+    to the 0.05 floor, proving the measurement actually ran."""
+    from flexflow_tpu.search.machine_model import calibrate_chip
+
+    chip = TPUChip.v5e()
+    cal = calibrate_chip(chip, iters=1)
+    assert 0.05 <= cal.mxu_efficiency <= 1.0
+    assert 0.05 <= cal.hbm_efficiency <= 1.0
+    # presets elsewhere untouched
+    assert cal.bf16_flops == chip.bf16_flops
+
+
+def test_compile_uses_machine_config_file(tmp_path):
+    """FFConfig.machine_config_file must reach the Unity search
+    (reference --machine-model-file end to end)."""
+    import numpy as np
+
+    import flexflow_tpu as ff
+
+    p = tmp_path / "machine.cfg"
+    p.write_text("chip = v5e\nnum_chips = 8\ntorus = 4x2\n")
+    cfg = ff.FFConfig(
+        batch_size=8, num_devices=8, search_budget=2,
+        machine_config_file=str(p),
+    )
+    m = ff.FFModel(cfg)
+    t = m.create_tensor((8, 16), name="x")
+    t = m.dense(t, 32, activation="relu")
+    t = m.dense(t, 4)
+    m.softmax(t)
+    m.compile(optimizer=ff.SGDOptimizer(lr=0.05), auto_parallel=True)
+    x = np.random.default_rng(0).normal(size=(16, 16)).astype(np.float32)
+    y = np.random.default_rng(0).integers(0, 4, size=(16,)).astype(np.int32)
+    m.fit(x, y, epochs=1, verbose=False)
+    assert m._search_report is not None
