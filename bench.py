@@ -167,17 +167,21 @@ def _run_phase_child(phase, platform, kernels, budget_s):
 
 
 # (phase, tpu_budget_s, cpu_budget_s, needs_kernels, cpu_ok) —
-# needs_kernels phases depend on the parity gate's pallas/xla verdict
+# needs_kernels phases depend on the parity gate's pallas/xla verdict.
+# Budgets are deliberately tight-ish: the driver's OUTER timeout is
+# unknown, and one wedged phase must not starve the phases behind it —
+# the headline-bearing train/parity/serve prefix totals ~37 min worst
+# case.
 _PHASES = [
-    ("train", 600, 300, False, True),
-    ("parity", 900, 300, False, True),
-    ("serve", 1800, 600, True, True),
-    ("serve_int8", 900, 400, True, True),
-    ("searched", 900, 400, False, True),
-    ("serve_int4", 900, 400, True, True),
+    ("train", 420, 300, False, True),
+    ("parity", 600, 300, False, True),
+    ("serve", 1200, 600, True, True),
+    ("serve_int8", 600, 400, True, True),
+    ("searched", 700, 400, False, True),
+    ("serve_int4", 600, 400, True, True),
     # 7B-shape int4: only meaningful on the chip (13.5 GB-of-flops model
     # on the 1-core CPU box would time out without informing anything)
-    ("serve_7b", 1500, 0, True, False),
+    ("serve_7b", 900, 0, True, False),
 ]
 _NEEDS_KERNELS = {p for p, _, _, nk, _ in _PHASES if nk}
 

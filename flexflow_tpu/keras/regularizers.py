@@ -36,12 +36,20 @@ def l2(l2: float = 0.01) -> L2:  # noqa: A001
 
 
 def resolve(reg):
-    """Regularizer | ("l1"/"l2", λ) | "l1"/"l2" | None → attr tuple."""
+    """Regularizer | ("l1"/"l2", λ) | "l1"/"l2" | None → attr tuple.
+    Unknown kinds raise here, next to the user's layer call — not as a
+    silently-wrong penalty deep in the train step."""
     if reg is None:
         return None
     if isinstance(reg, Regularizer):
-        return reg.to_attr()
-    if isinstance(reg, str):
-        return (reg, 0.01)
-    kind, lam = reg
-    return (str(kind), float(lam))
+        out = reg.to_attr()
+    elif isinstance(reg, str):
+        out = (reg.lower(), 0.01)
+    else:
+        kind, lam = reg
+        out = (str(kind).lower(), float(lam))
+    if out is not None and out[0] not in ("l1", "l2"):
+        raise ValueError(
+            f"unknown regularizer kind {out[0]!r}; supported: l1, l2"
+        )
+    return out

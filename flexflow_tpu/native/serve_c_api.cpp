@@ -57,6 +57,13 @@ long call_long(const char* fn, PyObject* args /* stolen, may be null */) {
 
 }  // namespace
 
+// Out-of-order calls (step before init, or after finalization) must
+// return the documented -1, not hit PyGILState_Ensure's fatal abort.
+#define FF_REQUIRE_PY() \
+  do {                  \
+    if (!Py_IsInitialized()) return -1; \
+  } while (0)
+
 extern "C" {
 
 // Initialize the engine from a JSON config (see c_backend docstring).
@@ -77,6 +84,7 @@ int ff_serve_init(const char* config_json) {
 // Queue a prompt of n int32 tokens; max_new <= 0 uses the config
 // default. Returns the request id (>= 0) or -1.
 int ff_serve_register_request(const int32_t* tokens, int n, int max_new) {
+  FF_REQUIRE_PY();
   Gil gil;
   PyObject* lst = PyList_New(n);
   if (lst == nullptr) return -1;
@@ -91,12 +99,14 @@ int ff_serve_register_request(const int32_t* tokens, int n, int max_new) {
 // round across all admitted requests). Returns 1 while work remains,
 // 0 when drained, -1 on error.
 int ff_serve_step(void) {
+  FF_REQUIRE_PY();
   Gil gil;
   return static_cast<int>(call_long("step", nullptr));
 }
 
 // Number of registered-but-not-completed requests.
 int ff_serve_num_active(void) {
+  FF_REQUIRE_PY();
   Gil gil;
   return static_cast<int>(call_long("num_active", nullptr));
 }
@@ -105,6 +115,7 @@ int ff_serve_num_active(void) {
 // Returns the token count (may exceed cap; only cap are written), or
 // -1 while the request is still running / unknown.
 int ff_serve_fetch(int request_id, int32_t* out, int cap) {
+  FF_REQUIRE_PY();
   Gil gil;
   PyObject* m = backend();
   if (m == nullptr) return -1;
@@ -127,6 +138,7 @@ int ff_serve_fetch(int request_id, int32_t* out, int cap) {
 
 // Drop the engine and all request state. Returns 0.
 int ff_serve_shutdown(void) {
+  if (!Py_IsInitialized()) return 0;  // nothing to drop
   Gil gil;
   return static_cast<int>(call_long("shutdown", nullptr));
 }

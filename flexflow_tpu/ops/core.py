@@ -62,6 +62,9 @@ def _maybe_regularize(kernel, attrs, ctx):
     if not reg or not ctx.training or ctx.state_updates is None:
         return
     kind, lam = reg
+    if kind not in ("l1", "l2"):
+        # trace-time guard: a typo'd kind must not silently become L2
+        raise ValueError(f"unknown regularizer kind {kind!r} (l1|l2)")
     if kernel is None or lam <= 0.0:
         return
     w = kernel.astype(jnp.float32)

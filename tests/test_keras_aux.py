@@ -213,3 +213,11 @@ class TestLayerKnobs:
         # zero inputs → logits 0 → CE = log(2); penalty = 0.1 * 8*2*0.5
         expected = np.log(2.0) + 0.1 * 8 * 2 * 0.5
         assert abs(perf.averages()["loss"] - expected) < 1e-3
+
+    def test_unknown_regularizer_kind_rejected(self):
+        with pytest.raises(ValueError, match="regularizer kind"):
+            keras.Dense(4, kernel_regularizer=("l3", 0.5))
+        # keras-style capitalization normalizes instead of silently
+        # becoming L2
+        d = keras.Dense(4, kernel_regularizer="L1")
+        assert d.kernel_regularizer == ("l1", 0.01)
