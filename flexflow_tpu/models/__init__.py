@@ -1,8 +1,8 @@
-from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, hf_utils
+from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, mixtral, hf_utils
 
 # Model-family registry (reference python/flexflow/serve/models/__init__.py
-# maps HF architectures to FlexFlow builders; qwen2 goes beyond the
-# reference's five-family zoo).
+# maps HF architectures to FlexFlow builders; qwen2 and mixtral go beyond
+# the reference's five-family zoo — mixtral adds sparse-MoE serving).
 FAMILIES = {
     "llama": llama,
     "opt": opt,
@@ -11,9 +11,11 @@ FAMILIES = {
     "starcoder": starcoder,
     "gpt_bigcode": starcoder,
     "qwen2": qwen2,
+    "mixtral": mixtral,
 }
 
 __all__ = [
     "llama", "transformer", "opt", "falcon", "mpt", "starcoder", "qwen2",
+    "mixtral",
     "hf_utils", "FAMILIES",
 ]

@@ -1,0 +1,174 @@
+"""Mixtral model family — sparse-MoE serving BEYOND the reference zoo
+(the reference serves dense decoders only, ``inference/models/*.cc``;
+its MoE support is the training-side expert ops). Runs on the generic
+decoder (:mod:`.transformer`) with ``num_local_experts`` > 0: a linear
+router takes the top-k experts per token (softmax over the selected k,
+HF ``MixtralSparseMoeBlock`` semantics), expert weights shard over the
+``expert`` mesh axis with Megatron TP inside each expert.
+
+Architecture = LLaMA attention (RoPE, GQA, RMSNorm, no biases) + the
+MoE FFN; weight conversion from HF ``MixtralForCausalLM``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import transformer
+from .transformer import (  # noqa: F401  (engine serving protocol)
+    DecoderConfig,
+    commit_kv,
+    forward,
+    init_kv_cache,
+    init_params,
+    kv_cache_pspecs,
+    num_params,
+    param_pspecs,
+    reorder_slots,
+    serve_step,
+)
+from .hf_utils import linear_w, stack, to_np
+
+
+def config(**kw) -> DecoderConfig:
+    d: Dict[str, Any] = dict(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        max_position_embeddings=4096,
+        norm_type="rmsnorm",
+        norm_bias=False,
+        norm_eps=1e-5,
+        positions="rope",
+        rope_theta=1e6,
+        activation="silu",
+        glu=True,
+        qkv_bias=False,
+        out_bias=False,
+        mlp_bias=False,
+        tie_word_embeddings=False,
+        num_local_experts=8,
+        num_experts_per_tok=2,
+    )
+    d.update(kw)
+    return DecoderConfig(**d)
+
+
+def mixtral_8x7b(**kw) -> DecoderConfig:
+    return config(**kw)
+
+
+def tiny(**kw) -> DecoderConfig:
+    d = dict(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+    )
+    d.update(kw)
+    return config(**d)
+
+
+def from_hf(hf: Dict[str, Any], **kw) -> DecoderConfig:
+    sw = hf.get("sliding_window")
+    if sw and sw < hf.get("max_position_embeddings", 1 << 30):
+        # the generic decoder runs full causal attention — silently
+        # loading a sliding-window checkpoint would diverge from HF
+        # beyond the window instead of erroring here (same guard as
+        # qwen2.from_hf)
+        raise NotImplementedError(
+            f"Mixtral sliding-window attention (sliding_window={sw}) is "
+            "not supported; load a full-attention checkpoint or set "
+            "sliding_window=null"
+        )
+    d = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_hidden_layers=hf["num_hidden_layers"],
+        num_attention_heads=hf["num_attention_heads"],
+        num_key_value_heads=hf.get(
+            "num_key_value_heads", hf["num_attention_heads"]
+        ),
+        max_position_embeddings=hf["max_position_embeddings"],
+        norm_eps=hf.get("rms_norm_eps", 1e-5),
+        rope_theta=hf.get("rope_theta", 1e6),
+        num_local_experts=hf.get("num_local_experts", 8),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+    )
+    d.update(kw)
+    return config(**d)
+
+
+def convert_hf_state_dict(
+    sd: Dict[str, Any], cfg: DecoderConfig
+) -> Dict[str, Any]:
+    """HF ``MixtralForCausalLM`` state dict → framework pytree. HF per-
+    expert names w1 (gate), w2 (down), w3 (up) map onto the generic
+    decoder's glu layout: w_gate ← w1, w_down ← w2, w_up ← w3, each
+    stacked (L, E, in, out)."""
+    dt = cfg.dtype
+    L, E = cfg.num_hidden_layers, cfg.num_local_experts
+    pre = "model."
+
+    def per_layer(fmt, conv):
+        return [conv(sd, pre + fmt.format(i)) for i in range(L)]
+
+    def experts(which):
+        return stack(
+            [
+                np.stack(
+                    [
+                        linear_w(
+                            sd,
+                            pre + f"layers.{i}.block_sparse_moe."
+                                  f"experts.{e}.{which}.weight",
+                        )
+                        for e in range(E)
+                    ],
+                    axis=0,
+                )
+                for i in range(L)
+            ],
+            dt,
+        )
+
+    layers = {
+        "attn_norm_scale": stack(
+            per_layer("layers.{}.input_layernorm.weight",
+                      lambda s, n: to_np(s[n])), dt
+        ),
+        "mlp_norm_scale": stack(
+            per_layer("layers.{}.post_attention_layernorm.weight",
+                      lambda s, n: to_np(s[n])), dt
+        ),
+        "wq": stack(per_layer("layers.{}.self_attn.q_proj.weight", linear_w), dt),
+        "wk": stack(per_layer("layers.{}.self_attn.k_proj.weight", linear_w), dt),
+        "wv": stack(per_layer("layers.{}.self_attn.v_proj.weight", linear_w), dt),
+        "wo": stack(per_layer("layers.{}.self_attn.o_proj.weight", linear_w), dt),
+        "w_router": stack(
+            per_layer("layers.{}.block_sparse_moe.gate.weight", linear_w), dt
+        ),
+        "w_gate": experts("w1"),
+        "w_up": experts("w3"),
+        "w_down": experts("w2"),
+    }
+    out: Dict[str, Any] = {
+        "embed": jnp.asarray(to_np(sd[pre + "embed_tokens.weight"]), dt),
+        "layers": layers,
+        "final_norm_scale": jnp.asarray(to_np(sd[pre + "norm.weight"]), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = jnp.asarray(to_np(sd["lm_head.weight"]).T, dt)
+    return out

@@ -36,7 +36,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..core.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+from ..core.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,7 +68,20 @@ class DecoderConfig:
     out_bias: bool = False
     mlp_bias: bool = False
     tie_word_embeddings: bool = True
+    # Mixture-of-experts FFN (Mixtral-style, HF MixtralSparseMoeBlock):
+    # 0 = dense FFN; E > 0 replaces the FFN with E experts and a linear
+    # router taking the top-k per token (softmax over the selected k).
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 2
     dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.num_local_experts and self.mlp_bias:
+            # the MoE FFN has no bias path — allocating dead b_up/b_down
+            # params would silently diverge from the configured arch
+            raise ValueError(
+                "mlp_bias is not supported with num_local_experts > 0"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -94,11 +113,17 @@ def _norm(cfg: DecoderConfig, x, scale, bias):
     return y
 
 
-def _mm(x, w):
+def _dense_w(w, dtype):
+    """Resolve a possibly-quantized ({"q","scale"}) weight to dense."""
     if isinstance(w, dict):  # int8/int4 weight-only quantization
         from ..quantization import dequantize
 
-        w = dequantize(w, x.dtype)
+        return dequantize(w, dtype)
+    return w
+
+
+def _mm(x, w):
+    w = _dense_w(w, x.dtype)
     return jnp.matmul(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
 
 
@@ -162,9 +187,17 @@ def init_params(key, cfg: DecoderConfig) -> Dict[str, Any]:
         "wk": w(ks[1], (L, D, KV * dk)),
         "wv": w(ks[2], (L, D, KV * dk)),
         "wo": w(ks[3], (L, H * dk, D), std / math.sqrt(2 * L)),
-        "w_up": w(ks[4], (L, D, F)),
-        "w_down": w(ks[5], (L, F, D), std / math.sqrt(2 * L)),
     }
+    E = cfg.num_local_experts
+    if E:
+        # expert-stacked FFN + router (HF Mixtral block_sparse_moe):
+        # expert dim shards over the ``expert`` mesh axis
+        layers["w_router"] = w(jax.random.fold_in(ks[4], 1), (L, D, E))
+        layers["w_up"] = w(ks[4], (L, E, D, F))
+        layers["w_down"] = w(ks[5], (L, E, F, D), std / math.sqrt(2 * L))
+    else:
+        layers["w_up"] = w(ks[4], (L, D, F))
+        layers["w_down"] = w(ks[5], (L, F, D), std / math.sqrt(2 * L))
     if cfg.norm_bias:
         layers["attn_norm_bias"] = zeros((L, D))
     # Sequential blocks and Falcon-40B-style parallel blocks have a second
@@ -174,7 +207,9 @@ def init_params(key, cfg: DecoderConfig) -> Dict[str, Any]:
         if cfg.norm_bias:
             layers["mlp_norm_bias"] = zeros((L, D))
     if cfg.glu:
-        layers["w_gate"] = w(ks[6], (L, D, F))
+        layers["w_gate"] = w(
+            ks[6], (L, E, D, F) if E else (L, D, F)
+        )
     if cfg.qkv_bias:
         layers["bq"] = zeros((L, H * dk))
         layers["bk"] = zeros((L, KV * dk))
@@ -218,11 +253,20 @@ def param_pspecs(cfg: DecoderConfig, *, pipeline: bool = False) -> Dict[str, Any
         "wq": col(), "wk": col(), "wv": col(), "wo": row(),
         "w_up": col(), "w_down": row(),
     }
+    if cfg.num_local_experts:
+        # experts shard over the expert axis AND Megatron-TP inside each
+        # expert (HF Mixtral weights are per-expert dense matmuls)
+        layers["w_router"] = P(pp, None, None)
+        layers["w_up"] = P(pp, EXPERT_AXIS, None, MODEL_AXIS)
+        layers["w_down"] = P(pp, EXPERT_AXIS, MODEL_AXIS, None)
     opt_specs = {
         "attn_norm_bias": vec_rep(),
         "mlp_norm_scale": vec_rep(),
         "mlp_norm_bias": vec_rep(),
-        "w_gate": col(),
+        "w_gate": (
+            P(pp, EXPERT_AXIS, None, MODEL_AXIS)
+            if cfg.num_local_experts else col()
+        ),
         "bq": vec_col(), "bk": vec_col(), "bv": vec_col(),
         "bo": vec_rep(),
         "b_up": vec_col(), "b_gate": vec_col(), "b_down": vec_rep(),
@@ -293,7 +337,53 @@ def _project_qkv(cfg: DecoderConfig, p, h):
     )
 
 
+def _moe_ffn(cfg: DecoderConfig, p, h):
+    """Mixtral-style sparse-MoE FFN (HF ``MixtralSparseMoeBlock``):
+    linear router → top-k per token → softmax over the SELECTED k →
+    weighted sum of expert outputs.
+
+    TPU shape: experts are computed as one batched einsum over the
+    expert dim rather than gather/scatter per expert — at decode (a few
+    tokens per step) the all-expert compute is cheap and keeps the MXU
+    busy with one big contraction; the expert dim shards over the
+    ``expert`` mesh axis so each device computes only its expert range
+    and GSPMD inserts the combine reduction (the serving-time analog of
+    ops/moe.py's ExpertsOp range sharding). For E=8,K=2 this spends E/K
+    = 4x the FLOPs of perfect dispatch at prefill — acceptable until
+    a capacity-dispatch Pallas path is warranted."""
+    E, K = cfg.num_local_experts, cfg.num_experts_per_tok
+    router = jnp.matmul(
+        h.astype(jnp.float32), _dense_w(p["w_router"], jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # (B,S,E)
+    topv, topi = lax.top_k(router, K)
+    gate = jax.nn.softmax(topv, axis=-1)  # (B,S,K) over selected experts
+    combine = jnp.einsum(
+        "bsk,bske->bse", gate, jax.nn.one_hot(topi, E, dtype=jnp.float32)
+    )  # (B,S,E)
+    w_up = _dense_w(p["w_up"], h.dtype)
+    w_down = _dense_w(p["w_down"], h.dtype)
+    up = jnp.einsum(
+        "bsd,edf->bsef", h, w_up, preferred_element_type=jnp.float32
+    ).astype(h.dtype)
+    if cfg.glu:
+        gate_p = jnp.einsum(
+            "bsd,edf->bsef", h, _dense_w(p["w_gate"], h.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(h.dtype)
+        act = _activation(cfg, gate_p) * up
+    else:
+        act = _activation(cfg, up)
+    out_e = jnp.einsum(
+        "bsef,efd->bsed", act, w_down, preferred_element_type=jnp.float32
+    )
+    out = jnp.einsum("bsed,bse->bsd", out_e, combine)
+    return out.astype(h.dtype)
+
+
 def _ffn(cfg: DecoderConfig, p, h):
+    if cfg.num_local_experts:
+        return _moe_ffn(cfg, p, h)
     up = _mm(h, p["w_up"])
     if cfg.mlp_bias:
         up = up + p["b_up"]

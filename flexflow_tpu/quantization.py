@@ -82,11 +82,16 @@ def dequantize(qw: Dict[str, jnp.ndarray], dtype) -> jnp.ndarray:
 
 def _leaf_names(layers: Dict[str, Any]):
     """Names of quantizable stacked-layer weights: 3-D matmul kernels
-    (wq/wk/wv/wo/w1..w3/w_up/w_down/w_gate) — norms/biases stay dense,
-    matching the reference which quantizes Linear weights only."""
+    (wq/wk/wv/wo/w1..w3/w_up/w_down/w_gate) and 4-D expert-stacked MoE
+    kernels (L, E, in, out) — norms/biases stay dense, matching the
+    reference which quantizes Linear weights only. The MoE ROUTER stays
+    dense too: it is a tiny (L, D, E) matmul whose rounding would
+    perturb top-k expert selection — the worst accuracy/byte trade in
+    the model."""
     return [
         k for k, v in layers.items()
-        if k.startswith("w") and hasattr(v, "ndim") and v.ndim == 3
+        if k.startswith("w") and hasattr(v, "ndim") and v.ndim in (3, 4)
+        and k != "w_router"
     ]
 
 
@@ -110,8 +115,12 @@ def quantize_pspecs(
     layer_specs = dict(pspecs["layers"])
     for name in _leaf_names_from_quantized(params["layers"]):
         spec = layer_specs[name]
-        parts = list(spec) + [None] * (3 - len(spec))
-        scale_spec = P(parts[0], None, parts[2])
+        ndim = params["layers"][name]["q"].ndim
+        parts = list(spec) + [None] * (ndim - len(spec))
+        # scale has size 1 on the contracted (second-to-last) dim —
+        # drop that dim's axis, keep the rest (works for 3-D dense and
+        # 4-D expert-stacked kernels alike)
+        scale_spec = P(*parts[:-2], None, parts[-1])
         layer_specs[name] = {"q": spec, "scale": scale_spec}
     out["layers"] = layer_specs
     return out

@@ -15,7 +15,15 @@ import jax
 import jax.numpy as jnp
 
 import flexflow_tpu.models as zoo
-from flexflow_tpu.models import falcon, llama, mpt, opt, qwen2, starcoder
+from flexflow_tpu.models import (
+    falcon,
+    llama,
+    mixtral,
+    mpt,
+    opt,
+    qwen2,
+    starcoder,
+)
 
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
@@ -88,9 +96,22 @@ def _hf_qwen2():
     ), qwen2
 
 
+def _hf_mixtral():
+    cfg = transformers.MixtralConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+    )
+    return transformers.MixtralForCausalLM(cfg), mixtral.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), mixtral
+
+
 BUILDERS = {
     "llama": _hf_llama,
     "qwen2": _hf_qwen2,
+    "mixtral": _hf_mixtral,
     "opt": _hf_opt,
     "falcon": _hf_falcon,
     "mpt": _hf_mpt,
@@ -185,3 +206,16 @@ def test_llm_from_pretrained_e2e(tmp_path):
             torch.tensor([prompts[0]]), max_new_tokens=5, do_sample=False
         )[0, 3:].tolist()
     assert out[0].output_tokens == hf_out
+
+
+def test_mixtral_guards():
+    """Config-level guards: sliding-window checkpoints rejected at load
+    (qwen2-style), mlp_bias incompatible with MoE."""
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        mixtral.from_hf({
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "max_position_embeddings": 4096, "sliding_window": 1024,
+        })
+    with pytest.raises(ValueError, match="mlp_bias"):
+        mixtral.config(mlp_bias=True)

@@ -145,3 +145,54 @@ def test_training_path_rejects_quantization():
     t = model.dense(t, 4)
     with pytest.raises(NotImplementedError):
         model.compile(optimizer=ff.SGDOptimizer(lr=0.1))
+
+
+def test_moe_expert_weights_quantize_router_stays_dense():
+    """4-D expert-stacked kernels quantize (they are ~all of a Mixtral's
+    bytes); the routing matmul stays dense — int-rounded router logits
+    would change top-k expert selection, the worst accuracy/byte trade."""
+    import jax
+
+    from flexflow_tpu.models import mixtral
+
+    cfg = mixtral.tiny(dtype=jnp.float32)
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+    qp = quant.quantize_params(params, bits=8)
+    layers = qp["layers"]
+    for name in ("w_up", "w_down", "w_gate"):
+        assert quant.is_quantized(layers[name]), name
+        assert layers[name]["q"].ndim == 4
+    assert not quant.is_quantized(layers["w_router"])
+    # bytes actually shrink (experts dominate)
+    assert (quant.quantized_nbytes(layers)
+            < 0.5 * quant.quantized_nbytes(params["layers"]))
+    # and the quantized model still serves greedily end to end
+    from flexflow_tpu.serve import (
+        InferenceEngine, RequestManager, ServingConfig,
+    )
+
+    sc = ServingConfig(max_requests_per_batch=1, max_sequence_length=32,
+                       prefill_chunk=4, max_spec_tree_tokens=8,
+                       cache_dtype=jnp.float32)
+    rm = RequestManager(InferenceEngine(mixtral, cfg, qp, sc))
+    out = rm.generate([[5, 9, 11]], max_new_tokens=4)[0]
+    assert len(out.output_tokens) == 4
+
+
+def test_moe_quantized_pspecs_shapes():
+    """quantize_pspecs must follow 4-D expert kernels: q keeps the dense
+    spec, scale drops the contracted dim's axis."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from flexflow_tpu.models import mixtral
+
+    cfg = mixtral.tiny(dtype=jnp.float32)
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+    qp = quant.quantize_params(params, bits=4)
+    pspecs = mixtral.param_pspecs(cfg)
+    qspecs = quant.quantize_pspecs(pspecs, qp)
+    up = qspecs["layers"]["w_up"]
+    assert up["q"] == pspecs["layers"]["w_up"]
+    # (pp, expert, None(contracted), model) -> scale (pp, expert, None, model)
+    assert up["scale"][-2] is None

@@ -72,3 +72,53 @@ def reference_tokens(tiny4):
 )
 def test_layout_token_equality(tiny4, reference_tokens, spec):
     assert _generate(tiny4, spec) == reference_tokens
+
+
+class TestMoEServing:
+    """Expert-parallel serving (beyond the reference zoo: its serving
+    models are dense-only). Mixtral-style MoE tokens must be identical
+    on expert-sharded / TP / mixed meshes vs single device."""
+
+    @pytest.fixture(scope="class")
+    def moe_tiny(self):
+        from flexflow_tpu.models import mixtral
+
+        cfg = mixtral.tiny(dtype=jnp.float32)
+        params = mixtral.init_params(jax.random.PRNGKey(3), cfg)
+        return cfg, params
+
+    def _gen(self, moe_tiny, spec: MachineSpec):
+        from flexflow_tpu.models import mixtral
+
+        cfg, params = moe_tiny
+        mesh = spec.make_mesh(jax.devices()[: spec.num_devices])
+        m = LLM(mixtral, cfg, params, mesh=mesh)
+        m.compile(
+            ServingConfig(
+                max_requests_per_batch=4,
+                max_sequence_length=64,
+                prefill_chunk=8,
+                max_spec_tree_tokens=8,
+                cache_dtype=jnp.float32,
+            )
+        )
+        return [
+            o.output_tokens for o in m.generate(PROMPTS, max_new_tokens=N_NEW)
+        ]
+
+    @pytest.fixture(scope="class")
+    def moe_reference(self, moe_tiny):
+        return self._gen(moe_tiny, MachineSpec())
+
+    @pytest.mark.parametrize(
+        "spec",
+        [
+            MachineSpec(expert=2),
+            MachineSpec(expert=4),
+            MachineSpec(expert=2, model=2),
+            MachineSpec(data=2, expert=2, model=2),
+        ],
+        ids=["ep2", "ep4", "ep2tp2", "dp2ep2tp2"],
+    )
+    def test_moe_layout_token_equality(self, moe_tiny, moe_reference, spec):
+        assert self._gen(moe_tiny, spec) == moe_reference
