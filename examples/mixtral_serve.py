@@ -1,0 +1,55 @@
+"""Mixtral sparse-MoE serving demo — expert-parallel continuous
+batching through the high-level ``LLM`` API (beyond the reference's
+apps: its serving models are dense-only; expert parallelism here is the
+serving-side analog of ``examples/moe_train.py``). Uses a tiny
+randomly-initialised model so it runs anywhere; point ``--model-dir``
+at a local HF Mixtral checkpoint directory to serve real weights.
+
+Run: python examples/mixtral_serve.py [--model-dir PATH] [--ep N] [--tp N]
+"""
+import argparse
+
+
+def main(model_dir=None, ep=1, tp=1, quantization=None):
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.core.mesh import MachineSpec
+    from flexflow_tpu.models import mixtral
+    from flexflow_tpu.serve import ServingConfig
+    from flexflow_tpu.serve.llm import LLM
+
+    n = ep * tp
+    mesh = MachineSpec.from_degrees(
+        n, tensor=tp, expert=ep
+    ).make_mesh(jax.devices()[:n])
+
+    if model_dir:
+        m = LLM.from_pretrained(model_dir, mesh=mesh)
+        prompts = ["The capital of France is"]
+    else:
+        cfg = mixtral.tiny(dtype=jnp.float32)
+        m = LLM(mixtral, cfg, mesh=mesh)
+        prompts = [[3, 17, 91, 42, 7], [9, 8, 7]]
+
+    sc = ServingConfig(
+        max_requests_per_batch=4, max_sequence_length=128,
+        prefill_chunk=16, max_spec_tree_tokens=16,
+        cache_dtype=m.cfg.dtype,
+    )
+    m.compile(sc, quantization=quantization)
+    outs = m.generate(prompts, max_new_tokens=16)
+    for o in outs:
+        print(f"moe ep{ep}tp{tp}:", o.output_text or o.output_tokens)
+    return outs
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-dir", default=None)
+    p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--quantization", default=None,
+                   choices=[None, "int8", "int4"])
+    a = p.parse_args()
+    main(a.model_dir, a.ep, a.tp, a.quantization)

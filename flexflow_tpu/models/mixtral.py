@@ -122,8 +122,12 @@ def convert_hf_state_dict(
     L, E = cfg.num_hidden_layers, cfg.num_local_experts
     pre = "model."
 
-    def per_layer(fmt, conv):
-        return [conv(sd, pre + fmt.format(i)) for i in range(L)]
+    # same helper shapes as the other family converters (qwen2.py)
+    def mats(fmt):
+        return stack([linear_w(sd, pre + fmt.format(i)) for i in range(L)], dt)
+
+    def vecs(fmt):
+        return stack([to_np(sd[pre + fmt.format(i)]) for i in range(L)], dt)
 
     def experts(which):
         return stack(
@@ -145,21 +149,13 @@ def convert_hf_state_dict(
         )
 
     layers = {
-        "attn_norm_scale": stack(
-            per_layer("layers.{}.input_layernorm.weight",
-                      lambda s, n: to_np(s[n])), dt
-        ),
-        "mlp_norm_scale": stack(
-            per_layer("layers.{}.post_attention_layernorm.weight",
-                      lambda s, n: to_np(s[n])), dt
-        ),
-        "wq": stack(per_layer("layers.{}.self_attn.q_proj.weight", linear_w), dt),
-        "wk": stack(per_layer("layers.{}.self_attn.k_proj.weight", linear_w), dt),
-        "wv": stack(per_layer("layers.{}.self_attn.v_proj.weight", linear_w), dt),
-        "wo": stack(per_layer("layers.{}.self_attn.o_proj.weight", linear_w), dt),
-        "w_router": stack(
-            per_layer("layers.{}.block_sparse_moe.gate.weight", linear_w), dt
-        ),
+        "attn_norm_scale": vecs("layers.{}.input_layernorm.weight"),
+        "mlp_norm_scale": vecs("layers.{}.post_attention_layernorm.weight"),
+        "wq": mats("layers.{}.self_attn.q_proj.weight"),
+        "wk": mats("layers.{}.self_attn.k_proj.weight"),
+        "wv": mats("layers.{}.self_attn.v_proj.weight"),
+        "wo": mats("layers.{}.self_attn.o_proj.weight"),
+        "w_router": mats("layers.{}.block_sparse_moe.gate.weight"),
         "w_gate": experts("w1"),
         "w_up": experts("w3"),
         "w_down": experts("w2"),

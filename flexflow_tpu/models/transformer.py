@@ -374,10 +374,12 @@ def _moe_ffn(cfg: DecoderConfig, p, h):
         act = _activation(cfg, gate_p) * up
     else:
         act = _activation(cfg, up)
-    out_e = jnp.einsum(
-        "bsef,efd->bsed", act, w_down, preferred_element_type=jnp.float32
+    # single contraction: folding the combine weights in avoids ever
+    # materializing the E-times-wider (B,S,E,D) f32 intermediate
+    out = jnp.einsum(
+        "bsef,efd,bse->bsd", act, w_down, combine,
+        preferred_element_type=jnp.float32,
     )
-    out = jnp.einsum("bsed,bse->bsd", out_e, combine)
     return out.astype(h.dtype)
 
 

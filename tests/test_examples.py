@@ -34,6 +34,13 @@ def test_llama_serve_example():
     assert outs and all(o.output_tokens for o in outs)
 
 
+def test_mixtral_serve_example():
+    import mixtral_serve
+
+    outs = mixtral_serve.main(ep=2, tp=2)
+    assert outs and all(o.output_tokens for o in outs)
+
+
 def test_moe_train_expert_parallel():
     import moe_train
 
