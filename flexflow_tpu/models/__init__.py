@@ -1,4 +1,4 @@
-from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, mixtral, hf_utils
+from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, mixtral, mistral, hf_utils
 
 # Model-family registry (reference python/flexflow/serve/models/__init__.py
 # maps HF architectures to FlexFlow builders; qwen2 and mixtral go beyond
@@ -12,10 +12,11 @@ FAMILIES = {
     "gpt_bigcode": starcoder,
     "qwen2": qwen2,
     "mixtral": mixtral,
+    "mistral": mistral,
 }
 
 __all__ = [
     "llama", "transformer", "opt", "falcon", "mpt", "starcoder", "qwen2",
-    "mixtral",
+    "mixtral", "mistral",
     "hf_utils", "FAMILIES",
 ]

@@ -45,6 +45,26 @@ def stack(arrs: List[np.ndarray], dtype) -> jnp.ndarray:
     return jnp.asarray(np.stack(arrs, axis=0), dtype=dtype)
 
 
+def layer_stackers(sd: Dict[str, Any], pre: str, num_layers: int, dtype):
+    """(mats, vecs) helpers shared by the family converters: stack a
+    per-layer HF tensor name pattern into one (L, ...) array — ``mats``
+    transposes Linear weights to (in, out), ``vecs`` takes them raw."""
+
+    def mats(fmt):
+        return stack(
+            [linear_w(sd, pre + fmt.format(i)) for i in range(num_layers)],
+            dtype,
+        )
+
+    def vecs(fmt):
+        return stack(
+            [to_np(sd[pre + fmt.format(i)]) for i in range(num_layers)],
+            dtype,
+        )
+
+    return mats, vecs
+
+
 def load_state_dict(model_dir: str) -> Dict[str, np.ndarray]:
     """Load all weights from a local HF checkpoint directory
     (*.safetensors preferred, falling back to pytorch_model*.bin)."""

@@ -1,20 +1,14 @@
-"""Mixtral model family — sparse-MoE serving BEYOND the reference zoo
-(the reference serves dense decoders only, ``inference/models/*.cc``;
-its MoE support is the training-side expert ops). Runs on the generic
-decoder (:mod:`.transformer`) with ``num_local_experts`` > 0: a linear
-router takes the top-k experts per token (softmax over the selected k,
-HF ``MixtralSparseMoeBlock`` semantics), expert weights shard over the
-``expert`` mesh axis with Megatron TP inside each expert.
-
-Architecture = LLaMA attention (RoPE, GQA, RMSNorm, no biases) + the
-MoE FFN; weight conversion from HF ``MixtralForCausalLM``.
-"""
+"""Mistral model family — LLaMA-architecture dense decoder with
+sliding-window attention (HF ``MistralForCausalLM``), beyond the
+reference zoo (``inference/models/*`` has no Mistral and no windowed
+attention). Runs on the generic decoder (:mod:`.transformer`) with
+``sliding_window`` > 0: queries attend only the last w key positions;
+training masks and the serving cache masks both enforce it."""
 from __future__ import annotations
 
 from typing import Any, Dict
 
 import jax.numpy as jnp
-import numpy as np
 
 from . import transformer
 from .transformer import (  # noqa: F401  (engine serving protocol)
@@ -45,21 +39,20 @@ def config(**kw) -> DecoderConfig:
         norm_bias=False,
         norm_eps=1e-5,
         positions="rope",
-        rope_theta=1e6,
+        rope_theta=10000.0,
         activation="silu",
         glu=True,
         qkv_bias=False,
         out_bias=False,
         mlp_bias=False,
         tie_word_embeddings=False,
-        num_local_experts=8,
-        num_experts_per_tok=2,
+        sliding_window=4096,
     )
     d.update(kw)
     return DecoderConfig(**d)
 
 
-def mixtral_8x7b(**kw) -> DecoderConfig:
+def mistral_7b(**kw) -> DecoderConfig:
     return config(**kw)
 
 
@@ -72,8 +65,7 @@ def tiny(**kw) -> DecoderConfig:
         num_attention_heads=4,
         num_key_value_heads=2,
         max_position_embeddings=128,
-        num_local_experts=4,
-        num_experts_per_tok=2,
+        sliding_window=8,
     )
     d.update(kw)
     return config(**d)
@@ -91,11 +83,8 @@ def from_hf(hf: Dict[str, Any], **kw) -> DecoderConfig:
         ),
         max_position_embeddings=hf["max_position_embeddings"],
         norm_eps=hf.get("rms_norm_eps", 1e-5),
-        rope_theta=hf.get("rope_theta", 1e6),
-        num_local_experts=hf.get("num_local_experts", 8),
-        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
-        # early mixtral-8x7b configs ship sliding_window=4096; the
-        # generic decoder enforces it (null/absent = full causal)
+        rope_theta=hf.get("rope_theta", 10000.0),
+        # null/absent window (mistral-v0.3-style configs) = full causal
         sliding_window=hf.get("sliding_window") or 0,
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
     )
@@ -106,34 +95,13 @@ def from_hf(hf: Dict[str, Any], **kw) -> DecoderConfig:
 def convert_hf_state_dict(
     sd: Dict[str, Any], cfg: DecoderConfig
 ) -> Dict[str, Any]:
-    """HF ``MixtralForCausalLM`` state dict → framework pytree. HF per-
-    expert names w1 (gate), w2 (down), w3 (up) map onto the generic
-    decoder's glu layout: w_gate ← w1, w_down ← w2, w_up ← w3, each
-    stacked (L, E, in, out)."""
+    """HF ``MistralForCausalLM`` state dict → framework pytree (same
+    tensor names as LLaMA's HF layout)."""
     dt = cfg.dtype
-    L, E = cfg.num_hidden_layers, cfg.num_local_experts
+    L = cfg.num_hidden_layers
     pre = "model."
 
     mats, vecs = layer_stackers(sd, pre, L, dt)
-
-    def experts(which):
-        return stack(
-            [
-                np.stack(
-                    [
-                        linear_w(
-                            sd,
-                            pre + f"layers.{i}.block_sparse_moe."
-                                  f"experts.{e}.{which}.weight",
-                        )
-                        for e in range(E)
-                    ],
-                    axis=0,
-                )
-                for i in range(L)
-            ],
-            dt,
-        )
 
     layers = {
         "attn_norm_scale": vecs("layers.{}.input_layernorm.weight"),
@@ -142,10 +110,9 @@ def convert_hf_state_dict(
         "wk": mats("layers.{}.self_attn.k_proj.weight"),
         "wv": mats("layers.{}.self_attn.v_proj.weight"),
         "wo": mats("layers.{}.self_attn.o_proj.weight"),
-        "w_router": mats("layers.{}.block_sparse_moe.gate.weight"),
-        "w_gate": experts("w1"),
-        "w_up": experts("w3"),
-        "w_down": experts("w2"),
+        "w_gate": mats("layers.{}.mlp.gate_proj.weight"),
+        "w_up": mats("layers.{}.mlp.up_proj.weight"),
+        "w_down": mats("layers.{}.mlp.down_proj.weight"),
     }
     out: Dict[str, Any] = {
         "embed": jnp.asarray(to_np(sd[pre + "embed_tokens.weight"]), dt),

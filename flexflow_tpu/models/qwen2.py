@@ -23,7 +23,7 @@ from .transformer import (  # noqa: F401  (engine serving protocol)
     reorder_slots,
     serve_step,
 )
-from .hf_utils import linear_w, stack, to_np
+from .hf_utils import layer_stackers, linear_w, stack, to_np
 
 
 def config(**kw) -> DecoderConfig:
@@ -112,11 +112,7 @@ def convert_hf_state_dict(sd: Dict[str, Any], cfg: DecoderConfig) -> Dict[str, A
     L = cfg.num_hidden_layers
     pre = "model."
 
-    def mats(fmt):
-        return stack([linear_w(sd, pre + fmt.format(i)) for i in range(L)], dt)
-
-    def vecs(fmt):
-        return stack([to_np(sd[pre + fmt.format(i)]) for i in range(L)], dt)
+    mats, vecs = layer_stackers(sd, pre, L, dt)
 
     layers = {
         "attn_norm_scale": vecs("layers.{}.input_layernorm.weight"),

@@ -73,6 +73,12 @@ class DecoderConfig:
     # router taking the top-k per token (softmax over the selected k).
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
+    # Sliding-window attention (Mistral-style): w > 0 lets a query at
+    # position q attend only keys in (q-w, q]. 0 = full causal. The
+    # serving KV cache keeps its full-length layout (lines beyond the
+    # window are masked, not evicted) — correctness first; a rolling
+    # cache is a memory optimization the reference also lacks.
+    sliding_window: int = 0
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
@@ -479,6 +485,9 @@ def forward(
     rope = rope_freqs(cfg, positions) if cfg.positions == "rope" else None
     bias = _train_bias(cfg, positions)
     mask = jnp.tril(jnp.ones((S, S), bool))
+    if cfg.sliding_window:
+        idx = jnp.arange(S)
+        mask &= idx[None, :] > idx[:, None] - cfg.sliding_window
 
     def constrain(t):
         if shard_activations:
@@ -507,10 +516,13 @@ def forward(
 
 
 def needs_pos_cache(cfg: DecoderConfig) -> bool:
-    """ALiBi biases depend on key *sequence* positions at attention time
-    (RoPE bakes position into cached K instead), so the cache carries a
-    per-line position buffer."""
-    return cfg.positions == "alibi"
+    """ALiBi biases and sliding-window masks depend on key *sequence*
+    positions at attention time (RoPE bakes position into cached K
+    instead), so the cache carries a per-line position buffer. For the
+    window this makes tree-verify masking EXACT: an in-flight tree key's
+    cache line (prefix + node index) is not its sequence position
+    (prefix + depth), so a line-index window would under-mask."""
+    return cfg.positions == "alibi" or cfg.sliding_window > 0
 
 
 def init_kv_cache(cfg: DecoderConfig, num_slots: int, max_len: int, dtype=None):
@@ -609,17 +621,29 @@ def serve_step(
         mask = mask & (key_pos[None, None, :] < S1 - 1)
 
     bias = None
+    pos_cache = None
     if needs_pos_cache(cfg):
         bidx = jnp.arange(R)[:, None]
         pos_cache = cache["pos"].at[bidx, cache_positions].set(
             positions.astype(jnp.int32)
         )
-        slopes = alibi_slopes(cfg.num_attention_heads)
-        dist = (
-            positions.astype(jnp.float32)[:, None, :, None]
-            - pos_cache.astype(jnp.float32)[:, None, None, :]
-        )  # (R,1,C,S1)
-        bias = -slopes[None, :, None, None] * dist
+        if cfg.positions == "alibi":
+            slopes = alibi_slopes(cfg.num_attention_heads)
+            dist = (
+                positions.astype(jnp.float32)[:, None, :, None]
+                - pos_cache.astype(jnp.float32)[:, None, None, :]
+            )  # (R,1,C,S1)
+            bias = -slopes[None, :, None, None] * dist
+    if cfg.sliding_window:
+        # window by TRUE key sequence positions from the pos cache —
+        # exact for every path, including tree-verify lines whose cache
+        # line (prefix + node index) differs from their sequence
+        # position (prefix + depth). Unwritten lines hold position 0,
+        # but the causal/tree mask already excludes them.
+        mask = mask & (
+            pos_cache[:, None, :]
+            > positions[:, :, None] - cfg.sliding_window
+        )
 
     def scan_body(h, xs):
         p_l, kc, vc = xs

@@ -18,6 +18,7 @@ import flexflow_tpu.models as zoo
 from flexflow_tpu.models import (
     falcon,
     llama,
+    mistral,
     mixtral,
     mpt,
     opt,
@@ -96,6 +97,19 @@ def _hf_qwen2():
     ), qwen2
 
 
+def _hf_mistral():
+    # sliding_window=8 < S=17 so the window mask actually BINDS in the
+    # alignment comparison (full-causal logits would differ)
+    cfg = transformers.MistralConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=8,
+    )
+    return transformers.MistralForCausalLM(cfg), mistral.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), mistral
+
+
 def _hf_mixtral():
     cfg = transformers.MixtralConfig(
         vocab_size=V, hidden_size=64, intermediate_size=128,
@@ -112,6 +126,7 @@ BUILDERS = {
     "llama": _hf_llama,
     "qwen2": _hf_qwen2,
     "mixtral": _hf_mixtral,
+    "mistral": _hf_mistral,
     "opt": _hf_opt,
     "falcon": _hf_falcon,
     "mpt": _hf_mpt,
@@ -209,13 +224,19 @@ def test_llm_from_pretrained_e2e(tmp_path):
 
 
 def test_mixtral_guards():
-    """Config-level guards: sliding-window checkpoints rejected at load
-    (qwen2-style), mlp_bias incompatible with MoE."""
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        mixtral.from_hf({
-            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
-            "num_hidden_layers": 2, "num_attention_heads": 4,
-            "max_position_embeddings": 4096, "sliding_window": 1024,
-        })
+    """Sliding-window configs carry the window through (the generic
+    decoder enforces it since mistral landed); mlp_bias stays
+    incompatible with MoE."""
+    cfg = mixtral.from_hf({
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "max_position_embeddings": 4096, "sliding_window": 1024,
+    })
+    assert cfg.sliding_window == 1024
+    assert mixtral.from_hf({
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "max_position_embeddings": 4096, "sliding_window": None,
+    }).sliding_window == 0
     with pytest.raises(ValueError, match="mlp_bias"):
         mixtral.config(mlp_bias=True)

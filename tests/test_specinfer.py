@@ -206,3 +206,50 @@ class TestSpecInfer:
         prompt = [3, 17, 91]
         out = m.generate([prompt], max_new_tokens=8)[0]
         assert out.output_tokens == ref_greedy(cfg, params, prompt, 8)
+
+
+class TestSlidingWindowSpec:
+    """Sliding-window models through the speculation loop: the window
+    mask must use TRUE key positions (the pos cache) — tree-verify
+    cache lines sit at prefix+node_index, not prefix+depth, so a
+    line-index window under-masks and breaks spec==greedy exactly when
+    the window is comparable to the tree depth."""
+
+    def test_spec_equals_greedy_window_comparable_to_tree(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flexflow_tpu.models import mistral
+        from flexflow_tpu.serve import (
+            InferenceEngine,
+            RequestManager,
+            ServingConfig,
+        )
+
+        # window 4 ~ beam_depth+1: several verified keys per round fall
+        # right at the window boundary
+        cfg = mistral.tiny(dtype=jnp.float32, sliding_window=4)
+        params = mistral.init_params(jax.random.PRNGKey(2), cfg)
+        dcfg = mistral.tiny(dtype=jnp.float32, sliding_window=4,
+                            num_hidden_layers=1)
+        dparams = dict(params)
+        dparams["layers"] = {k: v[:1] for k, v in params["layers"].items()}
+        sc = ServingConfig(
+            max_requests_per_batch=2, max_sequence_length=64,
+            prefill_chunk=8, max_spec_tree_tokens=12,
+            cache_dtype=jnp.float32,
+        )
+        prompts = [[3, 17, 91, 42, 5, 6, 7, 8, 9, 10, 11, 12], [9, 8, 7]]
+        rm = RequestManager(InferenceEngine(mistral, cfg, params, sc))
+        greedy = [
+            o.output_tokens for o in rm.generate(prompts, max_new_tokens=12)
+        ]
+        mgr = SpecInferManager(
+            InferenceEngine(mistral, cfg, params, sc),
+            InferenceEngine(mistral, dcfg, dparams, sc),
+            SpecConfig(beam_width=2, beam_depth=3),
+        )
+        spec = [
+            o.output_tokens for o in mgr.generate(prompts, max_new_tokens=12)
+        ]
+        assert spec == greedy, (spec, greedy)
