@@ -49,6 +49,10 @@ class FFConfig:
     # Calibrate the search cost model with on-device op timings
     # (reference inner_measure_operator_cost, model.cu:38).
     search_measured: bool = False
+    # Persist those timings to a JSON file and reuse across processes
+    # (per-(op, shape) timing costs a compile on TPU — SURVEY §7:
+    # "cache aggressively"); keyed by device kind.
+    search_measured_cache: Optional[str] = None
     # Replace the chip preset's mxu/hbm efficiency guesses with measured
     # roofline fractions (search.machine_model.calibrate_chip) before
     # searching — the other half of the fidelity loop.

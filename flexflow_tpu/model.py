@@ -818,6 +818,7 @@ class FFModel:
                 budget=budget,
                 alpha=cfgf.search_alpha,
                 measured=cfgf.search_measured,
+                measured_cache=cfgf.search_measured_cache,
                 enable_sample=cfgf.enable_sample_parallel,
                 enable_attribute=cfgf.enable_attribute_parallel,
                 enable_parameter=cfgf.enable_parameter_parallel,

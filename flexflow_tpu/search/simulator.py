@@ -256,22 +256,83 @@ class CostModel:
             )
         return total
 
-    def calibrate(self, graph: Graph, iters: int = 3) -> int:
+    def calibrate(
+        self, graph: Graph, iters: int = 3, cache_path: Optional[str] = None
+    ) -> int:
         """Measure every op's unsharded forward on the current device
         (memoized across calls) so op_cost scales real times instead of
         roofline estimates — the reference's measured simulator mode.
-        Returns the number of ops calibrated."""
+        Returns the number of ops calibrated.
+
+        ``cache_path``: persist measurements to a JSON file and reuse
+        them across processes — on TPU each per-(op, shape) timing
+        costs a compile (SURVEY §7 hard parts: "cache aggressively"),
+        so recompiles and repeated searches must not re-pay it. The
+        file holds a nested {device_kind: {mode: {key: secs}}} map, so
+        heterogeneous environments sharing one path coexist instead of
+        evicting each other, and training-mode forwards (dropout,
+        batch-stats) never masquerade as inference timings. Any corrupt
+        or wrong-shaped file is treated as empty."""
+        import json
+        import os
+
         if self.measured is None:
             self.measured = {}
+        disk: Dict[str, float] = {}
+        full: Dict = {}
+        dev_kind = ""
+        mode = "training" if self.training else "inference"
+        if cache_path:
+            import jax
+
+            dev_kind = jax.devices()[0].device_kind
+            try:
+                with open(cache_path) as f:
+                    raw = json.load(f)
+                full = raw if isinstance(raw, dict) else {}
+            except Exception:
+                full = {}
+            try:
+                disk = {
+                    k: float(v)
+                    for k, v in full.get(dev_kind, {}).get(mode, {}).items()
+                    if isinstance(v, (int, float))
+                }
+            except Exception:
+                # malformed inner shape: re-measure this (kind, mode)
+                # but keep the rest of the file intact on write
+                disk = {}
         n = 0
+        dirty = False
         for node in graph.nodes:
             if node.op_type == "input":
                 continue
+            in_specs = [graph.out_spec(r) for r in node.inputs]
+            key = (
+                node.op_type, node.attrs,
+                tuple(s.shape for s in in_specs), "REP",
+            )
+            rkey = repr(key)
+            if key not in self.measured and rkey in disk:
+                self.measured[key] = disk[rkey]
+                n += 1
+                continue
             try:
-                self.measure_op(graph, node, "REP", iters=iters)
+                t = self.measure_op(graph, node, "REP", iters=iters)
                 n += 1
             except Exception:
                 continue
+            if cache_path and disk.get(rkey) != t:
+                disk[rkey] = float(t)
+                dirty = True
+        if cache_path and dirty:
+            if not isinstance(full.get(dev_kind), dict):
+                full[dev_kind] = {}
+            full[dev_kind][mode] = disk
+            tmp = f"{cache_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(full, f)
+            os.replace(tmp, cache_path)
         return n
 
     def reshard_cost(

@@ -203,6 +203,7 @@ def optimize(
     alpha: float = 1.05,
     machines: Optional[Iterable[MachineSpec]] = None,
     measured: bool = False,
+    measured_cache: Optional[str] = None,
     enable_sample: bool = True,
     enable_attribute: bool = True,
     enable_parameter: bool = True,
@@ -239,7 +240,7 @@ def optimize(
     shared_measured = None
     if measured:
         cm0 = CostModel(topo=topo, machine=MachineSpec(), training=training)
-        cm0.calibrate(graph)
+        cm0.calibrate(graph, cache_path=measured_cache)
         shared_measured = cm0.measured
 
     if memory_budget is None:

@@ -644,3 +644,54 @@ def test_multibranch_fanout_dp_misrank_rescued_by_refinement():
         machines=[machine],
     )
     assert strat.estimated_step_time <= all_dp_cost
+
+
+def test_measured_cache_persists_and_reloads(tmp_path):
+    """Measured-mode timings persist to disk ({device_kind: {mode:
+    {key: secs}}}) and reload without re-measuring (per-(op, shape)
+    timing costs a compile on TPU — SURVEY §7 "cache aggressively").
+    A poisoned cache value proves the reload path is used; training and
+    inference timings never cross; other device kinds' entries survive
+    a write; corrupt files are treated as empty."""
+    import json
+
+    import jax
+
+    m = _mlp_model(hidden=32)
+    topo = TPUTopology(chip=TPUChip.v5e(), num_chips=8)
+    cache = tmp_path / "measured.json"
+    kind = jax.devices()[0].device_kind
+
+    cm = CostModel(topo=topo, machine=MachineSpec(), training=True)
+    n = cm.calibrate(m.graph, iters=1, cache_path=str(cache))
+    assert n > 0 and cache.exists()
+    blob = json.loads(cache.read_text())
+    assert blob[kind]["training"]
+
+    # poison one entry; a fresh training CostModel must pick it up...
+    rkey = next(iter(blob[kind]["training"]))
+    blob[kind]["training"][rkey] = 123.456
+    # ...and a foreign device's entries must survive future writes
+    blob["other-device"] = {"training": {"k": 1.0}}
+    cache.write_text(json.dumps(blob))
+    cm2 = CostModel(topo=topo, machine=MachineSpec(), training=True)
+    cm2.calibrate(m.graph, iters=1, cache_path=str(cache))
+    assert any(abs(v - 123.456) < 1e-9 for v in cm2.measured.values())
+
+    # an INFERENCE calibrate must not see training-mode timings
+    # (dropout/batch-stat forwards time differently)...
+    cm_inf = CostModel(topo=topo, machine=MachineSpec(), training=False)
+    cm_inf.calibrate(m.graph, iters=1, cache_path=str(cache))
+    assert all(abs(v - 123.456) > 1e-9 for v in cm_inf.measured.values())
+    # ...and its write keeps both the foreign device and the
+    # training-mode entries
+    blob2 = json.loads(cache.read_text())
+    assert blob2["other-device"] == {"training": {"k": 1.0}}
+    assert blob2[kind]["training"][rkey] == 123.456
+    assert blob2[kind]["inference"]
+
+    # corrupt file shapes are treated as empty, not a crash
+    for garbage in ("[1, 2]", "{not json", json.dumps({kind: "oops"})):
+        cache.write_text(garbage)
+        cm4 = CostModel(topo=topo, machine=MachineSpec(), training=True)
+        assert cm4.calibrate(m.graph, iters=1, cache_path=str(cache)) > 0
