@@ -1,4 +1,4 @@
-from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, mixtral, mistral, hf_utils
+from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, qwen2_moe, mixtral, mistral, hf_utils
 
 # Model-family registry (reference python/flexflow/serve/models/__init__.py
 # maps HF architectures to FlexFlow builders; qwen2 and mixtral go beyond
@@ -13,10 +13,11 @@ FAMILIES = {
     "qwen2": qwen2,
     "mixtral": mixtral,
     "mistral": mistral,
+    "qwen2_moe": qwen2_moe,
 }
 
 __all__ = [
     "llama", "transformer", "opt", "falcon", "mpt", "starcoder", "qwen2",
-    "mixtral", "mistral",
+    "mixtral", "mistral", "qwen2_moe",
     "hf_utils", "FAMILIES",
 ]

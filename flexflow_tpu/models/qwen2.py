@@ -72,12 +72,14 @@ def tiny(**kw) -> DecoderConfig:
 
 def from_hf(hf: Dict[str, Any], **kw) -> DecoderConfig:
     if hf.get("model_type", "qwen2") != "qwen2":
-        # the substring fallback in serve.llm.detect_family would route
-        # qwen2_moe / qwen2_vl checkpoints here; their weights don't fit
-        # the dense decoder — fail with the real reason
+        # qwen2_moe has its own family (models/qwen2_moe.py) and the
+        # detect_family fallback matches longest-key-first, so only
+        # genuinely unsupported variants (qwen2_vl etc.) land here —
+        # their weights don't fit the dense decoder; fail with the
+        # real reason
         raise NotImplementedError(
             f"model_type {hf['model_type']!r} is not dense Qwen2 "
-            "(MoE/VL variants are unsupported)"
+            "(use the qwen2_moe family for MoE; VL is unsupported)"
         )
     if hf.get("use_sliding_window"):
         # the generic decoder runs full causal attention — silently

@@ -73,6 +73,15 @@ class DecoderConfig:
     # router taking the top-k per token (softmax over the selected k).
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
+    # Qwen2-MoE extensions (HF Qwen2MoeSparseMoeBlock): experts may use
+    # their own FFN width; an always-on shared expert (its own glu FFN)
+    # joins the routed sum scaled by sigmoid(h @ shared_expert_gate);
+    # and norm_topk=False keeps the softmax-over-ALL-experts weights of
+    # the selected k WITHOUT renormalizing (qwen2_moe's default),
+    # versus the Mixtral renormalize-over-selected behavior.
+    moe_intermediate_size: int = 0          # 0 = intermediate_size
+    moe_shared_expert_intermediate_size: int = 0  # 0 = no shared expert
+    moe_norm_topk: bool = True
     # Sliding-window attention (Mistral-style): w > 0 lets a query at
     # position q attend only keys in (q-w, q]. 0 = full causal. The
     # serving KV cache keeps its full-length layout (lines beyond the
@@ -198,9 +207,23 @@ def init_params(key, cfg: DecoderConfig) -> Dict[str, Any]:
     if E:
         # expert-stacked FFN + router (HF Mixtral block_sparse_moe):
         # expert dim shards over the ``expert`` mesh axis
+        Fe = cfg.moe_intermediate_size or F
         layers["w_router"] = w(jax.random.fold_in(ks[4], 1), (L, D, E))
-        layers["w_up"] = w(ks[4], (L, E, D, F))
-        layers["w_down"] = w(ks[5], (L, E, F, D), std / math.sqrt(2 * L))
+        layers["w_up"] = w(ks[4], (L, E, D, Fe))
+        layers["w_down"] = w(ks[5], (L, E, Fe, D), std / math.sqrt(2 * L))
+        Fs = cfg.moe_shared_expert_intermediate_size
+        if Fs:
+            # always-on shared expert (Qwen2-MoE), sigmoid-gated; the
+            # gate stays un-prefixed so quantization never touches it
+            kk = jax.random.fold_in(ks[5], 7)
+            layers["w_shared_up"] = w(jax.random.fold_in(kk, 0), (L, D, Fs))
+            layers["w_shared_gate"] = w(jax.random.fold_in(kk, 1), (L, D, Fs))
+            layers["w_shared_down"] = w(
+                jax.random.fold_in(kk, 2), (L, Fs, D), std / math.sqrt(2 * L)
+            )
+            layers["shared_expert_gate"] = w(
+                jax.random.fold_in(kk, 3), (L, D, 1)
+            )
     else:
         layers["w_up"] = w(ks[4], (L, D, F))
         layers["w_down"] = w(ks[5], (L, F, D), std / math.sqrt(2 * L))
@@ -214,7 +237,8 @@ def init_params(key, cfg: DecoderConfig) -> Dict[str, Any]:
             layers["mlp_norm_bias"] = zeros((L, D))
     if cfg.glu:
         layers["w_gate"] = w(
-            ks[6], (L, E, D, F) if E else (L, D, F)
+            ks[6],
+            (L, E, D, cfg.moe_intermediate_size or F) if E else (L, D, F),
         )
     if cfg.qkv_bias:
         layers["bq"] = zeros((L, H * dk))
@@ -265,6 +289,12 @@ def param_pspecs(cfg: DecoderConfig, *, pipeline: bool = False) -> Dict[str, Any
         layers["w_router"] = P(pp, None, None)
         layers["w_up"] = P(pp, EXPERT_AXIS, None, MODEL_AXIS)
         layers["w_down"] = P(pp, EXPERT_AXIS, MODEL_AXIS, None)
+        if cfg.moe_shared_expert_intermediate_size:
+            # the shared expert is dense per token: plain Megatron TP
+            layers["w_shared_up"] = col()
+            layers["w_shared_gate"] = col()
+            layers["w_shared_down"] = row()
+            layers["shared_expert_gate"] = P(pp, None, None)
     opt_specs = {
         "attn_norm_bias": vec_rep(),
         "mlp_norm_scale": vec_rep(),
@@ -363,7 +393,16 @@ def _moe_ffn(cfg: DecoderConfig, p, h):
         preferred_element_type=jnp.float32,
     )  # (B,S,E)
     topv, topi = lax.top_k(router, K)
-    gate = jax.nn.softmax(topv, axis=-1)  # (B,S,K) over selected experts
+    if cfg.moe_norm_topk:
+        # renormalize over the selected k (Mixtral; equals softmax over
+        # the selected logits)
+        gate = jax.nn.softmax(topv, axis=-1)  # (B,S,K)
+    else:
+        # softmax over ALL experts, keep the selected weights verbatim
+        # (Qwen2-MoE norm_topk_prob=False default)
+        gate = jnp.take_along_axis(
+            jax.nn.softmax(router, axis=-1), topi, axis=-1
+        )
     combine = jnp.einsum(
         "bsk,bske->bse", gate, jax.nn.one_hot(topi, E, dtype=jnp.float32)
     )  # (B,S,E)
@@ -385,8 +424,22 @@ def _moe_ffn(cfg: DecoderConfig, p, h):
     out = jnp.einsum(
         "bsef,efd,bse->bsd", act, w_down, combine,
         preferred_element_type=jnp.float32,
-    )
-    return out.astype(h.dtype)
+    ).astype(h.dtype)
+    if cfg.moe_shared_expert_intermediate_size:
+        # always-on shared expert, scaled by a sigmoid token gate
+        # (HF Qwen2MoeSparseMoeBlock shared_expert + shared_expert_gate)
+        s_up = _mm(h, p["w_shared_up"])
+        s_act = _activation(cfg, _mm(h, p["w_shared_gate"])) * s_up
+        s_out = _mm(s_act, p["w_shared_down"])
+        s_gate = jax.nn.sigmoid(
+            jnp.matmul(
+                h.astype(jnp.float32),
+                _dense_w(p["shared_expert_gate"], jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+        ).astype(h.dtype)  # (B,S,1)
+        out = out + s_gate * s_out
+    return out
 
 
 def _ffn(cfg: DecoderConfig, p, h):

@@ -32,9 +32,11 @@ def detect_family(hf_config: Dict[str, Any]):
     if mt in zoo.FAMILIES:
         return zoo.FAMILIES[mt]
     for arch in hf_config.get("architectures", []):
-        for key, mod in zoo.FAMILIES.items():
+        # longest key first: "qwen2" must not shadow "qwen2_moe" when
+        # only the architectures list is present
+        for key in sorted(zoo.FAMILIES, key=len, reverse=True):
             if key.replace("_", "") in arch.lower().replace("_", ""):
-                return mod
+                return zoo.FAMILIES[key]
     raise ValueError(f"unsupported model family: {mt!r} / "
                      f"{hf_config.get('architectures')}")
 

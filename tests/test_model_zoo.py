@@ -20,6 +20,7 @@ from flexflow_tpu.models import (
     llama,
     mistral,
     mixtral,
+    qwen2_moe,
     mpt,
     opt,
     qwen2,
@@ -110,6 +111,19 @@ def _hf_mistral():
     ), mistral
 
 
+def _hf_qwen2_moe():
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=96, shared_expert_intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        max_position_embeddings=128, decoder_sparse_step=1,
+    )
+    return transformers.Qwen2MoeForCausalLM(cfg), qwen2_moe.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), qwen2_moe
+
+
 def _hf_mixtral():
     cfg = transformers.MixtralConfig(
         vocab_size=V, hidden_size=64, intermediate_size=128,
@@ -126,6 +140,7 @@ BUILDERS = {
     "llama": _hf_llama,
     "qwen2": _hf_qwen2,
     "mixtral": _hf_mixtral,
+    "qwen2_moe": _hf_qwen2_moe,
     "mistral": _hf_mistral,
     "opt": _hf_opt,
     "falcon": _hf_falcon,
@@ -240,3 +255,38 @@ def test_mixtral_guards():
     }).sliding_window == 0
     with pytest.raises(ValueError, match="mlp_bias"):
         mixtral.config(mlp_bias=True)
+
+
+def test_qwen2_moe_norm_topk_variant():
+    """norm_topk_prob=True renormalizes the selected expert weights —
+    both router semantics must match HF exactly."""
+    torch.manual_seed(1)
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=96, shared_expert_intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        max_position_embeddings=128, decoder_sparse_step=1,
+    )
+    hf = transformers.Qwen2MoeForCausalLM(cfg).eval()
+    mcfg = qwen2_moe.from_hf(cfg.to_dict(), dtype=jnp.float32)
+    assert mcfg.moe_norm_topk
+    params = qwen2_moe.convert_hf_state_dict(hf.state_dict(), mcfg)
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, V, size=(2, 11))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.float().numpy()
+    got = np.asarray(qwen2_moe.forward(params, jnp.asarray(tokens), mcfg))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_qwen2_moe_guards():
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=128)
+    with pytest.raises(NotImplementedError, match="sparse_step"):
+        qwen2_moe.from_hf({**base, "decoder_sparse_step": 2})
+    with pytest.raises(NotImplementedError, match="sparse_step"):
+        qwen2_moe.from_hf({**base, "mlp_only_layers": [0]})
+    with pytest.raises(NotImplementedError, match="sliding"):
+        qwen2_moe.from_hf({**base, "use_sliding_window": True})

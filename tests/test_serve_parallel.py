@@ -87,12 +87,9 @@ class TestMoEServing:
         params = mixtral.init_params(jax.random.PRNGKey(3), cfg)
         return cfg, params
 
-    def _gen(self, moe_tiny, spec: MachineSpec):
-        from flexflow_tpu.models import mixtral
-
-        cfg, params = moe_tiny
+    def _gen(self, family, cfg, params, spec: MachineSpec):
         mesh = spec.make_mesh(jax.devices()[: spec.num_devices])
-        m = LLM(mixtral, cfg, params, mesh=mesh)
+        m = LLM(family, cfg, params, mesh=mesh)
         m.compile(
             ServingConfig(
                 max_requests_per_batch=4,
@@ -108,7 +105,9 @@ class TestMoEServing:
 
     @pytest.fixture(scope="class")
     def moe_reference(self, moe_tiny):
-        return self._gen(moe_tiny, MachineSpec())
+        from flexflow_tpu.models import mixtral
+
+        return self._gen(mixtral, *moe_tiny, MachineSpec())
 
     @pytest.mark.parametrize(
         "spec",
@@ -121,4 +120,17 @@ class TestMoEServing:
         ids=["ep2", "ep4", "ep2tp2", "dp2ep2tp2"],
     )
     def test_moe_layout_token_equality(self, moe_tiny, moe_reference, spec):
-        assert self._gen(moe_tiny, spec) == moe_reference
+        from flexflow_tpu.models import mixtral
+
+        assert self._gen(mixtral, *moe_tiny, spec) == moe_reference
+
+    def test_qwen2_moe_shared_expert_ep_layout(self):
+        """Qwen2-MoE (shared expert + no-renorm router) must also be
+        token-identical expert-sharded vs single device."""
+        from flexflow_tpu.models import qwen2_moe
+
+        cfg = qwen2_moe.tiny(dtype=jnp.float32)
+        params = qwen2_moe.init_params(jax.random.PRNGKey(5), cfg)
+        assert self._gen(
+            qwen2_moe, cfg, params, MachineSpec(expert=2, model=2)
+        ) == self._gen(qwen2_moe, cfg, params, MachineSpec())
