@@ -1,4 +1,4 @@
-from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, qwen2_moe, mixtral, mistral, hf_utils
+from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, qwen2_moe, mixtral, mistral, gemma, hf_utils
 
 # Model-family registry (reference python/flexflow/serve/models/__init__.py
 # maps HF architectures to FlexFlow builders; qwen2 and mixtral go beyond
@@ -14,10 +14,11 @@ FAMILIES = {
     "mixtral": mixtral,
     "mistral": mistral,
     "qwen2_moe": qwen2_moe,
+    "gemma": gemma,
 }
 
 __all__ = [
     "llama", "transformer", "opt", "falcon", "mpt", "starcoder", "qwen2",
-    "mixtral", "mistral", "qwen2_moe",
+    "mixtral", "mistral", "qwen2_moe", "gemma",
     "hf_utils", "FAMILIES",
 ]

@@ -88,6 +88,14 @@ class DecoderConfig:
     # window are masked, not evicted) — correctness first; a rolling
     # cache is a memory optimization the reference also lacks.
     sliding_window: int = 0
+    # Gemma-style knobs: a head_dim decoupled from hidden/heads (0 =
+    # derived — kept as an OVERRIDE field, not resolved at construction,
+    # so dataclasses.replace(cfg, num_attention_heads=...) re-derives
+    # instead of carrying a stale value), RMSNorm scaling by (1 + w)
+    # instead of w, and sqrt(D) input-embedding scaling.
+    head_dim_override: int = 0
+    norm_plus_one: bool = False
+    embed_scale: bool = False
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
@@ -100,7 +108,10 @@ class DecoderConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.hidden_size // self.num_attention_heads
+        return (
+            self.head_dim_override
+            or self.hidden_size // self.num_attention_heads
+        )
 
 
 def _activation(cfg: DecoderConfig, x):
@@ -119,6 +130,9 @@ def _norm(cfg: DecoderConfig, x, scale, bias):
     xf = x.astype(jnp.float32)
     if cfg.norm_type == "rmsnorm":
         r = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + cfg.norm_eps)
+        if cfg.norm_plus_one:  # Gemma: weight is an offset from 1
+            scale = 1.0 + scale.astype(jnp.float32)
+            return ((xf * r) * scale).astype(x.dtype)
         return ((xf * r).astype(x.dtype)) * scale
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
@@ -503,6 +517,8 @@ def _train_bias(cfg: DecoderConfig, positions):
 
 def _embed_in(cfg: DecoderConfig, params, tokens, positions):
     x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+    if cfg.embed_scale:  # Gemma scales inputs by sqrt(hidden)
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
     if cfg.positions == "learned":
         # mode="clip": padding slots carry the scratch-row position, which
         # exceeds the table; JAX's default out-of-bounds fill is NaN, which

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import flexflow_tpu.models as zoo
 from flexflow_tpu.models import (
     falcon,
+    gemma,
     llama,
     mistral,
     mixtral,
@@ -111,6 +112,17 @@ def _hf_mistral():
     ), mistral
 
 
+def _hf_gemma():
+    cfg = transformers.GemmaConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=32, max_position_embeddings=128,
+    )
+    return transformers.GemmaForCausalLM(cfg), gemma.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), gemma
+
+
 def _hf_qwen2_moe():
     cfg = transformers.Qwen2MoeConfig(
         vocab_size=V, hidden_size=64, intermediate_size=128,
@@ -141,6 +153,7 @@ BUILDERS = {
     "qwen2": _hf_qwen2,
     "mixtral": _hf_mixtral,
     "qwen2_moe": _hf_qwen2_moe,
+    "gemma": _hf_gemma,
     "mistral": _hf_mistral,
     "opt": _hf_opt,
     "falcon": _hf_falcon,
@@ -290,3 +303,25 @@ def test_qwen2_moe_guards():
         qwen2_moe.from_hf({**base, "mlp_only_layers": [0]})
     with pytest.raises(NotImplementedError, match="sliding"):
         qwen2_moe.from_hf({**base, "use_sliding_window": True})
+
+
+def test_gemma_guards_and_replace_safety():
+    """gemma2/gemma3 checkpoints must be rejected, not silently
+    converted; and dataclasses.replace must re-derive head_dim when no
+    override is set (the config-surgery pattern bench/examples use)."""
+    import dataclasses
+
+    with pytest.raises(NotImplementedError, match="gemma2"):
+        gemma.from_hf({
+            "model_type": "gemma2", "vocab_size": 128, "hidden_size": 64,
+            "intermediate_size": 128, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "max_position_embeddings": 128,
+        })
+    from flexflow_tpu.models.transformer import DecoderConfig
+
+    cfg = DecoderConfig(hidden_size=768, num_attention_heads=12)
+    assert cfg.head_dim == 64
+    assert dataclasses.replace(cfg, num_attention_heads=8).head_dim == 96
+    # an explicit override survives replace (it IS the knob)
+    g = gemma.tiny()
+    assert dataclasses.replace(g, num_hidden_layers=1).head_dim == 32

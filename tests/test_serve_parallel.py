@@ -134,3 +134,15 @@ class TestMoEServing:
         assert self._gen(
             qwen2_moe, cfg, params, MachineSpec(expert=2, model=2)
         ) == self._gen(qwen2_moe, cfg, params, MachineSpec())
+
+    def test_gemma_tp_layout_decoupled_head_dim(self):
+        """Gemma's decoupled head_dim (4 heads x 32 over D=64) + MQA
+        cache (replicated across TP) must be token-identical TP-sharded
+        vs single device."""
+        from flexflow_tpu.models import gemma
+
+        cfg = gemma.tiny(dtype=jnp.float32)
+        params = gemma.init_params(jax.random.PRNGKey(6), cfg)
+        assert self._gen(
+            gemma, cfg, params, MachineSpec(model=2)
+        ) == self._gen(gemma, cfg, params, MachineSpec())
