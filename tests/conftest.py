@@ -25,8 +25,8 @@ def pytest_addoption(parser):
     # Compile cost dominates the suite on the 1-core CPU box; a full run
     # exceeds a 10-minute window. `--shard i/n` deterministically
     # partitions tests so N short invocations cover everything. THREE
-    # shards fit 10-minute windows on this box (r5 full green run:
-    # 1/3 = 5:59, 2/3 = 8:33, 3/3 = 5:42 — 260 passed); use --shard i/4
+    # shards fit 10-minute windows on this box (r5 final green run:
+    # 1/3 = 8:08, 2/3 = 8:13, 3/3 = 6:39 — 284 passed); use --shard i/4
     # when a tighter (<8 min guaranteed) window is needed:
     #   for i in 1 2 3; do pytest tests/ -q --shard $i/3; done
     parser.addoption(
