@@ -1,4 +1,4 @@
-from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, qwen2_moe, mixtral, mistral, gemma, hf_utils
+from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, qwen2_moe, mixtral, mistral, gemma, phi, hf_utils
 
 # Model-family registry (reference python/flexflow/serve/models/__init__.py
 # maps HF architectures to FlexFlow builders; qwen2 and mixtral go beyond
@@ -15,10 +15,11 @@ FAMILIES = {
     "mistral": mistral,
     "qwen2_moe": qwen2_moe,
     "gemma": gemma,
+    "phi": phi,
 }
 
 __all__ = [
     "llama", "transformer", "opt", "falcon", "mpt", "starcoder", "qwen2",
-    "mixtral", "mistral", "qwen2_moe", "gemma",
+    "mixtral", "mistral", "qwen2_moe", "gemma", "phi",
     "hf_utils", "FAMILIES",
 ]

@@ -96,6 +96,10 @@ class DecoderConfig:
     head_dim_override: int = 0
     norm_plus_one: bool = False
     embed_scale: bool = False
+    # Phi-style knobs: partial rotary embeddings (only the first
+    # rotary_pct of each head rotates) and an LM-head bias.
+    rotary_pct: float = 1.0
+    lm_head_bias: bool = False
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
@@ -104,6 +108,21 @@ class DecoderConfig:
             # params would silently diverge from the configured arch
             raise ValueError(
                 "mlp_bias is not supported with num_local_experts > 0"
+            )
+        if self.lm_head_bias and self.tie_word_embeddings:
+            # a tied head has no separate lm_head tensor to bias — the
+            # configured bias would silently vanish
+            raise ValueError(
+                "lm_head_bias requires tie_word_embeddings=False"
+            )
+        rot = int(self.head_dim * self.rotary_pct)
+        if self.positions == "rope" and rot % 2:
+            # an odd rotary width would silently rotate one dim fewer
+            # than HF's partial-rope implementations
+            raise ValueError(
+                f"rotary_pct={self.rotary_pct} gives an odd rotary "
+                f"width {rot} over head_dim={self.head_dim}; pick a "
+                "fraction with an even rotated width"
             )
 
     @property
@@ -161,7 +180,11 @@ def _mm(x, w):
 
 
 def rope_freqs(cfg: DecoderConfig, positions: jnp.ndarray):
-    half = cfg.head_dim // 2
+    # partial rotary (Phi-style): only the first rotary_pct of each
+    # head rotates; cos/sin carry that width and apply_rope passes the
+    # rest of the head through untouched
+    rot = int(cfg.head_dim * cfg.rotary_pct)
+    half = rot // 2
     inv_freq = 1.0 / (
         cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
     )
@@ -171,10 +194,15 @@ def rope_freqs(cfg: DecoderConfig, positions: jnp.ndarray):
 
 
 def apply_rope(x, cos, sin):
-    half = x.shape[-1] // 2
-    x1, x2 = x[..., :half], x[..., half:]
+    rot = cos.shape[-1]
+    xr, x_pass = x[..., :rot], x[..., rot:]
+    half = rot // 2
+    x1, x2 = xr[..., :half], xr[..., half:]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
-    return (x * cos[..., None, :] + rotated * sin[..., None, :]).astype(x.dtype)
+    out = xr * cos[..., None, :] + rotated * sin[..., None, :]
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass.astype(out.dtype)], axis=-1)
+    return out.astype(x.dtype)
 
 
 def alibi_slopes(num_heads: int) -> jnp.ndarray:
@@ -279,6 +307,8 @@ def init_params(key, cfg: DecoderConfig) -> Dict[str, Any]:
         )
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(ks[9], (D, cfg.vocab_size))
+        if cfg.lm_head_bias:
+            params["lm_head_bias"] = zeros((cfg.vocab_size,))
     return params
 
 
@@ -336,6 +366,8 @@ def param_pspecs(cfg: DecoderConfig, *, pipeline: bool = False) -> Dict[str, Any
         specs["pos_embed"] = P(None, None)
     if "lm_head" in probe:
         specs["lm_head"] = P(None, MODEL_AXIS)
+    if "lm_head_bias" in probe:
+        specs["lm_head_bias"] = P(MODEL_AXIS)
     return specs
 
 
@@ -534,7 +566,10 @@ def _embed_in(cfg: DecoderConfig, params, tokens, positions):
 
 def _lm_logits(cfg: DecoderConfig, params, x):
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return jnp.matmul(x, head, preferred_element_type=jnp.float32)
+    logits = jnp.matmul(x, head, preferred_element_type=jnp.float32)
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    return logits
 
 
 def forward(

@@ -19,6 +19,7 @@ from flexflow_tpu.models import (
     falcon,
     gemma,
     llama,
+    phi,
     mistral,
     mixtral,
     qwen2_moe,
@@ -112,6 +113,19 @@ def _hf_mistral():
     ), mistral
 
 
+def _hf_phi():
+    # partial_rotary_factor=0.5 < 1 so the pass-through half of each
+    # head actually exercises the partial-rope path
+    cfg = transformers.PhiConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=128,
+    )
+    return transformers.PhiForCausalLM(cfg), phi.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), phi
+
+
 def _hf_gemma():
     cfg = transformers.GemmaConfig(
         vocab_size=V, hidden_size=64, intermediate_size=128,
@@ -154,6 +168,7 @@ BUILDERS = {
     "mixtral": _hf_mixtral,
     "qwen2_moe": _hf_qwen2_moe,
     "gemma": _hf_gemma,
+    "phi": _hf_phi,
     "mistral": _hf_mistral,
     "opt": _hf_opt,
     "falcon": _hf_falcon,
@@ -325,3 +340,16 @@ def test_gemma_guards_and_replace_safety():
     # an explicit override survives replace (it IS the knob)
     g = gemma.tiny()
     assert dataclasses.replace(g, num_hidden_layers=1).head_dim == 32
+
+
+def test_phi_guards():
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=128)
+    with pytest.raises(NotImplementedError, match="phi3"):
+        phi.from_hf({**base, "model_type": "phi3"})
+    with pytest.raises(NotImplementedError, match="qk_layernorm"):
+        phi.from_hf({**base, "qk_layernorm": True})
+    # odd rotary widths are a config error, not a silent one-dim drift
+    with pytest.raises(ValueError, match="odd rotary"):
+        phi.tiny(rotary_pct=0.45)  # head_dim 16 -> rot 7

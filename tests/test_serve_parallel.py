@@ -146,3 +146,14 @@ class TestMoEServing:
         assert self._gen(
             gemma, cfg, params, MachineSpec(model=2)
         ) == self._gen(gemma, cfg, params, MachineSpec())
+
+    def test_phi_tp_layout_partial_rotary(self):
+        """Phi's partial rotary + biased LM head (vocab-sharded bias
+        under TP) must be token-identical TP-sharded vs single device."""
+        from flexflow_tpu.models import phi
+
+        cfg = phi.tiny(dtype=jnp.float32)
+        params = phi.init_params(jax.random.PRNGKey(7), cfg)
+        assert self._gen(
+            phi, cfg, params, MachineSpec(model=2)
+        ) == self._gen(phi, cfg, params, MachineSpec())
