@@ -1,4 +1,4 @@
-from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, qwen2_moe, mixtral, mistral, gemma, phi, hf_utils
+from . import llama, transformer, opt, falcon, mpt, starcoder, qwen2, qwen2_moe, mixtral, mistral, gemma, phi, gpt2, hf_utils
 
 # Model-family registry (reference python/flexflow/serve/models/__init__.py
 # maps HF architectures to FlexFlow builders; qwen2 and mixtral go beyond
@@ -16,10 +16,11 @@ FAMILIES = {
     "qwen2_moe": qwen2_moe,
     "gemma": gemma,
     "phi": phi,
+    "gpt2": gpt2,
 }
 
 __all__ = [
     "llama", "transformer", "opt", "falcon", "mpt", "starcoder", "qwen2",
-    "mixtral", "mistral", "qwen2_moe", "gemma", "phi",
+    "mixtral", "mistral", "qwen2_moe", "gemma", "phi", "gpt2",
     "hf_utils", "FAMILIES",
 ]

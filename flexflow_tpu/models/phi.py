@@ -73,6 +73,16 @@ def tiny(**kw) -> DecoderConfig:
     return config(**d)
 
 
+_HF_ACTS = {
+    "gelu_new": "gelu_tanh",
+    "gelu_pytorch_tanh": "gelu_tanh",
+    "gelu_fast": "gelu_tanh",
+    "gelu": "gelu",
+    "relu": "relu",
+    "silu": "silu",
+}
+
+
 def from_hf(hf: Dict[str, Any], **kw) -> DecoderConfig:
     mt = hf.get("model_type", "phi")
     if mt != "phi":
@@ -89,6 +99,7 @@ def from_hf(hf: Dict[str, Any], **kw) -> DecoderConfig:
         raise NotImplementedError(
             "Phi qk_layernorm=True is not supported"
         )
+    act = hf.get("hidden_act", "gelu_new")
     d = dict(
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
@@ -102,6 +113,7 @@ def from_hf(hf: Dict[str, Any], **kw) -> DecoderConfig:
         norm_eps=hf.get("layer_norm_eps", 1e-5),
         rope_theta=hf.get("rope_theta", 10000.0),
         rotary_pct=hf.get("partial_rotary_factor", 0.5),
+        activation=_HF_ACTS.get(act, act),
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
     )
     d.update(kw)

@@ -18,6 +18,7 @@ import flexflow_tpu.models as zoo
 from flexflow_tpu.models import (
     falcon,
     gemma,
+    gpt2,
     llama,
     phi,
     mistral,
@@ -113,6 +114,15 @@ def _hf_mistral():
     ), mistral
 
 
+def _hf_gpt2():
+    cfg = transformers.GPT2Config(
+        vocab_size=V, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+    )
+    return transformers.GPT2LMHeadModel(cfg), gpt2.from_hf(
+        cfg.to_dict(), dtype=jnp.float32
+    ), gpt2
+
+
 def _hf_phi():
     # partial_rotary_factor=0.5 < 1 so the pass-through half of each
     # head actually exercises the partial-rope path
@@ -169,6 +179,7 @@ BUILDERS = {
     "qwen2_moe": _hf_qwen2_moe,
     "gemma": _hf_gemma,
     "phi": _hf_phi,
+    "gpt2": _hf_gpt2,
     "mistral": _hf_mistral,
     "opt": _hf_opt,
     "falcon": _hf_falcon,
@@ -353,3 +364,16 @@ def test_phi_guards():
     # odd rotary widths are a config error, not a silent one-dim drift
     with pytest.raises(ValueError, match="odd rotary"):
         phi.tiny(rotary_pct=0.45)  # head_dim 16 -> rot 7
+
+
+def test_gpt2_guards_and_activation():
+    base = dict(model_type="gpt2", vocab_size=128, n_embd=64, n_layer=2,
+                n_head=4, n_positions=128)
+    with pytest.raises(NotImplementedError, match="scale_attn_by"):
+        gpt2.from_hf({**base, "scale_attn_by_inverse_layer_idx": True})
+    with pytest.raises(NotImplementedError, match="scale_attn_weights"):
+        gpt2.from_hf({**base, "scale_attn_weights": False})
+    # activation comes from the checkpoint, not a hardcode
+    assert gpt2.from_hf({**base, "activation_function": "relu"}
+                        ).activation == "relu"
+    assert gpt2.from_hf(base).activation == "gelu_tanh"
