@@ -26,7 +26,7 @@ def pytest_addoption(parser):
     # exceeds a 10-minute window. `--shard i/n` deterministically
     # partitions tests so N short invocations cover everything. THREE
     # shards fit 10-minute windows on this box (r5 final green run:
-    # 1/3 = 8:08, 2/3 = 8:13, 3/3 = 6:39 — 284 passed); use --shard i/4
+    # 1/3 = 8:28, 2/3 = 8:42, 3/3 = 8:08 — 291 passed); use --shard i/4
     # when a tighter (<8 min guaranteed) window is needed:
     #   for i in 1 2 3; do pytest tests/ -q --shard $i/3; done
     parser.addoption(
