@@ -176,6 +176,9 @@ _PHASES = [
     ("train", 420, 300, False, True),
     ("parity", 600, 300, False, True),
     ("serve", 1200, 600, True, True),
+    # 64-slot paged-KV serving vs the dense 8-slot ceiling (the
+    # reference's 64 request slots, VERDICT.md round 5 missing #3)
+    ("serve_paged", 900, 600, True, True),
     ("serve_int8", 600, 400, True, True),
     ("searched", 700, 400, False, True),
     ("serve_int4", 600, 400, True, True),
@@ -241,6 +244,7 @@ def orchestrate(which):
     order = (
         "specinfer_tokens_per_sec_per_chip",
         "incr_decode_tokens_per_sec_per_chip",
+        "paged_serve_tokens_per_sec_per_chip",
         "specinfer_tokens_per_sec_7b_int4",
         "incr_decode_tokens_per_sec_int8",
         "unity_searched_train_mfu",
@@ -389,7 +393,8 @@ def _random_quantized_params(cfg, bits, seed=0):
         n: v for n, v in shapes["layers"].items()
     }))
 
-    leaves, treedef = jax.tree.flatten_with_path(shapes)
+    # tree.flatten_with_path is missing on older JAX (0.4.x)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(shapes)
 
     def build(path, sds, k):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
@@ -433,7 +438,7 @@ def train_bench(on_tpu):
     import jax
     import jax.numpy as jnp
 
-    from flexflow_tpu.core.mesh import MachineSpec
+    from flexflow_tpu.core.mesh import MachineSpec, set_mesh as _set_mesh
     from flexflow_tpu.models import llama
     from flexflow_tpu.optimizers import AdamOptimizer
 
@@ -449,7 +454,7 @@ def train_bench(on_tpu):
     ) if on_tpu else llama.LLaMAConfig.tiny(dtype=jnp.float32)
     batch, seq = (8, 1024) if on_tpu else (2, 32)
     mesh = MachineSpec().make_mesh(jax.devices()[:1])
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         init_fn, step, ds = llama.make_train_step(
             cfg, mesh, AdamOptimizer(lr=1e-4), remat=True,
             # save MXU outputs, recompute only elementwise in backward —
@@ -650,6 +655,126 @@ def serve_bench(on_tpu, kernels):
     return spec_tps
 
 
+def serve_paged_bench(on_tpu, kernels):
+    """High-concurrency serving on the paged KV cache: 64 request slots
+    (the reference's MAX_NUM_REQUESTS, request_manager.h) vs the dense
+    layout at 8 slots — the pre-paging ceiling this repo had ever been
+    exercised at (VERDICT.md round 5). Reports tokens/sec/chip at 64
+    slots and the measured KV-HBM-bytes-per-live-token (allocated pages,
+    not slots × max_len). vs_baseline is paged-64 over dense-8 on the
+    SAME platform — the acceptance bar is ≥ 1."""
+    import jax
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import InferenceEngine, RequestManager, ServingConfig
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_new = 32 if on_tpu else 8
+    prompt_len = 64 if on_tpu else 12
+    page_size = 64 if on_tpu else 16
+    if not on_tpu and kernels == "pallas":
+        # interpret-mode Pallas is a correctness vehicle, not a perf
+        # path: its per-(request, page) Python grid dominates a 64-slot
+        # CPU run. Only Mosaic-compiled kernels may carry this metric.
+        _log("serve_paged: forcing kernels=xla off-TPU (interpret-mode "
+             "pallas would dominate the measurement)")
+        kernels = "xla"
+
+    def prompts(n):
+        return [
+            [(i * 37 + j * 11 + 3) % cfg.vocab_size
+             for j in range(prompt_len)]
+            for i in range(n)
+        ]
+
+    def make_sc(n_req, layout, kern):
+        return ServingConfig(
+            max_requests_per_batch=n_req,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=32 if on_tpu else 8,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kern,
+            kv_layout=layout,
+            page_size=page_size,
+            # live tokens + one page of slack per slot — far below the
+            # 64-slot dense worst case, never preempting mid-run
+            max_cached_tokens=(
+                n_req * (prompt_len + n_new + page_size)
+                if layout == "paged" else None
+            ),
+        )
+
+    def timed(rm, n_req):
+        rm.generate(prompts(n_req), max_new_tokens=4)  # warm/compile
+        best = 0.0
+        for _ in range(2):  # best-of-2: host-side noise dominates small runs
+            t0 = time.perf_counter()
+            outs = rm.generate(prompts(n_req), max_new_tokens=n_new)
+            dt = time.perf_counter() - t0
+            best = max(best, sum(len(o.output_tokens) for o in outs) / dt)
+        return best
+
+    # --- dense ceiling: 8 slots (kernels kept apples-to-apples) ---
+    dense_rm, kernels = _make_rm(
+        llama, cfg, params,
+        lambda k: make_sc(8, "dense", k), prompts(8), kernels,
+    )
+    dense_tps = timed(dense_rm, 8)
+    del dense_rm
+
+    # --- paged: 64 slots on the same model ---
+    rm, kernels = _make_rm(
+        llama, cfg, params,
+        lambda k: make_sc(64, "paged", k), prompts(64), kernels,
+    )
+    eng = rm.engine
+
+    # measured bytes/live-token: admit all 64, step through prefill,
+    # snapshot allocated pages vs live tokens mid-flight
+    rids = [rm.register_request(p) for p in prompts(64)]
+    for _ in range(4):
+        rm.step()
+    live_tokens = sum(
+        rm.requests[r].n_cached
+        for r in rids if rm.requests[r].slot >= 0
+    )
+    bytes_per_live_token = (
+        eng.kv_allocated_bytes() / max(1, live_tokens)
+    )
+    dense64_equiv = 64 * (eng.serving.cache_len + 1) * eng.kv_bytes_per_line()
+    while rm.step():
+        pass  # drain before the timed run
+
+    paged_tps = timed(rm, 64)
+    emit(
+        "paged_kv_hbm_bytes_per_live_token",
+        round(bytes_per_live_token, 1),
+        "bytes/token",
+        # ideal = K+V line bytes; ratio over it is pure paging overhead
+        vs_baseline=bytes_per_live_token / eng.kv_bytes_per_line(),
+        kv_pool_bytes=eng.kv_cache_bytes(),
+        dense_64slot_equiv_bytes=int(dense64_equiv),
+        page_size=page_size,
+        platform=_platform(),
+    )
+    emit(
+        "paged_serve_tokens_per_sec_per_chip",
+        round(paged_tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=paged_tps / max(1e-9, dense_tps),
+        kernels=kernels,
+        n_requests=64,
+        dense_8slot_tokens_per_sec=round(dense_tps, 2),
+        new_tokens_per_request=n_new,
+        kv_hbm_bytes_per_live_token=round(bytes_per_live_token, 1),
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return paged_tps
+
+
 def serve_quantized_bench(on_tpu, kernels, bits):
     """Weight-only int8/int4 serving (reference --8bit/4bit-quantization,
     file_loader.cc:651,710 + decompress kernels): decode is
@@ -796,6 +921,8 @@ def child_main(phase, platform, kernels):
         kernel_parity(on_tpu)
     elif phase == "serve":
         serve_bench(on_tpu, kernels)
+    elif phase == "serve_paged":
+        serve_paged_bench(on_tpu, kernels)
     elif phase == "serve_int8":
         serve_quantized_bench(on_tpu, kernels, bits=8)
     elif phase == "serve_int4":
@@ -812,7 +939,7 @@ def main():
         "--metric",
         default="all",
         choices=["all", "train", "searched", "parity", "serve",
-                 "serve_int8", "serve_int4", "serve_7b"],
+                 "serve_paged", "serve_int8", "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)

@@ -94,6 +94,9 @@ def cmd_serve(args):
         max_requests_per_batch=args.max_requests_per_batch,
         max_sequence_length=args.max_sequence_length,
         kernels="pallas" if args.pallas else "xla",
+        kv_layout=args.kv_layout,
+        page_size=args.page_size,
+        max_cached_tokens=args.max_cached_tokens,
     )
     ssms = []
     spec = None
@@ -184,6 +187,14 @@ def main(argv=None):
     s.add_argument("--quantization", choices=["int8", "int4"], default=None)
     s.add_argument("--offload", action="store_true")
     s.add_argument("--pallas", action="store_true")
+    s.add_argument("--kv-layout", choices=["dense", "paged"], default="dense",
+                   help="paged = block-paged KV cache (HBM scales with "
+                        "live tokens; enables high request concurrency)")
+    s.add_argument("--page-size", type=int, default=128)
+    s.add_argument("--max-cached-tokens", type=int, default=None,
+                   help="paged KV pool budget in tokens (default: worst "
+                        "case slots*max_len; smaller oversubscribes with "
+                        "recompute preemption)")
     # reference -output-file (request_manager.cc:417-440): append each
     # finished request's latency/steps/token-ids
     s.add_argument("--output-file", "-output-file", default=None)

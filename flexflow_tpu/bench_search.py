@@ -13,6 +13,8 @@ src/runtime/graph.cc:2108 + model.cc:3347).
 """
 from __future__ import annotations
 
+from .core.mesh import set_mesh as _set_mesh
+
 import time
 from typing import Any, Dict, Optional
 
@@ -108,7 +110,7 @@ def searched_train_mfu(
     rng = np.random.default_rng(0)
     data = rng.integers(0, V, size=(B, S + 1)).astype(np.int32)
     inputs, labels = {"tokens": data[:, :-1][:, :S]}, data[:, 1 : S + 1]
-    with jax.set_mesh(ff.mesh):
+    with _set_mesh(ff.mesh):
         batch = ff._shard_batch(inputs)
         yb = ff._shard_batch({"y": labels})["y"]
         key = jax.random.PRNGKey(0)

@@ -109,6 +109,18 @@ class MachineSpec:
         return cls(data=data, expert=expert, pipe=pipeline, seq=sequence, model=tensor)
 
 
+def set_mesh(mesh: Mesh):
+    """Context manager installing ``mesh`` as the ambient mesh.
+
+    ``jax.set_mesh`` where it exists (JAX >= 0.6); on older releases
+    (this container ships 0.4.x, where the attribute is missing and
+    every call site died with AttributeError) the ``Mesh`` object's own
+    context manager provides the same ambient-mesh scoping the call
+    sites need."""
+    fn = getattr(jax, "set_mesh", None)
+    return fn(mesh) if fn is not None else mesh
+
+
 def single_device_spec() -> MachineSpec:
     return MachineSpec()
 

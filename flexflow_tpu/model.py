@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .core.mesh import set_mesh as _set_mesh
 from .config import FFConfig, get_config
 from .core.dtypes import DataType
 from .core.graph import Graph, OpNode, TensorRef
@@ -978,7 +979,7 @@ class FFModel:
 
         # ---- initialise params/opt-state on device, sharded ----
         init_key = jax.random.PRNGKey(self.seed)
-        with jax.set_mesh(mesh):
+        with _set_mesh(mesh):
             params_shardings = to_sharding(param_pspecs)
             self.params = jax.jit(
                 self.init_params, out_shardings=params_shardings
@@ -1118,7 +1119,7 @@ class FFModel:
             for xb, yb in epoch_batches(epoch):
                 # per-step mesh context: a recompile triggered by
                 # recompile_on_condition may install a NEW mesh mid-epoch
-                with jax.set_mesh(self.mesh):
+                with _set_mesh(self.mesh):
                     batch = self._shard_batch(xb)
                     yb_dev = self._shard_batch({"y": yb})["y"]
                     step_rng = jax.random.PRNGKey(
@@ -1167,7 +1168,7 @@ class FFModel:
             x = {names[0]: x}
         n = len(y)
         perf = PerfMetrics()
-        with jax.set_mesh(self.mesh):
+        with _set_mesh(self.mesh):
             for s in range(n // bs):
                 sl = slice(s * bs, (s + 1) * bs)
                 batch = self._shard_batch({k: v[sl] for k, v in x.items()})
@@ -1182,7 +1183,7 @@ class FFModel:
         assert self._fwd is not None, "call compile() first"
         if not isinstance(inputs, dict):
             inputs = {self._input_names()[0]: inputs}
-        with jax.set_mesh(self.mesh):
+        with _set_mesh(self.mesh):
             return self._fwd(self.params, self.model_state, inputs)
 
     # ------------------------------------------------------------------
@@ -1340,7 +1341,7 @@ class FFModel:
         snap = jax.device_get(live)
         shardings = jax.tree.map(lambda a: a.sharding, live)
         try:
-            with jax.set_mesh(self.mesh):
+            with _set_mesh(self.mesh):
                 batch = self._shard_batch(x)
                 yb = self._shard_batch({"y": y})["y"]
                 key = jax.random.PRNGKey(0)
@@ -1361,7 +1362,7 @@ class FFModel:
             # the first warm step donated the live buffers — restore even
             # when the timing loop dies, or every later fit() hits
             # "Array has been deleted"
-            with jax.set_mesh(self.mesh):
+            with _set_mesh(self.mesh):
                 self.params, self.opt_state, self.model_state = jax.tree.map(
                     jax.device_put, snap, shardings
                 )

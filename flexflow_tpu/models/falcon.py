@@ -14,14 +14,20 @@ from . import transformer
 from .transformer import (  # noqa: F401  (engine serving protocol)
     DecoderConfig,
     commit_kv,
+    commit_kv_paged,
     forward,
     init_kv_cache,
+    init_paged_kv_cache,
     init_params,
     kv_cache_pspecs,
     num_params,
+    paged_kv_cache_pspecs,
     param_pspecs,
     reorder_slots,
+    reorder_slots_paged,
+    serve_debug_activations,
     serve_step,
+    serve_step_paged,
 )
 from .hf_utils import linear_w, stack, to_np
 

@@ -686,6 +686,8 @@ def serve_debug_activations(
     *,
     cfg: LLaMAConfig,
     kernels: str = "xla",
+    page_table: Optional[jnp.ndarray] = None,
+    cache_len: Optional[int] = None,
 ):
     """Per-layer hidden-state capture for ``inference_debugging``
     (reference's per-op tensor dump mode, serve/__init__.py:48 —
@@ -693,17 +695,31 @@ def serve_debug_activations(
     layer stack as an eager Python loop instead of ``lax.scan`` so every
     layer's output survives as its own array; cache writes are computed
     and DISCARDED (the caller's donating step does the real commit).
-    Deliberately slow — a triage tool, not a serving path."""
+    Deliberately slow — a triage tool, not a serving path. With
+    ``page_table`` the paged layout is read/written through the table."""
     if cache_positions is None:
         cache_positions = positions
-    S1 = cache["k"].shape[2]
     x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
     cos, sin = rope_freqs(cfg, positions)
+    acts = []
+    if page_table is not None:  # paged layout
+        ps = cache["k"].shape[2]
+        mask = _paged_mask(mask, positions, page_table, ps, cache_len)
+        phys, off = _page_lookup(page_table, cache_positions, ps)
+        for l in range(cfg.num_hidden_layers):
+            p_l = jax.tree.map(lambda a: a[l], params["layers"])
+            x, _, _ = serve_block_paged(
+                cfg, p_l, x, cos, sin, mask,
+                cache["k"][l], cache["v"][l], phys, off, page_table,
+                kernels,
+            )
+            acts.append(x)
+        return acts
+    S1 = cache["k"].shape[2]
     if mask is None:
         key_pos = jnp.arange(S1, dtype=jnp.int32)
         mask = key_pos[None, None, :] <= positions[:, :, None]
         mask = mask & (key_pos[None, None, :] < S1 - 1)
-    acts = []
     for l in range(cfg.num_hidden_layers):
         p_l = jax.tree.map(lambda a: a[l], params["layers"])
         x, _, _ = serve_block(
@@ -712,6 +728,199 @@ def serve_debug_activations(
         )
         acts.append(x)
     return acts
+
+
+# ---------------------------------------------------------------------------
+# Paged serving path (Ragged Paged Attention layout, PAPERS.md arxiv
+# 2604.15464): K/V live in a pool of fixed-size token pages shared by all
+# request slots; each slot's page table maps logical cache lines
+# (line // page_size) to physical pages. HBM is proportional to pages
+# allocated — live tokens — instead of slots × max_len, which is what
+# lets one chip serve the reference's 64 request slots. The XLA path
+# gathers the virtual cache through the table with ``jnp.take`` and runs
+# the exact dense serve_attention math (bit-for-bit parity with the
+# dense layout); ``kernels="pallas"`` routes through the fused ragged
+# paged kernel (serve/kernels.py) which DMAs pages directly.
+
+
+def init_paged_kv_cache(
+    cfg: LLaMAConfig, num_pages: int, page_size: int, dtype=None
+) -> Dict[str, jnp.ndarray]:
+    """Paged pool: (L, num_pages+1, page_size, KV, dk). Pool row
+    ``num_pages`` is the shared scratch page — unallocated page-table
+    entries point there, so padding writes and gathers through
+    unallocated entries never touch live pages (the paged analog of the
+    dense layout's per-slot scratch row)."""
+    L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
+    dt = dtype or cfg.dtype
+    shape = (L, num_pages + 1, page_size, KV, dk)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_kv_cache_pspecs(
+    cfg: Optional[LLaMAConfig] = None, *, pipeline: bool = False
+) -> Dict[str, P]:
+    """Pages shard over DP on the pool dim, KV heads over TP on the
+    model axis (same head axis the attention shards on) — tensor-
+    parallel serving keeps working; MQA (KV=1) replicates as in the
+    dense layout."""
+    kv_axis = (
+        None if (cfg is not None and cfg.num_key_value_heads == 1)
+        else MODEL_AXIS
+    )
+    pp = PIPE_AXIS if pipeline else None
+    return {
+        "k": P(pp, DATA_AXIS, None, kv_axis, None),
+        "v": P(pp, DATA_AXIS, None, kv_axis, None),
+    }
+
+
+def _page_lookup(page_table: jnp.ndarray, cache_positions: jnp.ndarray,
+                 page_size: int):
+    """(R, NP) table × (R, C) cache lines → physical page + in-page
+    offset, each (R, C)."""
+    logical = cache_positions // page_size
+    phys = jnp.take_along_axis(page_table, logical, axis=1)
+    return phys, cache_positions % page_size
+
+
+def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
+                      k_pool, v_pool, phys, off, page_table,
+                      kernels: str = "xla"):
+    """One block on a paged serving step: scatter new K/V at the
+    table-resolved (physical page, offset), attend over the virtual
+    cache read through the page table."""
+    R, C, D = x.shape
+    H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    h = _rms(x, p["attn_norm"], cfg.rms_norm_eps)
+    q = _mm(h, p["wq"]).reshape(R, C, H, dk)
+    k = _mm(h, p["wk"]).reshape(R, C, KV, dk)
+    v = _mm(h, p["wv"]).reshape(R, C, KV, dk)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
+    v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
+    from ..serve import kernels as _pk
+
+    if kernels == "pallas":
+        attn = _pk.ragged_paged_attention(q, k_pool, v_pool, page_table, mask)
+        attn = attn.reshape(R, C, H * dk)
+    else:
+        k_virt = _pk.gather_pages(k_pool, page_table)
+        v_virt = _pk.gather_pages(v_pool, page_table)
+        attn = serve_attention(cfg, q, k_virt, v_virt, mask)
+    x = x + _mm(attn, p["wo"])
+    h2 = _rms(x, p["ffn_norm"], cfg.rms_norm_eps)
+    ffn = _mm(jax.nn.silu(_mm(h2, p["w1"])) * _mm(h2, p["w3"]), p["w2"])
+    return x + ffn, k_pool, v_pool
+
+
+def _paged_mask(mask, positions, page_table, page_size, cache_len):
+    """Default causal-by-position mask over the virtual cache, or an
+    explicit (R, C, cache_len+1) mask padded out to the page-aligned
+    virtual length (padding is never-attended)."""
+    S_virt = page_table.shape[1] * page_size
+    if mask is None:
+        key_pos = jnp.arange(S_virt, dtype=jnp.int32)
+        mask = key_pos[None, None, :] <= positions[:, :, None]
+        # never the scratch line (padding tokens write there)
+        return mask & (key_pos[None, None, :] < cache_len)
+    if mask.shape[-1] < S_virt:
+        pad = S_virt - mask.shape[-1]
+        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, pad)))
+    return mask
+
+
+def serve_step_paged(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,      # (R, C)
+    positions: jnp.ndarray,   # (R, C) RoPE/sequence positions
+    logits_idx: jnp.ndarray,  # (R,)
+    mask: Optional[jnp.ndarray],  # (R, C, cache_len+1) bool or None
+    cache_positions: Optional[jnp.ndarray],  # (R, C) cache line idx
+    page_table: jnp.ndarray,  # (R, NP) int32
+    *,
+    cfg: LLaMAConfig,
+    cache_len: int,
+    all_logits: bool = False,
+    kernels: str = "xla",
+    mesh=None,
+):
+    """Paged twin of :func:`serve_step` — same contract plus the
+    per-slot page table; prefill chunks, single-token decode and
+    tree-verify all read/write K/V through the table."""
+    if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
+        raise NotImplementedError(
+            "paged KV serving is not composed with pipeline parallelism "
+            "yet — use kv_layout='dense' with pipe>1"
+        )
+    if cache_positions is None:
+        cache_positions = positions
+    ps = cache["k"].shape[2]
+    x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+    cos, sin = rope_freqs(cfg, positions)
+    mask = _paged_mask(mask, positions, page_table, ps, cache_len)
+    phys, off = _page_lookup(page_table, cache_positions, ps)
+
+    def scan_body(h, xs):
+        p_l, kc, vc = xs
+        h, kc, vc = serve_block_paged(
+            cfg, p_l, h, cos, sin, mask, kc, vc, phys, off, page_table,
+            kernels,
+        )
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rms(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    if not all_logits:
+        x = jnp.take_along_axis(x, logits_idx[:, None, None], axis=1)
+        logits = jnp.matmul(x, head, preferred_element_type=jnp.float32)[:, 0]
+    else:
+        logits = jnp.matmul(x, head, preferred_element_type=jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def commit_kv_paged(
+    cache: Dict[str, jnp.ndarray],
+    page_table: jnp.ndarray,  # (R, NP) int32
+    src: jnp.ndarray,         # (R, K) int32 cache lines (tree node lines)
+    dst: jnp.ndarray,         # (R, K) int32 destination lines
+) -> Dict[str, jnp.ndarray]:
+    """:func:`commit_kv` through the page table: accepted speculative
+    lines move between table-resolved (page, offset) pairs. Functional
+    gather-then-scatter, so overlapping ranges stay safe; scratch→
+    scratch no-ops are harmless duplicates (identical values)."""
+    ps = cache["k"].shape[2]
+    s_phys, s_off = _page_lookup(page_table, src, ps)
+    d_phys, d_off = _page_lookup(page_table, dst, ps)
+    out = {}
+    for name, buf in cache.items():  # (L, P+1, ps, KV, dk)
+        rows = buf[:, s_phys, s_off]  # (L, R, K, KV, dk)
+        out[name] = buf.at[:, d_phys, d_off].set(rows)
+    return out
+
+
+def reorder_slots_paged(
+    cache: Dict[str, jnp.ndarray],
+    page_table: jnp.ndarray,  # (R, NP) int32
+    src: jnp.ndarray,         # (R,) int32
+) -> Dict[str, jnp.ndarray]:
+    """:func:`reorder_slots` for the paged layout: page OWNERSHIP stays
+    with each slot (the host table is untouched) and page CONTENT is
+    copied — new slot r's pages receive slot src[r]'s lines. Requires
+    the destination slots to have (at least) the source slots' pages
+    allocated, which beam search guarantees by construction (equal-
+    length hypotheses)."""
+    src_pages = page_table[src].reshape(-1)   # (R*NP,)
+    dst_pages = page_table.reshape(-1)
+    return {
+        name: buf.at[:, dst_pages].set(buf[:, src_pages])
+        for name, buf in cache.items()
+    }
 
 
 def commit_kv(

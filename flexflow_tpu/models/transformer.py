@@ -837,6 +837,284 @@ def reorder_slots(
     }
 
 
+# ---------------------------------------------------------------------------
+# Paged serving path (Ragged Paged Attention layout — see the twin
+# implementation in models/llama.py for the design rationale): the pool
+# replaces the per-slot line dim with (pages+1, page_size); page tables
+# resolve logical cache lines to physical pages. The extra per-line
+# position buffer (ALiBi/sliding-window families) pages the same way.
+
+
+def init_paged_kv_cache(
+    cfg: DecoderConfig, num_pages: int, page_size: int, dtype=None
+):
+    """Pool (L, num_pages+1, page_size, KV, dk); pool row ``num_pages``
+    is the shared scratch page. ALiBi/sliding-window configs also page
+    the per-line position buffer."""
+    L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
+    dt = dtype or cfg.dtype
+    shape = (L, num_pages + 1, page_size, KV, dk)
+    cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if needs_pos_cache(cfg):
+        cache["pos"] = jnp.zeros((num_pages + 1, page_size), jnp.int32)
+    return cache
+
+
+def paged_kv_cache_pspecs(cfg: DecoderConfig = None, *, pipeline: bool = False):
+    """Pages shard over DP, KV heads over TP (MQA replicates, as in the
+    dense layout)."""
+    kv_axis = (
+        None if (cfg is not None and cfg.num_key_value_heads == 1)
+        else MODEL_AXIS
+    )
+    pp = PIPE_AXIS if pipeline else None
+    specs = {
+        "k": P(pp, DATA_AXIS, None, kv_axis, None),
+        "v": P(pp, DATA_AXIS, None, kv_axis, None),
+    }
+    if cfg is not None and needs_pos_cache(cfg):
+        specs["pos"] = P(DATA_AXIS, None)
+    return specs
+
+
+def _page_lookup(page_table, cache_positions, page_size):
+    logical = cache_positions // page_size
+    phys = jnp.take_along_axis(page_table, logical, axis=1)
+    return phys, cache_positions % page_size
+
+
+def serve_block_paged(cfg, p, x, rope, bias, mask, k_pool, v_pool,
+                      phys, off, page_table, kernels: str = "xla"):
+    """Paged twin of :func:`serve_block`: scatter new K/V at the
+    table-resolved (page, offset); attend over the virtual cache read
+    through the table (``jnp.take`` gather, or the fused ragged paged
+    kernel when ``kernels='pallas'`` and no additive bias is in play)."""
+    from ..serve import kernels as _pk
+
+    R, C, D = x.shape
+    h = _norm(cfg, x, p["attn_norm_scale"], p.get("attn_norm_bias"))
+    q, k, v = _project_qkv(cfg, p, h)
+    if rope is not None:
+        cos, sin = rope
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
+    v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
+    if kernels == "pallas" and bias is None:
+        attn = _pk.ragged_paged_attention(q, k_pool, v_pool, page_table, mask)
+        attn = attn.reshape(R, C, -1)
+    else:
+        k_virt = _pk.gather_pages(k_pool, page_table)
+        v_virt = _pk.gather_pages(v_pool, page_table)
+        attn = _serve_attend(cfg, q, k_virt, v_virt, bias, mask)
+    attn = _mm(attn, p["wo"])
+    if cfg.out_bias:
+        attn = attn + p["bo"]
+    if cfg.parallel_block:
+        if cfg.parallel_two_norms:
+            h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
+        else:
+            h2 = h
+        return x + attn + _ffn(cfg, p, h2), k_pool, v_pool
+    x = x + attn
+    h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
+    return x + _ffn(cfg, p, h2), k_pool, v_pool
+
+
+def _paged_serve_context(cfg, cache, positions, cache_positions, mask,
+                         page_table, cache_len):
+    """Shared prologue of the paged step/debug paths: page lookup, the
+    causal-or-padded mask over the virtual cache, and the paged position
+    buffer + ALiBi bias/sliding-window refinement."""
+    from ..serve.kernels import gather_pages
+
+    ps = cache["k"].shape[2]
+    S_virt = page_table.shape[1] * ps
+    phys, off = _page_lookup(page_table, cache_positions, ps)
+    if mask is None:
+        key_pos = jnp.arange(S_virt, dtype=jnp.int32)
+        mask = key_pos[None, None, :] <= positions[:, :, None]
+        mask = mask & (key_pos[None, None, :] < cache_len)  # scratch line
+    elif mask.shape[-1] < S_virt:
+        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, S_virt - mask.shape[-1])))
+
+    bias = None
+    pos_pool = None
+    if needs_pos_cache(cfg):
+        pos_pool = cache["pos"].at[phys, off].set(positions.astype(jnp.int32))
+        pos_virt = gather_pages(pos_pool, page_table)  # (R, S_virt)
+        if cfg.positions == "alibi":
+            slopes = alibi_slopes(cfg.num_attention_heads)
+            dist = (
+                positions.astype(jnp.float32)[:, None, :, None]
+                - pos_virt.astype(jnp.float32)[:, None, None, :]
+            )
+            bias = -slopes[None, :, None, None] * dist
+        if cfg.sliding_window:
+            mask = mask & (
+                pos_virt[:, None, :]
+                > positions[:, :, None] - cfg.sliding_window
+            )
+    return phys, off, mask, bias, pos_pool
+
+
+def serve_step_paged(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,      # (R, C)
+    positions: jnp.ndarray,   # (R, C)
+    logits_idx: jnp.ndarray,  # (R,)
+    mask: Optional[jnp.ndarray],   # (R, C, cache_len+1) bool or None
+    cache_positions: Optional[jnp.ndarray],
+    page_table: jnp.ndarray,  # (R, NP) int32
+    *,
+    cfg: DecoderConfig,
+    cache_len: int,
+    all_logits: bool = False,
+    kernels: str = "xla",
+    mesh=None,
+):
+    """Paged twin of :func:`serve_step` — same contract plus the page
+    table (see models/llama.py serve_step_paged)."""
+    if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
+        raise NotImplementedError(
+            "paged KV serving is not composed with pipeline parallelism "
+            "yet — use kv_layout='dense' with pipe>1"
+        )
+    if cache_positions is None:
+        cache_positions = positions
+    x = _embed_in(cfg, params, tokens, positions)
+    rope = rope_freqs(cfg, positions) if cfg.positions == "rope" else None
+    phys, off, mask, bias, pos_pool = _paged_serve_context(
+        cfg, cache, positions, cache_positions, mask, page_table, cache_len
+    )
+
+    def scan_body(h, xs):
+        p_l, kc, vc = xs
+        h, kc, vc = serve_block_paged(
+            cfg, p_l, h, rope, bias, mask, kc, vc, phys, off, page_table,
+            kernels,
+        )
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
+    if not all_logits:
+        x = jnp.take_along_axis(x, logits_idx[:, None, None], axis=1)
+        logits = _lm_logits(cfg, params, x)[:, 0]
+    else:
+        logits = _lm_logits(cfg, params, x)
+    new_cache = {"k": k_new, "v": v_new}
+    if needs_pos_cache(cfg):
+        new_cache["pos"] = pos_pool
+    return logits, new_cache
+
+
+def commit_kv_paged(cache, page_table, src, dst):
+    """:func:`commit_kv` through the page table (see
+    models.llama.commit_kv_paged); the position pool pages like K/V but
+    without the layer dim."""
+    ps = cache["k"].shape[2]
+    s_phys, s_off = _page_lookup(page_table, src, ps)
+    d_phys, d_off = _page_lookup(page_table, dst, ps)
+    out = {}
+    for name, buf in cache.items():
+        if name == "pos":  # (P+1, ps)
+            out[name] = buf.at[d_phys, d_off].set(buf[s_phys, s_off])
+        else:              # (L, P+1, ps, KV, dk)
+            out[name] = buf.at[:, d_phys, d_off].set(buf[:, s_phys, s_off])
+    return out
+
+
+def reorder_slots_paged(cache, page_table, src):
+    """Page-content copy between slots' own pages (see
+    models.llama.reorder_slots_paged)."""
+    src_pages = page_table[src].reshape(-1)
+    dst_pages = page_table.reshape(-1)
+    out = {}
+    for name, buf in cache.items():
+        if name == "pos":
+            out[name] = buf.at[dst_pages].set(buf[src_pages])
+        else:
+            out[name] = buf.at[:, dst_pages].set(buf[:, src_pages])
+    return out
+
+
+def serve_debug_activations(
+    params: Dict[str, Any],
+    cache: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    cache_positions: Optional[jnp.ndarray] = None,
+    *,
+    cfg: DecoderConfig,
+    kernels: str = "xla",
+    page_table: Optional[jnp.ndarray] = None,
+    cache_len: Optional[int] = None,
+):
+    """Per-layer hidden-state capture for ``inference_debugging`` on the
+    generic decoder — previously the hook only existed for LLaMA, making
+    the switch a silent no-op for every other family (ADVICE.md round
+    5). Eager Python loop so each layer's output survives as its own
+    array; cache writes are computed and DISCARDED (the engine's
+    donating step does the real commit). ``kernels`` is accepted for
+    signature parity with the engine's call and ignored — the triage
+    path is deliberately the plain XLA one."""
+    del kernels  # triage runs the reference XLA math
+    if cache_positions is None:
+        cache_positions = positions
+    x = _embed_in(cfg, params, tokens, positions)
+    rope = rope_freqs(cfg, positions) if cfg.positions == "rope" else None
+    acts = []
+    if page_table is not None:  # paged layout
+        phys, off, mask, bias, _ = _paged_serve_context(
+            cfg, cache, positions, cache_positions, mask, page_table,
+            cache_len,
+        )
+        for l in range(cfg.num_hidden_layers):
+            p_l = jax.tree.map(lambda a: a[l], params["layers"])
+            x, _, _ = serve_block_paged(
+                cfg, p_l, x, rope, bias, mask,
+                cache["k"][l], cache["v"][l], phys, off, page_table,
+            )
+            acts.append(x)
+        return acts
+    R = tokens.shape[0]
+    S1 = cache["k"].shape[2]
+    if mask is None:
+        key_pos = jnp.arange(S1, dtype=jnp.int32)
+        mask = key_pos[None, None, :] <= positions[:, :, None]
+        mask = mask & (key_pos[None, None, :] < S1 - 1)
+    bias = None
+    if needs_pos_cache(cfg):
+        bidx = jnp.arange(R)[:, None]
+        pos_cache = cache["pos"].at[bidx, cache_positions].set(
+            positions.astype(jnp.int32)
+        )
+        if cfg.positions == "alibi":
+            slopes = alibi_slopes(cfg.num_attention_heads)
+            dist = (
+                positions.astype(jnp.float32)[:, None, :, None]
+                - pos_cache.astype(jnp.float32)[:, None, None, :]
+            )
+            bias = -slopes[None, :, None, None] * dist
+        if cfg.sliding_window:
+            mask = mask & (
+                pos_cache[:, None, :]
+                > positions[:, :, None] - cfg.sliding_window
+            )
+    for l in range(cfg.num_hidden_layers):
+        p_l = jax.tree.map(lambda a: a[l], params["layers"])
+        x, _, _ = serve_block(
+            cfg, p_l, x, rope, bias, mask,
+            cache["k"][l], cache["v"][l], cache_positions,
+        )
+        acts.append(x)
+    return acts
+
+
 def num_params(cfg: DecoderConfig) -> int:
     shapes = init_shapes(cfg)
     return sum(

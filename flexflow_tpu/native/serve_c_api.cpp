@@ -89,9 +89,20 @@ int ff_serve_register_request(const int32_t* tokens, int n, int max_new) {
   PyObject* lst = PyList_New(n);
   if (lst == nullptr) return -1;
   for (int i = 0; i < n; ++i) {
-    PyList_SET_ITEM(lst, i, PyLong_FromLong(tokens[i]));
+    PyObject* tok = PyLong_FromLong(tokens[i]);
+    if (tok == nullptr) {  // SET_ITEM would store a null element
+      PyErr_Print();
+      Py_DECREF(lst);
+      return -1;
+    }
+    PyList_SET_ITEM(lst, i, tok);
   }
   PyObject* args = Py_BuildValue("(Ni)", lst, max_new);  // N steals lst
+  if (args == nullptr) {  // on failure N does NOT release the list
+    PyErr_Print();
+    Py_DECREF(lst);
+    return -1;
+  }
   return static_cast<int>(call_long("register_request", args));
 }
 
@@ -125,6 +136,10 @@ int ff_serve_fetch(int request_id, int32_t* out, int cap) {
     return -1;
   }
   if (r == Py_None) {
+    Py_DECREF(r);
+    return -1;
+  }
+  if (!PyList_Check(r)) {  // PyList_Size on a non-list is fatal/-1+err
     Py_DECREF(r);
     return -1;
   }

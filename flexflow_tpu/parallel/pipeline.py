@@ -24,7 +24,27 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax (0.4.x) — same fallback as parallel/sequence
+    from jax.experimental.shard_map import shard_map
+
+
+def _partial_shard_map(fn, mesh, in_specs, out_specs, manual_axes):
+    """Partial-manual shard_map across jax versions: new jax names the
+    MANUAL axes (``axis_names`` + ``check_vma``); 0.4.x names the
+    complement (``auto`` + ``check_rep``)."""
+    try:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            axis_names=frozenset(manual_axes), check_vma=False,
+        )
+    except TypeError:
+        auto = frozenset(mesh.axis_names) - frozenset(manual_axes)
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False, auto=auto,
+        )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.mesh import DATA_AXIS, PIPE_AXIS
@@ -226,13 +246,11 @@ def make_pipelined_serve(
         )
         return out, caches_out
 
-    return shard_map(
-        inner,
-        mesh=mesh,
-        in_specs=(params_spec, cache_spec, x_spec, row_specs),
-        out_specs=(x_spec, cache_spec),
-        axis_names=frozenset({PIPE_AXIS, DATA_AXIS}),
-        check_vma=False,
+    return _partial_shard_map(
+        inner, mesh,
+        (params_spec, cache_spec, x_spec, row_specs),
+        (x_spec, cache_spec),
+        {PIPE_AXIS, DATA_AXIS},
     )
 
 
@@ -273,11 +291,6 @@ def make_pipelined_apply(
     # Partial-manual mode: only the pipe axis is manual; data/model axes
     # remain under GSPMD, so DP batch sharding and Megatron TP compose
     # with the pipeline loop without manual collectives for them.
-    return shard_map(
-        inner,
-        mesh=mesh,
-        in_specs=(params_spec, x_spec),
-        out_specs=x_spec,
-        axis_names=frozenset({PIPE_AXIS}),
-        check_vma=False,
+    return _partial_shard_map(
+        inner, mesh, (params_spec, x_spec), x_spec, {PIPE_AXIS},
     )

@@ -99,24 +99,51 @@ class TPUTopology:
     def axis_latency(self, axis: str) -> float:
         return self.dcn_latency if axis in self.dcn_axes else self.per_hop_latency
 
-    def axis_link_multiplicity(self, axis: str, degree: int = 0) -> int:
+    def axis_link_multiplicity(
+        self,
+        axis: str,
+        degree: int = 0,
+        axis_degrees: Optional[Dict[str, int]] = None,
+    ) -> int:
         """How many ICI links a ring collective over ``axis`` can stripe
         across. DCN axes get 1 (one NIC path). On a physical torus, an
         axis covering k torus dimensions rides 2k links (bidirectional
         ring per dimension): a model-axis all-reduce on a v5e 4x4 slice
-        is ~2x the single-ring estimate, and a whole-slice axis ~4x."""
+        is ~2x the single-ring estimate, and a whole-slice axis ~4x.
+
+        ``axis_degrees`` (full mesh axis → degree map) places ``axis``
+        on the torus correctly: mesh axes map onto ICI innermost-first
+        (``core.mesh.AXIS_ORDER`` — ``model`` rides the fastest links),
+        so an outer axis starts at the torus dim where the inner ICI
+        axes left off. Without it every axis was assumed to start at
+        torus dim 0, over-crediting outer axes on asymmetric tori (a
+        data axis of 8 on a 2x8 torus rides the single size-8 dim → 2
+        links, not the 4 the dim-0 walk claimed)."""
         if axis in self.dcn_axes:
             return 1
         if self.axis_links and axis in self.axis_links:
             return max(1, int(self.axis_links[axis]))
         if self.torus and degree > 1:
+            start = 0
+            if axis_degrees:
+                # consume torus dims claimed by ICI axes INSIDE this one
+                for inner in reversed(AXIS_ORDER):
+                    if inner == axis:
+                        break
+                    d = int(axis_degrees.get(inner, 1))
+                    if d <= 1 or inner in self.dcn_axes:
+                        continue
+                    covered = 1
+                    while start < len(self.torus) and covered < d:
+                        covered *= self.torus[start]
+                        start += 1
             covered, dims = 1, 0
-            for d in self.torus:
+            for d in self.torus[start:]:
                 if covered >= degree:
                     break
                 covered *= d
                 dims += 1
-            return 2 * max(1, dims)
+            return 2 * dims if dims else 1
         return 1
 
     @classmethod
@@ -208,8 +235,13 @@ class CollectiveModel:
     ring costs over the axis's ICI links.
     """
 
-    def __init__(self, topo: TPUTopology):
+    def __init__(self, topo: TPUTopology,
+                 axis_degrees: Optional[Dict[str, int]] = None):
         self.topo = topo
+        # full mesh axis → degree map (MachineSpec.axis_sizes()): places
+        # each axis on the physical torus so outer axes aren't credited
+        # with the inner axes' links on asymmetric tori
+        self.axis_degrees = axis_degrees
 
     def _ring(self, bytes_total: float, degree: int, axis: str, factor: float) -> float:
         if degree <= 1 or bytes_total <= 0:
@@ -217,7 +249,7 @@ class CollectiveModel:
         # stripe over every ICI link the axis's torus layout provides
         # (2 per covered torus dim); 1 when no torus info is available
         bw = self.topo.axis_bandwidth(axis) * self.topo.axis_link_multiplicity(
-            axis, degree
+            axis, degree, self.axis_degrees
         )
         lat = self.topo.axis_latency(axis) * (degree - 1)
         return factor * (degree - 1) / degree * bytes_total / bw + lat

@@ -165,7 +165,9 @@ class CostModel:
     enable_parameter: bool = True
 
     def __post_init__(self):
-        self.coll = CollectiveModel(self.topo)
+        # the machine's axis degrees place each mesh axis on the torus
+        # (outer axes start where inner ICI axes left off)
+        self.coll = CollectiveModel(self.topo, self.machine.axis_sizes())
 
     # ------------------------------------------------------------------
 

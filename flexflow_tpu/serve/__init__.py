@@ -9,6 +9,7 @@ three attention operators become one compiled program per static mode.
 from .batch_config import BatchConfig, GenerationConfig, GenerationResult
 from .engine import InferenceEngine, ServingConfig
 from .llm import LLM, SSM, detect_family
+from .paging import PageAllocator
 from .request_manager import Request, RequestManager
 from .sampling import sample_tokens
 from .specinfer import SpecConfig, SpecInferManager, TokenTree
@@ -19,6 +20,7 @@ __all__ = [
     "GenerationResult",
     "InferenceEngine",
     "LLM",
+    "PageAllocator",
     "SSM",
     "detect_family",
     "ServingConfig",

@@ -41,6 +41,16 @@ class BatchConfig:
     # Cache line indices when they differ from sequence positions (tree
     # tokens: siblings share a position but need distinct lines).
     cache_positions: Optional[np.ndarray] = None
+    # Paged-KV metadata (Ragged Paged Attention layout, serve/paging.py).
+    # page_table: (R, pages_per_slot) int32 physical page per logical
+    # page — a snapshot of the batch-building engine's allocator table
+    # (each engine dispatches with its OWN authoritative table; this
+    # copy is host-side metadata for telemetry and tests).
+    page_table: Optional[np.ndarray] = None
+    # Ragged per-slot lengths: committed cache lines + this step's new
+    # tokens for each active slot (0 for idle slots) — the kernel-side
+    # sequence-length metadata of the ragged batch.
+    seq_lens: Optional[np.ndarray] = None  # (R,) int32
 
     @property
     def num_slots(self) -> int:

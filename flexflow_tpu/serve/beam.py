@@ -31,6 +31,28 @@ def _topk_np(x: np.ndarray, k: int):
     return x[idx], idx
 
 
+def _ensure_beam_pages(engine: InferenceEngine, num_slots: int, lines: int):
+    """Paged KV: every beam slot needs its own pages covering ``lines``
+    BEFORE the cache-content reorder copies hypotheses across slots
+    (reorder moves content between table-resolved pages; equal-length
+    beams guarantee equal allocations)."""
+    if not getattr(engine, "paged", False):
+        return
+    for s in range(num_slots):
+        if not engine.pager.ensure(s, lines):
+            raise RuntimeError(
+                f"KV page pool exhausted during beam search (slot {s}, "
+                f"{lines} lines) — raise ServingConfig.max_cached_tokens"
+            )
+
+
+def _release_beam_pages(engine: InferenceEngine, num_slots: int):
+    if not getattr(engine, "paged", False):
+        return
+    for s in range(num_slots):
+        engine.pager.release(s)
+
+
 def beam_generate(
     engine: InferenceEngine,
     prompt: Sequence[int],
@@ -57,6 +79,7 @@ def beam_generate(
     # --- chunked prefill into slot 0 ---
     n = 0
     logits = None
+    _ensure_beam_pages(engine, 1, len(prompt))
     while n < len(prompt):
         toks = prompt[n : n + sc.prefill_chunk]
         bc = BatchConfig.empty(R, sc.prefill_chunk, scratch)
@@ -90,51 +113,56 @@ def beam_generate(
         return new_live, parents
 
     # --- seed beams from the prefill logits; clone slot 0's cache ---
-    vals, idxs = _topk_np(logp0, min(2 * W, logp0.size))
-    seeds, _ = select(vals, idxs, lambda t, rank: [t])
-    live = seeds
-    src = np.arange(R, dtype=np.int32)
-    src[:W] = 0
-    engine.reorder(src)
-
-    max_new = min(gen.max_new_tokens, max_total - len(prompt))
-    for step in range(1, max_new):
-        if not live:
-            break
-        if len(banked) >= W:
-            # early_stopping=False rule: stop once no live hypothesis
-            # can still beat the W-th banked score.
-            banked.sort(key=lambda x: -x[0])
-            del banked[W:]
-            best_live = max(s for s, _ in live)
-            if banked[-1][0] >= norm(best_live, len(live[0][1])):
-                break
-        bc = BatchConfig.empty(R, 1, scratch)
-        for b, (score, toks) in enumerate(live):
-            bc.tokens[b, 0] = toks[-1]
-            bc.positions[b, 0] = len(prompt) + len(toks) - 1
-            bc.active[b] = True
-        logits = engine.run(bc)
-        logp = np.asarray(jax.device_get(log_softmax(logits)))[: len(live)]
-        V = logp.shape[-1]
-        cand = np.asarray(
-            [score for score, _ in live], np.float32
-        )[:, None] + logp  # (w, V)
-        vals, flat = _topk_np(cand.reshape(-1), min(2 * W, cand.size))
-        beam_of = (flat // V).astype(int)
-        live_prev = live
-        live, parent_ranks = select(
-            vals, flat % V,
-            lambda t, rank: live_prev[beam_of[rank]][1] + [t],
-        )
-        parents = [int(beam_of[r]) for r in parent_ranks]
+    try:
+        vals, idxs = _topk_np(logp0, min(2 * W, logp0.size))
+        seeds, _ = select(vals, idxs, lambda t, rank: [t])
+        live = seeds
+        _ensure_beam_pages(engine, W, len(prompt))
         src = np.arange(R, dtype=np.int32)
-        src[: len(parents)] = parents
+        src[:W] = 0
         engine.reorder(src)
 
-    finals = banked + [(norm(s, len(t)), t) for s, t in live]
-    finals.sort(key=lambda x: -x[0])
-    return finals[0][1]
+        max_new = min(gen.max_new_tokens, max_total - len(prompt))
+        for step in range(1, max_new):
+            if not live:
+                break
+            if len(banked) >= W:
+                # early_stopping=False rule: stop once no live hypothesis
+                # can still beat the W-th banked score.
+                banked.sort(key=lambda x: -x[0])
+                del banked[W:]
+                best_live = max(s for s, _ in live)
+                if banked[-1][0] >= norm(best_live, len(live[0][1])):
+                    break
+            _ensure_beam_pages(engine, W, len(prompt) + step)
+            bc = BatchConfig.empty(R, 1, scratch)
+            for b, (score, toks) in enumerate(live):
+                bc.tokens[b, 0] = toks[-1]
+                bc.positions[b, 0] = len(prompt) + len(toks) - 1
+                bc.active[b] = True
+            logits = engine.run(bc)
+            logp = np.asarray(jax.device_get(log_softmax(logits)))[: len(live)]
+            V = logp.shape[-1]
+            cand = np.asarray(
+                [score for score, _ in live], np.float32
+            )[:, None] + logp  # (w, V)
+            vals, flat = _topk_np(cand.reshape(-1), min(2 * W, cand.size))
+            beam_of = (flat // V).astype(int)
+            live_prev = live
+            live, parent_ranks = select(
+                vals, flat % V,
+                lambda t, rank: live_prev[beam_of[rank]][1] + [t],
+            )
+            parents = [int(beam_of[r]) for r in parent_ranks]
+            src = np.arange(R, dtype=np.int32)
+            src[: len(parents)] = parents
+            engine.reorder(src)
+
+        finals = banked + [(norm(s, len(t)), t) for s, t in live]
+        finals.sort(key=lambda x: -x[0])
+        return finals[0][1]
+    finally:
+        _release_beam_pages(engine, W)
 
 
 def generate_with_beams(

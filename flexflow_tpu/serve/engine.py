@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.mesh import DATA_AXIS, MachineSpec
+from ..core.mesh import DATA_AXIS, MachineSpec, set_mesh as _set_mesh
 from .batch_config import BatchConfig
 
 
@@ -48,12 +48,40 @@ class ServingConfig:
     # .npy. None = off; the FF_INFERENCE_DEBUGGING env var (a directory
     # path) switches it on without touching code.
     inference_debugging: Optional[str] = None
+    # KV cache layout. "dense": per-slot (slots, max_len+1) lines — HBM
+    # scales with the worst case. "paged": fixed-size token pages + a
+    # per-slot page table (Ragged Paged Attention, PAPERS.md arxiv
+    # 2604.15464) — HBM scales with pages actually allocated, which is
+    # what lets one chip run the reference's 64 request slots.
+    kv_layout: str = "dense"
+    page_size: int = 128                    # tokens per KV page
+    # Page-pool budget in tokens (rounded up to whole pages). None =
+    # worst case (slots × pages_per_slot — same capacity as dense, still
+    # allocated lazily). Set it below the worst case to oversubscribe:
+    # the RequestManager preempts (recompute-on-readmit) on exhaustion.
+    max_cached_tokens: Optional[int] = None
 
     @property
     def cache_len(self) -> int:
         # Committed tokens + in-flight speculative tree slack
         # (reference BatchConfig::MAX_SPEC_TREE_TOKEN_NUM headroom).
         return self.max_sequence_length + self.max_spec_tree_tokens
+
+    @property
+    def pages_per_slot(self) -> int:
+        """Logical pages covering one slot's worst case (cache_len lines
+        + the scratch line)."""
+        return -(-(self.cache_len + 1) // self.page_size)
+
+    @property
+    def num_pages(self) -> int:
+        """Physical pages in the pool (excluding the scratch page)."""
+        if self.max_cached_tokens is None:
+            return self.max_requests_per_batch * self.pages_per_slot
+        return max(
+            self.pages_per_slot,
+            -(-self.max_cached_tokens // self.page_size),
+        )
 
 
 class InferenceEngine:
@@ -92,6 +120,13 @@ class InferenceEngine:
         # string tag for fused variants ("decode_fused").
         self._steps: Dict[Any, Callable] = {}
         self._commit: Optional[Callable] = None
+        self.paged = self.serving.kv_layout == "paged"
+        if self.serving.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"unknown kv_layout {self.serving.kv_layout!r} "
+                "(expected 'dense' or 'paged')"
+            )
+        self.pager = None  # PageAllocator when paged (host-side tables)
         if self.pipelined:
             pp = self.mesh.shape["pipe"]
             L = cfg.num_hidden_layers
@@ -99,6 +134,11 @@ class InferenceEngine:
                 raise ValueError(
                     f"pipeline serving needs num_hidden_layers ({L}) "
                     f"divisible by the pipe degree ({pp})"
+                )
+            if self.paged:
+                raise ValueError(
+                    "kv_layout='paged' is not composed with pipeline "
+                    "parallelism yet — use kv_layout='dense' with pipe>1"
                 )
         self.cache = self._alloc_cache()
 
@@ -112,22 +152,46 @@ class InferenceEngine:
 
     def _alloc_cache(self):
         """Allocate the KV cache sharded over the mesh (the model's
-        kv_cache_pspecs: slots on the data axis, KV heads on the model
-        axis) — the analog of the reference's per-shard tensor_buffer
-        allocation (inference_manager.cc:143-200)."""
+        kv_cache_pspecs: slots — or pages, when paged — on the data
+        axis, KV heads on the model axis) — the analog of the
+        reference's per-shard tensor_buffer allocation
+        (inference_manager.cc:143-200). The paged branch also (re)builds
+        the host-side page allocator: a fresh cache means empty tables."""
         sc = self.serving
-        init = functools.partial(
-            self.model.init_kv_cache,
-            self.cfg,
-            sc.max_requests_per_batch,
-            sc.cache_len,
-            sc.cache_dtype,
-        )
-        with jax.set_mesh(self.mesh):
+        if self.paged:
+            from .paging import PageAllocator
+
+            num_pages = sc.num_pages
+            data = self.mesh.shape.get(DATA_AXIS, 1)
+            if data > 1:
+                # pool rows (num_pages + scratch) shard over data —
+                # round up so the leading dim divides evenly
+                num_pages += (-(num_pages + 1)) % data
+            self.pager = PageAllocator(
+                num_pages, sc.pages_per_slot, sc.max_requests_per_batch,
+                sc.page_size,
+            )
+            self._table_cache = None  # fresh pager → stale device copy
+            init = functools.partial(
+                self.model.init_paged_kv_cache,
+                self.cfg,
+                num_pages,
+                sc.page_size,
+                sc.cache_dtype,
+            )
+            pspec_fn = self.model.paged_kv_cache_pspecs
+        else:
+            init = functools.partial(
+                self.model.init_kv_cache,
+                self.cfg,
+                sc.max_requests_per_batch,
+                sc.cache_len,
+                sc.cache_dtype,
+            )
+            pspec_fn = self.model.kv_cache_pspecs
+        with _set_mesh(self.mesh):
             if any(n > 1 for n in self.mesh.shape.values()):
-                pspecs = self.model.kv_cache_pspecs(
-                    self.cfg, pipeline=self.pipelined
-                )
+                pspecs = pspec_fn(self.cfg, pipeline=self.pipelined)
                 shardings = jax.tree.map(
                     lambda p: NamedSharding(self.mesh, p),
                     pspecs,
@@ -135,6 +199,42 @@ class InferenceEngine:
                 )
                 return jax.jit(init, out_shardings=shardings)()
             return init()
+
+    # ------------------------------------------------------------------
+    # paged-layout accounting (bench + tests)
+
+    def page_table_device(self) -> jnp.ndarray:
+        """The engine's own page table as a device array — every step's
+        read-only gather/scatter indices. Cached against the allocator's
+        version counter: steady-state decode (no admissions, no page
+        growth) re-ships nothing."""
+        cached = getattr(self, "_table_cache", None)
+        if cached is not None and cached[0] == self.pager.version:
+            return cached[1]
+        dev = jnp.asarray(self.pager.table)
+        self._table_cache = (self.pager.version, dev)
+        return dev
+
+    def kv_cache_bytes(self) -> int:
+        """Device bytes held by the cache buffers (dense: the whole
+        slots × max_len cache; paged: the page pool)."""
+        return sum(int(leaf.nbytes) for leaf in jax.tree.leaves(self.cache))
+
+    def kv_bytes_per_line(self) -> float:
+        """K+V bytes one cached token line costs across all layers."""
+        k, v = self.cache["k"], self.cache["v"]
+        lines = k.shape[1] * k.shape[2]  # slots×(len+1) or pages×page_size
+        return (int(k.nbytes) + int(v.nbytes)) / lines
+
+    def kv_allocated_bytes(self) -> int:
+        """Bytes of KV HBM backing ALLOCATED pages (paged layout): the
+        footprint proportional-to-live-tokens claim, measured."""
+        if not self.paged:
+            return self.kv_cache_bytes()
+        return int(
+            self.pager.used_pages * self.serving.page_size
+            * self.kv_bytes_per_line()
+        )
 
     @property
     def scratch_pos(self) -> int:
@@ -147,12 +247,18 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _serve_step_fn(self, all_logits: bool) -> Callable:
-        """model.serve_step bound to this engine's static kwargs."""
+        """model.serve_step (or serve_step_paged) bound to this engine's
+        static kwargs. The paged variant takes the page table as a
+        trailing positional and needs cache_len for its scratch-line
+        mask cutoff."""
         kw = dict(cfg=self.cfg, all_logits=all_logits)
         if self.serving.kernels != "xla":
             kw["kernels"] = self.serving.kernels
         if self.pipelined:
             kw["mesh"] = self.mesh
+        if self.paged:
+            kw["cache_len"] = self.serving.cache_len
+            return functools.partial(self.model.serve_step_paged, **kw)
         return functools.partial(self.model.serve_step, **kw)
 
     def _get_step(self, chunk: int, all_logits: bool, with_mask: bool):
@@ -163,8 +269,16 @@ class InferenceEngine:
         if key not in self._steps:
             fn = self._serve_step_fn(all_logits)
 
-            def step(params, cache, tokens, positions, logits_idx, mask, cpos):
-                return fn(params, cache, tokens, positions, logits_idx, mask, cpos)
+            if self.paged:
+                def step(params, cache, tokens, positions, logits_idx,
+                         mask, cpos, page_table):
+                    return fn(params, cache, tokens, positions, logits_idx,
+                              mask, cpos, page_table)
+            else:
+                def step(params, cache, tokens, positions, logits_idx,
+                         mask, cpos):
+                    return fn(params, cache, tokens, positions, logits_idx,
+                              mask, cpos)
 
             self._steps[key] = jax.jit(step, donate_argnums=(1,))
         return self._steps[key]
@@ -181,16 +295,19 @@ class InferenceEngine:
 
             fn = self._serve_step_fn(all_logits=False)
             R = self.num_slots
+            paged = self.paged
 
             def step(params, cache, last_tokens, host_tokens, use_last,
-                     positions, key, greedy, temperature, topp):
+                     positions, key, greedy, temperature, topp,
+                     page_table=None):
                 tokens = jnp.where(
                     use_last[:, None], last_tokens[:, None], host_tokens
                 )
-                logits, cache = fn(
-                    params, cache, tokens, positions,
-                    jnp.zeros((R,), jnp.int32), None, None,
-                )
+                args = (params, cache, tokens, positions,
+                        jnp.zeros((R,), jnp.int32), None, None)
+                if paged:
+                    args = args + (page_table,)
+                logits, cache = fn(*args)
                 toks = sample_tokens(
                     logits, key,
                     greedy=greedy, temperature=temperature, topp=topp,
@@ -204,7 +321,10 @@ class InferenceEngine:
                    key, greedy, temperature, topp):
         """Dispatch one fused decode step; returns the sampled tokens as
         a DEVICE array (R,) — the caller fetches it a step later."""
-        with jax.set_mesh(self.mesh):
+        kw = {}
+        if self.paged:
+            kw["page_table"] = self.page_table_device()
+        with _set_mesh(self.mesh):
             step = self._get_decode_step()
             toks, self.cache = step(
                 self.params,
@@ -217,6 +337,7 @@ class InferenceEngine:
                 jnp.asarray(greedy),
                 jnp.asarray(temperature),
                 jnp.asarray(topp),
+                **kw,
             )
         return toks
 
@@ -239,7 +360,10 @@ class InferenceEngine:
             scratch = self.scratch_pos
             NEG = -1e30
 
-            def speculate(params, cache, root_tokens, prefix, active):
+            paged = self.paged
+
+            def speculate(params, cache, root_tokens, prefix, active,
+                          page_table=None):
                 key_pos = jnp.arange(S1, dtype=jnp.int32)
                 # frontier state, beam dim = W; only w0 live at depth 0
                 w_iota = jnp.arange(W, dtype=jnp.int32)
@@ -262,10 +386,11 @@ class InferenceEngine:
                     pos = jnp.where(
                         f_valid, prefix[:, None] + d, scratch
                     ).astype(jnp.int32)
-                    logits, cache = fn(
-                        params, cache, f_tok, pos,
-                        jnp.zeros((R,), jnp.int32), f_mask, f_line,
-                    )  # (R, W, V)
+                    args = (params, cache, f_tok, pos,
+                            jnp.zeros((R,), jnp.int32), f_mask, f_line)
+                    if paged:
+                        args = args + (page_table,)
+                    logits, cache = fn(*args)  # (R, W, V)
                     V = logits.shape[-1]
                     logp = log_softmax(logits) + f_cum[:, :, None]
                     logp = jnp.where(f_valid[:, :, None], logp, NEG)
@@ -301,7 +426,10 @@ class InferenceEngine:
         """Dispatch one whole speculation round; returns device arrays
         (tokens, parents, logps) each (D, R, W). The cache advances in
         place with every tree node's K/V at its slack line."""
-        with jax.set_mesh(self.mesh):
+        kw = {}
+        if self.paged:
+            kw["page_table"] = self.page_table_device()
+        with _set_mesh(self.mesh):
             step = self._get_speculate(W, D)
             toks, parents, logps, self.cache = step(
                 self.params,
@@ -309,6 +437,7 @@ class InferenceEngine:
                 jnp.asarray(root_tokens, jnp.int32),
                 jnp.asarray(prefix, jnp.int32),
                 jnp.asarray(active),
+                **kw,
             )
         return toks, parents, logps
 
@@ -320,6 +449,21 @@ class InferenceEngine:
 
         fn = getattr(self.model, "serve_debug_activations", None)
         if fn is None:
+            # loud skip, never a silent no-op (ADVICE.md round 5): the
+            # family module lacks the hook, so nothing can be dumped —
+            # warn once and keep serving at full speed (the
+            # RequestManager only downgrades fast decode when the hook
+            # exists, request_manager.py).
+            if not getattr(self, "_warned_no_debug_hook", False):
+                from ..logging_utils import get_logger
+
+                get_logger("serve").warning(
+                    "inference_debugging is enabled but %s has no "
+                    "serve_debug_activations hook — nothing will be "
+                    "dumped for this engine",
+                    getattr(self.model, "__name__", repr(self.model)),
+                )
+                self._warned_no_debug_hook = True
             return
         # per-engine subdirectory: a SpecInfer pair (LLM + SSM engines)
         # shares the dump dir, and both counters start at 0 — same-named
@@ -330,13 +474,17 @@ class InferenceEngine:
             f"L{self.cfg.num_hidden_layers}-{id(self) & 0xFFFF:04x}",
         )
         os.makedirs(outdir, exist_ok=True)
+        kw = dict(cfg=self.cfg, kernels=self.serving.kernels)
+        if self.paged:
+            kw["page_table"] = self.page_table_device()
+            kw["cache_len"] = self.serving.cache_len
         acts = fn(
             self.params, self.cache, jnp.asarray(bc.tokens),
             jnp.asarray(bc.positions),
             jnp.asarray(bc.mask) if bc.mask is not None else None,
             jnp.asarray(bc.cache_positions)
             if bc.cache_positions is not None else None,
-            cfg=self.cfg, kernels=self.serving.kernels,
+            **kw,
         )
         step = self._debug_step
         np.save(os.path.join(outdir, f"step{step:05d}_tokens.npy"),
@@ -355,45 +503,71 @@ class InferenceEngine:
         inference_manager.cc:334). Returns logits on device; the cache is
         advanced in place (donated)."""
         if self.serving.inference_debugging:
-            with jax.set_mesh(self.mesh):
+            with _set_mesh(self.mesh):
                 self._dump_debug(bc)
-        with jax.set_mesh(self.mesh):
+        args = (
+            jnp.asarray(bc.tokens),
+            jnp.asarray(bc.positions),
+            jnp.asarray(bc.logits_idx),
+            jnp.asarray(bc.mask) if bc.mask is not None else None,
+            jnp.asarray(bc.cache_positions)
+            if bc.cache_positions is not None
+            else None,
+        )
+        if self.paged:
+            # the engine's own table is authoritative (a SpecInfer pair
+            # shares one BatchConfig across engines whose pools differ);
+            # bc.page_table is carried as host-side metadata
+            args = args + (self.page_table_device(),)
+        with _set_mesh(self.mesh):
             step = self._get_step(bc.chunk, all_logits, bc.mask is not None)
-            logits, self.cache = step(
-                self.params,
-                self.cache,
-                jnp.asarray(bc.tokens),
-                jnp.asarray(bc.positions),
-                jnp.asarray(bc.logits_idx),
-                jnp.asarray(bc.mask) if bc.mask is not None else None,
-                jnp.asarray(bc.cache_positions)
-                if bc.cache_positions is not None
-                else None,
-            )
+            logits, self.cache = step(self.params, self.cache, *args)
         return logits
 
     def reorder(self, src_slots: np.ndarray):
         """Slot permutation/gather of the whole cache (beam search
-        hypothesis reordering): new slot r holds old slot src_slots[r]."""
+        hypothesis reordering): new slot r holds old slot src_slots[r].
+        Paged layout: page ownership stays put, page CONTENT is copied
+        through the table (model.reorder_slots_paged)."""
         if "reorder" not in self._steps:
-            self._steps["reorder"] = jax.jit(
-                self.model.reorder_slots, donate_argnums=(0,)
-            )
-        with jax.set_mesh(self.mesh):
-            self.cache = self._steps["reorder"](
-                self.cache, jnp.asarray(src_slots, jnp.int32)
-            )
+            if self.paged:
+                self._steps["reorder"] = jax.jit(
+                    self.model.reorder_slots_paged, donate_argnums=(0,)
+                )
+            else:
+                self._steps["reorder"] = jax.jit(
+                    self.model.reorder_slots, donate_argnums=(0,)
+                )
+        with _set_mesh(self.mesh):
+            if self.paged:
+                self.cache = self._steps["reorder"](
+                    self.cache, self.page_table_device(),
+                    jnp.asarray(src_slots, jnp.int32),
+                )
+            else:
+                self.cache = self._steps["reorder"](
+                    self.cache, jnp.asarray(src_slots, jnp.int32)
+                )
 
     def commit(self, src: np.ndarray, dst: np.ndarray):
         """Move accepted speculative cache lines to committed positions
         (src/dst (R, K); unused entries scratch→scratch)."""
         if self._commit is None:
-            self._commit = jax.jit(self.model.commit_kv, donate_argnums=(0,))
-        with jax.set_mesh(self.mesh):
-            self.cache = self._commit(
-                self.cache, jnp.asarray(src), jnp.asarray(dst)
-            )
+            fn = (self.model.commit_kv_paged if self.paged
+                  else self.model.commit_kv)
+            self._commit = jax.jit(fn, donate_argnums=(0,))
+        with _set_mesh(self.mesh):
+            if self.paged:
+                self.cache = self._commit(
+                    self.cache, self.page_table_device(),
+                    jnp.asarray(src), jnp.asarray(dst),
+                )
+            else:
+                self.cache = self._commit(
+                    self.cache, jnp.asarray(src), jnp.asarray(dst)
+                )
 
     def reset(self):
-        """Drop all cached sequences (fresh KV cache)."""
+        """Drop all cached sequences (fresh KV cache; paged: fresh
+        allocator — all pages back on the free list)."""
         self.cache = self._alloc_cache()

@@ -19,8 +19,20 @@ MAX_NUM_TOKENS the same way, batch_config.h:58-60).
 request with an explicit (C, S) boolean mask (the reference's causal
 ``BitMask``), same online-softmax core.
 
-On non-TPU backends both fall back to ``interpret=True`` so tests run
-on the CPU mesh.
+:func:`ragged_paged_attention` — the paged-KV variant (PAPERS.md,
+arxiv 2604.15464 Ragged Paged Attention): K/V live in a pool of
+fixed-size token pages and the kernel gathers them **through the page
+table** — the grid is (request, logical page) and the K/V BlockSpec
+index maps read the scalar-prefetched table to DMA the right physical
+page, so no (R, S) virtual cache is ever materialised in HBM. One
+kernel serves decode (C=1), chunked prefill and tree verify (C>1, any
+mask) — the single ragged kernel for mixed batches the paper argues
+for. :func:`ragged_paged_attention_xla` is the shape-identical
+``jnp.take``-based fallback (via :func:`gather_pages`) used on CPU and
+as the correctness reference.
+
+On non-TPU backends the Pallas kernels fall back to ``interpret=True``
+so tests run on the CPU mesh.
 """
 from __future__ import annotations
 
@@ -257,4 +269,161 @@ def verify_attention(
         ),
         interpret=_interpret(),
     )(qg, k_cache, v_cache, mask)
+    return out.reshape(R, C, H, dk)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged attention (paged KV pool + per-request page table)
+
+
+def gather_pages(pool: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
+    """``jnp.take``-gather of a request's logical cache from the page
+    pool: pool (P+1, ps, ...) × table (R, NP) → virtual cache
+    (R, NP*ps, ...). Unallocated table entries point at the scratch page
+    (pool row P) — the caller's mask never exposes those lines."""
+    R, NP = page_table.shape
+    ps = pool.shape[1]
+    flat = jnp.take(pool, page_table.reshape(-1), axis=0)
+    return flat.reshape((R, NP * ps) + pool.shape[2:])
+
+
+def ragged_paged_attention_xla(
+    q: jnp.ndarray,           # (R, C, H, dk)
+    k_pool: jnp.ndarray,      # (P+1, ps, KV, dk)
+    v_pool: jnp.ndarray,      # (P+1, ps, KV, dk)
+    page_table: jnp.ndarray,  # (R, NP) int32 physical page per logical page
+    mask: jnp.ndarray,        # (R, C, NP*ps) bool
+    *,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Shape-identical XLA fallback: gather the virtual cache through
+    the page table, then the standard grouped-query masked softmax —
+    bit-for-bit the dense ``serve_attention`` math on the gathered
+    lines. Returns (R, C, H, dk)."""
+    R, C, H, dk = q.shape
+    KV = k_pool.shape[2]
+    G = H // KV
+    scale = scale if scale is not None else 1.0 / math.sqrt(dk)
+    k_virt = gather_pages(k_pool, page_table)  # (R, S, KV, dk)
+    v_virt = gather_pages(v_pool, page_table)
+    qg = q.reshape(R, C, KV, G, dk)
+    scores = jnp.einsum(
+        "rckgd,rskd->rkgcs", qg, k_virt, preferred_element_type=jnp.float32
+    ) * scale
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("rkgcs,rskd->rckgd", probs, v_virt)
+    return out.reshape(R, C, H, dk)
+
+
+def _ragged_paged_kernel(
+    pt_ref,       # scalar-prefetch: (R, NP) int32 page table
+    q_ref,        # (1, C, KV, G, dk)
+    k_ref,        # (1, ps, KV, dk) — physical page picked by index map
+    v_ref,        # (1, ps, KV, dk)
+    mask_ref,     # (1, C, ps)
+    out_ref,      # (1, C, KV, G, dk)
+    o_scr,        # VMEM (C, KV, G, dk) f32
+    m_scr,        # VMEM (C, KV, G) f32
+    l_scr,        # VMEM (C, KV, G) f32
+    *,
+    scale: float,
+):
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _():
+        o_scr[:] = jnp.zeros_like(o_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    mask = mask_ref[0]  # (C, ps) — already bounded: S_virt = NP*ps exactly
+
+    @pl.when(jnp.any(mask))
+    def _():
+        q = q_ref[0].astype(jnp.float32)            # (C, KV, G, dk)
+        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # (KV, ps, dk)
+        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+        C, KV, G = q.shape[0], q.shape[1], q.shape[2]
+        # (KV, C*G, dk) grouped layout: one batched dot per KV head
+        qkv = q.transpose(1, 0, 2, 3).reshape(KV, C * G, q.shape[-1])
+        scores = jax.lax.dot_general(
+            qkv, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # (KV, C*G, ps)
+        scores = scores.reshape(KV, C, G, -1).transpose(1, 0, 2, 3)
+        scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+        m_new = jnp.maximum(m_scr[:], scores.max(axis=-1))
+        prob = jnp.exp(scores - m_new[..., None])
+        prob = jnp.where(mask[:, None, None, :], prob, 0.0)
+        corr = jnp.exp(m_scr[:] - m_new)
+        l_scr[:] = l_scr[:] * corr + prob.sum(axis=-1)
+        pk = prob.transpose(1, 0, 2, 3).reshape(KV, C * G, -1)
+        pv = jax.lax.dot_general(
+            pk, v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (KV, C*G, dk)
+        pv = pv.reshape(KV, C, G, -1).transpose(1, 0, 2, 3)
+        o_scr[:] = o_scr[:] * corr[..., None] + pv
+        m_scr[:] = m_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-20)
+        out_ref[0] = (o_scr[:] / l[..., None]).astype(out_ref.dtype)
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,           # (R, C, H, dk)
+    k_pool: jnp.ndarray,      # (P+1, ps, KV, dk)
+    v_pool: jnp.ndarray,      # (P+1, ps, KV, dk)
+    page_table: jnp.ndarray,  # (R, NP) int32
+    mask: jnp.ndarray,        # (R, C, NP*ps) bool
+    *,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Fused ragged paged attention: grid (request, logical page); the
+    K/V BlockSpec index maps read the scalar-prefetched page table so
+    each step DMAs exactly the physical page that logical position maps
+    to — gathering through the table without materialising the
+    (R, S) virtual cache. One kernel covers decode (C=1), chunked
+    prefill and tree verify (the explicit-mask modes). Returns
+    (R, C, H, dk)."""
+    R, C, H, dk = q.shape
+    _, ps, KV, _ = k_pool.shape
+    NP = page_table.shape[1]
+    G = H // KV
+    scale = scale if scale is not None else 1.0 / math.sqrt(dk)
+    qg = q.reshape(R, C, KV, G, dk)
+    grid = (R, NP)
+
+    out = pl.pallas_call(
+        functools.partial(_ragged_paged_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((R, C, KV, G, dk), q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, C, KV, G, dk),
+                             lambda r, p, pt: (r, 0, 0, 0, 0)),
+                # the paged gather: block row = page_table[r, p]
+                pl.BlockSpec((1, ps, KV, dk),
+                             lambda r, p, pt: (pt[r, p], 0, 0, 0)),
+                pl.BlockSpec((1, ps, KV, dk),
+                             lambda r, p, pt: (pt[r, p], 0, 0, 0)),
+                pl.BlockSpec((1, C, ps), lambda r, p, pt: (r, 0, p)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, C, KV, G, dk), lambda r, p, pt: (r, 0, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((C, KV, G, dk), jnp.float32),
+                pltpu.VMEM((C, KV, G), jnp.float32),
+                pltpu.VMEM((C, KV, G), jnp.float32),
+            ],
+        ),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), qg, k_pool, v_pool, mask)
     return out.reshape(R, C, H, dk)

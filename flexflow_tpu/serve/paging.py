@@ -1,0 +1,141 @@
+"""Paged KV cache — free-list page allocator + per-slot page tables.
+
+TPU-native port of the Ragged Paged Attention memory layout
+(PAPERS.md, arxiv 2604.15464; vLLM's PagedAttention ancestry): instead
+of a dense per-slot cache of ``slots × (max_len+1)`` lines, K/V live in
+a pool of fixed-size token **pages** and each request slot owns a
+**page table** mapping logical pages (line // page_size) to physical
+pages. HBM cost is then proportional to pages actually allocated — live
+tokens rounded up to the page size — not to the worst-case sequence
+length, which is what lets serving run the reference's 64 request slots
+on one chip (VERDICT.md round 5, missing #3).
+
+The allocator is host-side state owned by the :class:`InferenceEngine`
+(one per engine — a SpecInfer LLM/SSM pair allocates independently
+because their pools differ in layer count and budget). The
+RequestManager drives it on admit/evict/completion; the device only
+ever sees the resulting ``(slots, pages_per_slot)`` int32 table shipped
+with each step.
+
+Physical page ``num_pages`` (one past the pool) is the shared
+**scratch page**: unallocated table entries point at it, so padding
+tokens' K/V writes and gathers through unallocated entries land on a
+real buffer that no mask ever exposes (the paged analog of the dense
+layout's per-slot scratch row, models/llama.py init_kv_cache).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class PageAllocator:
+    """Free-list allocator over a physical KV page pool.
+
+    Invariants (asserted, tested in tests/test_paged_kv.py):
+      * a physical page is owned by at most one slot at a time;
+      * ``ensure`` either covers the requested lines fully or changes
+        nothing (no partial allocation to roll back);
+      * ``release`` returns exactly the slot's owned pages — double
+        release is a no-op, never a double-free.
+    """
+
+    def __init__(self, num_pages: int, pages_per_slot: int, num_slots: int,
+                 page_size: int):
+        if num_pages < pages_per_slot:
+            raise ValueError(
+                f"page pool ({num_pages} pages) smaller than one request's "
+                f"worst case ({pages_per_slot} pages) — no request could "
+                "ever run to max_sequence_length"
+            )
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.pages_per_slot = int(pages_per_slot)
+        self.scratch_page = int(num_pages)  # pool row num_pages is scratch
+        # pop() takes from the end: keep ascending ids there
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self.table = np.full(
+            (num_slots, pages_per_slot), self.scratch_page, np.int32
+        )
+        # bumped on every table mutation — the engine caches the device
+        # copy of the table against it, so steady-state decode (table
+        # unchanged across steps) re-ships nothing
+        self.version = 0
+
+    # ------------------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def slot_pages(self, slot: int) -> int:
+        """Physical pages currently owned by ``slot``."""
+        return int((self.table[slot] != self.scratch_page).sum())
+
+    def pages_for(self, num_lines: int) -> int:
+        """Logical pages needed to cover cache lines [0, num_lines)."""
+        return -(-int(num_lines) // self.page_size)
+
+    # ------------------------------------------------------------------
+
+    def ensure(self, slot: int, num_lines: int) -> bool:
+        """Grow ``slot``'s table to cover ``num_lines`` cache lines.
+        Already-covered prefixes are kept (idempotent). Returns False —
+        with NOTHING allocated — when the free list cannot cover the
+        growth; the caller preempts a victim and retries."""
+        need = min(self.pages_for(num_lines), self.pages_per_slot)
+        row = self.table[slot]
+        have = int((row[:need] != self.scratch_page).sum())
+        grow = need - have
+        if grow <= 0:
+            return True
+        if grow > len(self._free):
+            return False
+        for j in range(have, need):
+            assert row[j] == self.scratch_page, (
+                f"slot {slot} page table has a hole before logical page {j}"
+            )
+            row[j] = self._free.pop()
+        self.version += 1
+        return True
+
+    def release(self, slot: int) -> int:
+        """Return all of ``slot``'s pages to the free list; resets the
+        row to scratch. Returns the number of pages freed."""
+        row = self.table[slot]
+        freed = 0
+        for j in range(self.pages_per_slot):
+            page = int(row[j])
+            if page == self.scratch_page:
+                continue
+            assert page not in self._free, (
+                f"double free of physical page {page} (slot {slot})"
+            )
+            self._free.append(page)
+            row[j] = self.scratch_page
+            freed += 1
+        if freed:
+            self.version += 1
+        return freed
+
+    def check_no_leaks(self) -> None:
+        """All pages are either free or table-owned, with no overlap —
+        the no-leak/no-alias invariant tests assert after a workload."""
+        owned = set()
+        for row in self.table:
+            for page in row:
+                if int(page) == self.scratch_page:
+                    continue
+                assert int(page) not in owned, f"page {page} aliased"
+                owned.add(int(page))
+        free = set(self._free)
+        assert not (owned & free), f"pages both owned and free: {owned & free}"
+        assert len(free) == len(self._free), "free list holds duplicates"
+        assert owned | free == set(range(self.num_pages)), (
+            f"leaked pages: {set(range(self.num_pages)) - owned - free}"
+        )

@@ -51,6 +51,8 @@ class Request:
     slot: int = -1
     n_cached: int = 0                 # tokens whose K/V are in the cache
     inflight: int = 0                 # dispatched decode steps not yet fetched
+    admit_seq: int = -1               # admission order (preemption victims
+    # are chosen newest-first, vLLM-style recompute preemption)
     profile: ProfileInfo = dataclasses.field(default_factory=ProfileInfo)
 
     @property
@@ -72,11 +74,16 @@ class RequestManager:
         output_file: Optional[str] = None,
     ):
         self.engine = engine
-        if engine.serving.inference_debugging:
+        if engine.serving.inference_debugging and getattr(
+            engine.model, "serve_debug_activations", None
+        ) is not None:
             # the dump hook lives in engine.run(): the dispatch-ahead
             # fused decode pipeline bypasses it, so debugging forces
             # every step through the sync path (triage mode is allowed
-            # to be slow — the reference's inference_debugging is too)
+            # to be slow — the reference's inference_debugging is too).
+            # A model without the hook keeps fast decode: nothing could
+            # be dumped anyway (the engine logs a loud warning instead
+            # of silently paying the slowdown, ADVICE.md round 5).
             self.supports_fast_decode = False
         self.tokenizer = tokenizer
         self.eos_token_id = eos_token_id
@@ -90,6 +97,7 @@ class RequestManager:
         self.pending: List[int] = []
         self.slots: List[Optional[int]] = [None] * engine.num_slots
         self._next_id = 1000000  # reference starts guids at 1000000
+        self._admit_counter = 0
         self._key = jax.random.PRNGKey(seed)
         self._step_counter = 0
         # Dispatch-ahead decode pipeline (reference's 4-deep batch-future
@@ -135,17 +143,150 @@ class RequestManager:
         return rid
 
     # ------------------------------------------------------------------
+    # paged-KV page management (serve/paging.py PageAllocator; one
+    # allocator per engine — a SpecInfer LLM/SSM pair allocates
+    # independently but the tables evolve in lockstep because slot
+    # assignment and serving limits are shared)
+
+    @property
+    def _paged(self) -> bool:
+        return getattr(self.engine, "paged", False)
+
+    def _engines(self):
+        """Every engine whose cache this manager keeps in sync
+        (SpecInferManager adds its SSMs)."""
+        return [self.engine]
+
+    def _ensure_pages(self, req: Request, num_lines: int) -> bool:
+        """Cover cache lines [0, num_lines) for ``req`` on every engine.
+        All-or-nothing per engine; a partial cross-engine success is
+        resolved by the caller's preemption retry (``ensure`` is
+        idempotent on the engines that already granted)."""
+        for eng in self._engines():
+            if not eng.pager.ensure(req.slot, num_lines):
+                return False
+        return True
+
+    def _release_pages(self, slot: int):
+        for eng in self._engines():
+            eng.pager.release(slot)
+
+    def _preempt(self, req: Request):
+        """Evict an admitted request back to the front of the pending
+        queue, reclaiming its pages everywhere. Its prefix is recomputed
+        on re-admission (prompt + tokens generated so far re-prefill —
+        vLLM-style recompute preemption), so generation continues
+        exactly where it stopped."""
+        self._release_pages(req.slot)
+        self.slots[req.slot] = None
+        req.slot = -1
+        req.status = RequestStatus.PENDING
+        req.n_cached = 0
+        req.inflight = 0
+        self.pending.insert(0, req.request_id)
+
+    def _lines_needed(self, req: Request) -> int:
+        """Conservative cache-line bound the next step may touch."""
+        if req.status is RequestStatus.PREFILLING:
+            return min(
+                len(req.tokens),
+                req.n_cached + self.engine.serving.prefill_chunk,
+            )
+        # decode: reads lines [0, len-1], writes len-1 (+ dispatch-ahead
+        # steps in flight advance the write line without a host sync)
+        return len(req.tokens) + req.inflight + 1
+
+    def _reserve_active_pages(self, lines_fn=None):
+        """Grow every active slot's page table to cover this step's
+        reads/writes; on pool exhaustion, preempt the newest admission
+        (reference eviction order) and retry. Raises only when a single
+        request alone exceeds the pool — a configuration error."""
+        if not self._paged:
+            return
+        lines_fn = lines_fn or self._lines_needed
+        while True:
+            active = sorted(
+                (
+                    self.requests[rid]
+                    for rid in self.slots
+                    if rid is not None
+                    and self.requests[rid].status
+                    in (RequestStatus.PREFILLING, RequestStatus.DECODING)
+                ),
+                key=lambda r: r.admit_seq,
+            )
+            for req in active:
+                if self._ensure_pages(req, lines_fn(req)):
+                    continue
+                # free in-flight state before touching slot ownership;
+                # flushed completions may already release enough pages
+                self._flush_all()
+                if req.status not in (
+                    RequestStatus.PREFILLING, RequestStatus.DECODING
+                ) or self._ensure_pages(req, lines_fn(req)):
+                    break  # flush resolved it; re-derive the active set
+                victims = [
+                    r for r in active
+                    if r is not req
+                    and r.status
+                    in (RequestStatus.PREFILLING, RequestStatus.DECODING)
+                ]
+                if not victims:
+                    raise RuntimeError(
+                        "KV page pool exhausted by a single request — "
+                        "raise ServingConfig.max_cached_tokens (or lower "
+                        "max_sequence_length/page_size)"
+                    )
+                self._preempt(victims[-1])
+                break  # active set changed; re-derive
+            else:
+                return
+
+    def _attach_paging_metadata(self, bc: BatchConfig):
+        """Record the page table + ragged lengths on the batch
+        descriptor (the engine dispatches with its own authoritative
+        table; this is telemetry/testing metadata)."""
+        if not self._paged:
+            return
+        bc.page_table = self.engine.pager.table.copy()
+        seq_lens = np.zeros((self.engine.num_slots,), np.int32)
+        for rid in self.slots:
+            if rid is None:
+                continue
+            req = self.requests[rid]
+            if req.status is RequestStatus.PREFILLING:
+                seq_lens[req.slot] = min(
+                    len(req.tokens),
+                    req.n_cached + self.engine.serving.prefill_chunk,
+                )
+            elif req.status is RequestStatus.DECODING:
+                seq_lens[req.slot] = len(req.tokens)
+        bc.seq_lens = seq_lens
+
+    # ------------------------------------------------------------------
     # slot management
 
     def _admit_pending(self):
         for i, occupant in enumerate(self.slots):
             if occupant is not None or not self.pending:
                 continue
-            rid = self.pending.pop(0)
+            rid = self.pending[0]
             req = self.requests[rid]
             req.slot = i
+            if self._paged and not self._ensure_pages(
+                req, min(len(req.tokens), self.engine.serving.prefill_chunk)
+            ):
+                # pool cannot take the first chunk: stop admitting (a
+                # flush will free pages; the request stays queued) and
+                # roll back any partial cross-engine grant
+                self._release_pages(i)
+                req.slot = -1
+                break
+            self.pending.pop(0)
             req.status = RequestStatus.PREFILLING
             req.n_cached = 0
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
             self.slots[i] = rid
 
     def _active(self, status: RequestStatus) -> List[Request]:
@@ -162,6 +303,8 @@ class RequestManager:
         req.status = RequestStatus.COMPLETED
         req.profile.finish_time = time.perf_counter()
         if req.slot >= 0:
+            if self._paged:
+                self._release_pages(req.slot)
             self.slots[req.slot] = None
             req.slot = -1
         if self.output_file:
@@ -222,6 +365,7 @@ class RequestManager:
             bc.positions[req.slot, 0] = len(req.tokens) - 1
             bc.active[req.slot] = True
             bc.logits_idx[req.slot] = 0
+        self._attach_paging_metadata(bc)
         return bc
 
     # ------------------------------------------------------------------
@@ -341,6 +485,9 @@ class RequestManager:
     def step(self) -> bool:
         """One scheduling step. Returns False when no work remains."""
         self._admit_pending()
+        # paged KV: grow page tables to cover this step's writes BEFORE
+        # any dispatch (may preempt the newest admission on exhaustion)
+        self._reserve_active_pages()
         prefilling = self._active(RequestStatus.PREFILLING)
         decoding = self._active(RequestStatus.DECODING)
         if self.supports_fast_decode and decoding and not prefilling:

@@ -204,6 +204,10 @@ class SpecInferManager(RequestManager):
         assert (
             self.max_merged_tokens <= llm_engine.serving.max_spec_tree_tokens
         ), "merged tree larger than the cache's speculative slack region"
+        assert all(
+            getattr(s, "paged", False) == getattr(llm_engine, "paged", False)
+            for s in self.ssms
+        ), "LLM and SSM engines must agree on kv_layout"
 
     @property
     def max_merged_tokens(self) -> int:
@@ -213,6 +217,18 @@ class SpecInferManager(RequestManager):
     def ssm(self) -> InferenceEngine:
         """Primary SSM (kept for single-SSM callers/tests)."""
         return self.ssms[0]
+
+    def _engines(self):
+        """Page allocation/reclaim happens on the LLM and every SSM in
+        lockstep (shared slots + serving limits; pools sized per
+        engine)."""
+        return [self.engine, *self.ssms]
+
+    def _spec_lines(self, req: Request) -> int:
+        """Cache lines a speculate→verify→commit round touches: the
+        committed prefix plus the merged tree's slack lines (node i
+        writes line prefix + i)."""
+        return req.n_cached + self.max_merged_tokens + 1
 
     # ------------------------------------------------------------------
     # batch builders
@@ -246,6 +262,8 @@ class SpecInferManager(RequestManager):
                 bc.mask[req.slot, c, :prefix] = True
                 bc.mask[req.slot, c, prefix : prefix + len(tree)] = anc[node]
             bc.active[req.slot] = True
+        if getattr(engine, "paged", False):
+            bc.page_table = engine.pager.table.copy()
         return bc
 
     # ------------------------------------------------------------------
@@ -416,6 +434,9 @@ class SpecInferManager(RequestManager):
         self._admit_pending()
         if self._active(RequestStatus.PREFILLING):
             return super().step()
+        # paged KV: a spec round writes the whole tree's slack lines —
+        # reserve prefix + merged-tree pages on the LLM and every SSM
+        self._reserve_active_pages(self._spec_lines)
         decoding = self._active(RequestStatus.DECODING)
         if decoding:
             trees = self._grow_trees(decoding)

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from flexflow_tpu.core.mesh import set_mesh as _set_mesh
 from flexflow_tpu.models import llama
 from flexflow_tpu.ops.flash_attention import flash_attention
 
@@ -100,7 +101,7 @@ def test_make_train_step_flash_smoke():
         0, cfg.vocab_size, size=(2, 32)
     ).astype(np.int32)
     losses = {}
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         for attn in ("xla", "flash"):
             init_fn, step, ds = llama.make_train_step(
                 cfg, mesh, SGDOptimizer(lr=0.0), remat=True,
@@ -125,7 +126,7 @@ def test_remat_policy_dots_same_numerics():
         0, cfg.vocab_size, size=(2, 24)
     ).astype(np.int32)
     losses = {}
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         for pol in (None, "dots"):
             init_fn, step, ds = llama.make_train_step(
                 cfg, mesh, SGDOptimizer(lr=0.1), remat=True,

@@ -11,7 +11,7 @@ import pytest
 
 import flexflow_tpu as ff
 from flexflow_tpu.bench_search import build_searched_lm
-from flexflow_tpu.core.mesh import MachineSpec
+from flexflow_tpu.core.mesh import MachineSpec, set_mesh as _set_mesh
 from flexflow_tpu.models import llama
 from flexflow_tpu.optimizers import AdamOptimizer, SGDOptimizer
 from flexflow_tpu.search import CostModel, TPUChip, TPUTopology, optimize
@@ -106,7 +106,7 @@ def test_searched_compile_runs_and_learns():
     data = rng.integers(0, V, size=(B, S + 1)).astype(np.int32)
     x, y = {"tokens": data[:, :-1]}, data[:, 1:]
     losses = []
-    with jax.set_mesh(m.mesh):
+    with _set_mesh(m.mesh):
         batch = m._shard_batch(x)
         yb = m._shard_batch({"y": y})["y"]
         params, opt, st = m.params, m.opt_state, m.model_state
@@ -133,7 +133,7 @@ def test_searched_tp_megatron_matches_single_device():
         )
         rng = np.random.default_rng(1)
         data = rng.integers(0, V, size=(8, S + 1)).astype(np.int32)
-        with jax.set_mesh(m.mesh):
+        with _set_mesh(m.mesh):
             batch = m._shard_batch({"tokens": data[:, :-1]})
             yb = m._shard_batch({"y": data[:, 1:]})["y"]
             *_, loss, _m = m._train_step(
@@ -279,7 +279,7 @@ def test_param_state_executes_and_matches_dp():
         m._param_pspecs = strat.weight_pspecs(m.graph)
         m.config.data_parallelism_degree = 8
         m.compile(optimizer=SGDOptimizer(lr=0.0), metrics=())
-        with jax.set_mesh(m.mesh):
+        with _set_mesh(m.mesh):
             batch = m._shard_batch({"x": x})
             yb = m._shard_batch({"y": y})["y"]
             *_, loss, _mv = m._train_step(
@@ -327,7 +327,7 @@ def test_param_state_embedding_matches_dp():
         m._param_pspecs = strat.weight_pspecs(m.graph)
         m.config.data_parallelism_degree = 8
         m.compile(optimizer=SGDOptimizer(lr=0.0), metrics=())
-        with jax.set_mesh(m.mesh):
+        with _set_mesh(m.mesh):
             batch = m._shard_batch({"ids": x})
             yb = m._shard_batch({"y": y})["y"]
             *_, loss, _mv = m._train_step(

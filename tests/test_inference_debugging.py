@@ -71,6 +71,51 @@ def test_debug_dump_matches_real_step_tokens(tmp_path):
     assert gen(None) == gen(str(tmp_path / "d"))
 
 
+def test_debug_dump_generic_decoder_family(tmp_path):
+    """The hook must exist for the generic-decoder families too —
+    previously inference_debugging was a silent no-op for everything
+    but llama (ADVICE.md round 5)."""
+    from flexflow_tpu.models import opt
+
+    cfg = opt.tiny(dtype=jnp.float32)
+    params = opt.init_params(jax.random.PRNGKey(0), cfg)
+    outdir = str(tmp_path / "optdumps")
+    sc = ServingConfig(
+        max_requests_per_batch=2, max_sequence_length=32, prefill_chunk=4,
+        max_spec_tree_tokens=8, cache_dtype=jnp.float32,
+        inference_debugging=outdir,
+    )
+    rm = RequestManager(InferenceEngine(opt, cfg, params, sc))
+    assert rm.supports_fast_decode is False  # hook present → sync path
+    rm.generate([[3, 17, 91, 42]], max_new_tokens=2)
+    layer_files = glob.glob(os.path.join(outdir, "*", "step*_layer*.npy"))
+    assert layer_files, "generic decoder produced no activation dumps"
+    h = np.load(sorted(layer_files)[0])
+    assert h.shape[-1] == cfg.hidden_size
+
+
+def test_debug_dump_paged_layout(tmp_path):
+    """Dumps also work on the paged KV layout (reads through the page
+    table), and observing must not perturb tokens."""
+    cfg, params = _tiny()
+
+    def gen(dump):
+        sc = ServingConfig(
+            max_requests_per_batch=2, max_sequence_length=32,
+            prefill_chunk=4, max_spec_tree_tokens=8,
+            cache_dtype=jnp.float32, inference_debugging=dump,
+            kv_layout="paged", page_size=8,
+        )
+        rm = RequestManager(InferenceEngine(llama, cfg, params, sc))
+        return [o.output_tokens for o in rm.generate(
+            [[5, 9, 88], [3, 17, 91, 42]], max_new_tokens=4
+        )]
+
+    outdir = str(tmp_path / "paged")
+    assert gen(None) == gen(outdir)
+    assert glob.glob(os.path.join(outdir, "*", "step*_layer*.npy"))
+
+
 def test_env_var_switch(tmp_path, monkeypatch):
     outdir = str(tmp_path / "envdumps")
     monkeypatch.setenv("FF_INFERENCE_DEBUGGING", outdir)

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from flexflow_tpu.core.mesh import MachineSpec
+from flexflow_tpu.core.mesh import MachineSpec, set_mesh as _set_mesh
 from flexflow_tpu.models import llama
 from flexflow_tpu.optimizers import AdamOptimizer, SGDOptimizer
 
@@ -99,7 +99,7 @@ def test_vs_hf_transformers():
 
 def test_train_loss_decreases():
     mesh = MachineSpec().make_mesh(jax.devices()[:1])
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         init_fn, step, ds = llama.make_train_step(
             CFG, mesh, AdamOptimizer(lr=1e-2), remat=False,
             shard_activations=False,
@@ -142,7 +142,7 @@ def test_layout_equivalence(degrees):
         else:
             mesh = MachineSpec.from_degrees(8, **spec_degrees).make_mesh()
             mb = 2 if spec_degrees.get("pipeline", 1) > 1 else 1
-        with jax.set_mesh(mesh):
+        with _set_mesh(mesh):
             init_fn, step, ds = llama.make_train_step(
                 cfg, mesh, SGDOptimizer(lr=0.1), num_microbatches=mb
             )

@@ -37,6 +37,23 @@ def test_torus_multiplicity_speeds_up_model_axis_allreduce():
     assert t_torus16 == pytest.approx(t_flat16 / 4, rel=1e-3)
 
 
+def test_torus_multiplicity_walks_axis_order_on_asymmetric_torus():
+    """Mesh axes map onto the torus innermost-first (core.mesh
+    AXIS_ORDER): on a 2x8 torus with model=2, data=8, the data axis
+    rides ONLY the single size-8 torus dim (2 links) — the old
+    start-at-dim-0 walk credited it with both dims (4 links)."""
+    topo = TPUTopology(chip=TPUChip.v5e(), num_chips=16, torus=(2, 8))
+    degrees = {"data": 8, "expert": 1, "pipe": 1, "seq": 1, "model": 2}
+    assert topo.axis_link_multiplicity("model", 2, degrees) == 2
+    assert topo.axis_link_multiplicity("data", 8, degrees) == 2
+    # without the degree map the conservative dim-0 walk is unchanged
+    assert topo.axis_link_multiplicity("data", 8) == 4
+    # inner axes consuming the whole torus leave the outer axis 1 link
+    topo44 = TPUTopology(chip=TPUChip.v5e(), num_chips=16, torus=(4, 4))
+    d2 = {"data": 2, "expert": 1, "pipe": 1, "seq": 1, "model": 16}
+    assert topo44.axis_link_multiplicity("data", 2, d2) == 1
+
+
 def test_torus_multiplicity_never_applies_to_dcn_axes():
     topo = TPUTopology(
         chip=TPUChip.v5e(), num_chips=16, torus=(4, 4), dcn_axes=("data",)
@@ -124,15 +141,19 @@ def test_search_accepts_file_loaded_topology(tmp_path):
 
 
 def test_calibrate_chip_measures_and_clamps():
-    """calibrate_chip must return measured efficiencies in (0, 1] —
-    on this CPU host the fractions-of-TPU-peak are tiny, so they clamp
-    to the 0.05 floor, proving the measurement actually ran."""
+    """calibrate_chip must return measured efficiencies within the
+    documented clamp [0.05, 8.0] — the upper bound is deliberately >1
+    (hardware faster than the preset, e.g. a v5p calibrated against the
+    v5e numbers, legitimately measures above the assumed peak; see the
+    clamp comment in machine_model.calibrate_chip). On this CPU host the
+    fractions-of-TPU-peak are tiny and clamp to the 0.05 floor, proving
+    the measurement actually ran."""
     from flexflow_tpu.search.machine_model import calibrate_chip
 
     chip = TPUChip.v5e()
     cal = calibrate_chip(chip, iters=1)
-    assert 0.05 <= cal.mxu_efficiency <= 1.0
-    assert 0.05 <= cal.hbm_efficiency <= 1.0
+    assert 0.05 <= cal.mxu_efficiency <= 8.0
+    assert 0.05 <= cal.hbm_efficiency <= 8.0
     # presets elsewhere untouched
     assert cal.bf16_flops == chip.bf16_flops
 

@@ -1,0 +1,310 @@
+"""Paged KV cache tests (Ragged Paged Attention layout, serve/paging.py):
+allocator admit/evict/reclaim invariants, paged-vs-dense logit parity on
+mixed prefill/decode batches at the reference's 64 request slots
+(VERDICT.md round 5: serving had never been exercised past 8 of the
+reference's 64), Pallas-vs-XLA ragged kernel parity, and preemption
+(recompute-on-readmit) under an oversubscribed page budget.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import (
+    InferenceEngine,
+    PageAllocator,
+    RequestManager,
+    ServingConfig,
+    SpecConfig,
+    SpecInferManager,
+)
+from flexflow_tpu.serve.batch_config import BatchConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_engine(tiny, kv_layout, *, slots=4, page_size=16, max_seq=64,
+                spec_slack=8, **kw):
+    cfg, params = tiny
+    sc = ServingConfig(
+        max_requests_per_batch=slots,
+        max_sequence_length=max_seq,
+        prefill_chunk=8,
+        max_spec_tree_tokens=spec_slack,
+        cache_dtype=jnp.float32,
+        kv_layout=kv_layout,
+        page_size=page_size,
+        **kw,
+    )
+    return InferenceEngine(llama, cfg, params, sc)
+
+
+# ---------------------------------------------------------------------------
+# allocator invariants
+
+
+class TestPageAllocator:
+    def test_ensure_grows_idempotently(self):
+        pa = PageAllocator(num_pages=8, pages_per_slot=4, num_slots=3,
+                           page_size=16)
+        assert pa.ensure(0, 17)  # 2 pages
+        assert pa.slot_pages(0) == 2
+        assert pa.ensure(0, 17)  # idempotent: nothing new
+        assert pa.slot_pages(0) == 2
+        assert pa.ensure(0, 33)  # grows by one
+        assert pa.slot_pages(0) == 3
+        assert pa.used_pages == 3 and pa.free_pages == 5
+        pa.check_no_leaks()
+
+    def test_distinct_physical_pages_across_slots(self):
+        pa = PageAllocator(8, 4, 3, 16)
+        assert pa.ensure(0, 40) and pa.ensure(1, 40)
+        owned0 = set(pa.table[0]) - {pa.scratch_page}
+        owned1 = set(pa.table[1]) - {pa.scratch_page}
+        assert owned0 and owned1 and not (owned0 & owned1)
+        pa.check_no_leaks()
+
+    def test_exhaustion_is_all_or_nothing(self):
+        pa = PageAllocator(4, 4, 2, 16)
+        assert pa.ensure(0, 3 * 16)  # 3 of 4 pages
+        before = pa.table.copy()
+        assert not pa.ensure(1, 2 * 16)  # needs 2, only 1 free
+        np.testing.assert_array_equal(pa.table, before)  # nothing leaked
+        assert pa.free_pages == 1
+        pa.check_no_leaks()
+
+    def test_release_reclaims_and_double_release_is_noop(self):
+        pa = PageAllocator(8, 4, 2, 16)
+        pa.ensure(0, 50)
+        freed = pa.release(0)
+        assert freed == 4 and pa.free_pages == 8
+        assert pa.release(0) == 0  # no double-free
+        assert pa.free_pages == 8
+        pa.check_no_leaks()
+
+    def test_pool_smaller_than_one_request_rejected(self):
+        with pytest.raises(ValueError, match="smaller than one request"):
+            PageAllocator(2, 4, 2, 16)
+
+
+# ---------------------------------------------------------------------------
+# paged vs dense parity
+
+
+def _mixed_batch_logits(tiny, kv_layout):
+    """One prefill step for half the slots, then a MIXED step: those
+    slots decode one token while the other half prefills — the batch
+    shape continuous batching actually produces. 64 slots. Shapes are
+    chosen page-aligned (cache_len+1 == pages_per_slot*page_size) so the
+    virtual cache is shape-identical to the dense one and logits must
+    match bit-for-bit on the XLA path."""
+    cfg, params = tiny
+    R = 64
+    eng = make_engine(tiny, kv_layout, slots=R, page_size=32, max_seq=96,
+                      spec_slack=31)  # cache_len+1 = 128 = 4 pages of 32
+    assert eng.serving.cache_len + 1 == 128
+    scratch = eng.scratch_pos
+    first, second = range(0, R, 2), range(1, R, 2)
+    prompts = {
+        r: [(r * 13 + j * 7 + 1) % cfg.vocab_size for j in range(5)]
+        for r in range(R)
+    }
+    if kv_layout == "paged":
+        for r in range(R):
+            assert eng.pager.ensure(r, 8)
+
+    out = []  # (active-slot logits only: idle slots' rows are garbage
+    # BY CONTRACT — fully-masked attention reads the scratch page/row,
+    # and the scheduler never samples them)
+    bc = BatchConfig.empty(R, 8, scratch)
+    for r in first:  # prefill the even slots
+        bc.tokens[r, :5] = prompts[r]
+        bc.positions[r, :5] = np.arange(5)
+        bc.logits_idx[r] = 4
+        bc.active[r] = True
+    out.append(np.asarray(jax.device_get(eng.run(bc)))[list(first)])
+
+    bc = BatchConfig.empty(R, 8, scratch)  # mixed prefill + decode
+    for r in first:  # decode one token
+        bc.tokens[r, 0] = 7 + r % 5
+        bc.positions[r, 0] = 5
+        bc.logits_idx[r] = 0
+        bc.active[r] = True
+    for r in second:  # prefill the odd slots
+        bc.tokens[r, :5] = prompts[r]
+        bc.positions[r, :5] = np.arange(5)
+        bc.logits_idx[r] = 4
+        bc.active[r] = True
+    out.append(np.asarray(jax.device_get(eng.run(bc))))  # all slots active
+    return out
+
+
+class TestPagedDenseParity:
+    def test_mixed_batch_logits_bitwise_at_64_slots(self, tiny):
+        dense = _mixed_batch_logits(tiny, "dense")
+        paged = _mixed_batch_logits(tiny, "paged")
+        for d, p in zip(dense, paged):
+            np.testing.assert_array_equal(d, p)
+
+    def test_generate_64_slots_matches_dense(self, tiny):
+        cfg, _ = tiny
+        prompts = [
+            [(i * 37 + j * 11 + 3) % cfg.vocab_size
+             for j in range(2 + i % 9)]
+            for i in range(64)
+        ]
+        outs = {}
+        for layout in ("dense", "paged"):
+            rm = RequestManager(make_engine(tiny, layout, slots=64))
+            outs[layout] = [
+                o.output_tokens
+                for o in rm.generate(prompts, max_new_tokens=5)
+            ]
+            if layout == "paged":
+                # every request completed → every page reclaimed
+                pa = rm.engine.pager
+                assert pa.free_pages == pa.num_pages
+                pa.check_no_leaks()
+        assert outs["paged"] == outs["dense"]
+
+    def test_hbm_proportional_to_live_tokens(self, tiny):
+        """The point of paging: a 64-slot paged engine's ALLOCATED KV
+        bytes scale with live tokens, not slots × max_len."""
+        eng = make_engine(tiny, "paged", slots=64, page_size=16,
+                          max_cached_tokens=256)
+        dense_equiv = (
+            64 * (eng.serving.cache_len + 1) * eng.kv_bytes_per_line()
+        )
+        assert eng.kv_cache_bytes() < dense_equiv / 4  # pool ≪ dense
+        assert eng.kv_allocated_bytes() == 0  # nothing live yet
+        assert eng.pager.ensure(0, 20)  # 2 pages
+        assert eng.kv_allocated_bytes() == int(
+            2 * 16 * eng.kv_bytes_per_line()
+        )
+
+    def test_preemption_recompute_matches(self, tiny):
+        """An oversubscribed pool must preempt + re-admit without
+        changing any output (recompute preemption)."""
+        cfg, _ = tiny
+        prompts = [
+            [(i * 7 + j * 3 + 1) % cfg.vocab_size for j in range(4 + i)]
+            for i in range(4)
+        ]
+        ref = RequestManager(make_engine(tiny, "dense"))
+        want = [o.output_tokens for o in ref.generate(prompts, max_new_tokens=6)]
+        # 48-token budget ≈ 1.5 requests' worth of pages → forced evictions
+        rm = RequestManager(
+            make_engine(tiny, "paged", max_cached_tokens=48)
+        )
+        got = [o.output_tokens for o in rm.generate(prompts, max_new_tokens=6)]
+        assert got == want
+        rm.engine.pager.check_no_leaks()
+        assert rm.engine.pager.free_pages == rm.engine.pager.num_pages
+
+    def test_specinfer_paged_matches_dense_greedy(self, tiny):
+        cfg, params = tiny
+        dcfg = llama.LLaMAConfig.tiny(
+            dtype=jnp.float32, num_hidden_layers=1
+        )
+        dparams = {
+            "embed": params["embed"],
+            "layers": {k: v[:1] for k, v in params["layers"].items()},
+            "final_norm": params["final_norm"],
+            "lm_head": params["lm_head"],
+        }
+        prompts = [[3, 17, 91, 42, 7], [9, 8, 7, 6, 5], [42] * 9]
+        ref = RequestManager(make_engine(tiny, "dense", spec_slack=16))
+        want = [o.output_tokens
+                for o in ref.generate(prompts, max_new_tokens=8)]
+        mgr = SpecInferManager(
+            make_engine(tiny, "paged", spec_slack=16),
+            InferenceEngine(
+                llama, dcfg, dparams,
+                ServingConfig(
+                    max_requests_per_batch=4, max_sequence_length=64,
+                    prefill_chunk=8, max_spec_tree_tokens=16,
+                    cache_dtype=jnp.float32, kv_layout="paged",
+                    page_size=16,
+                ),
+            ),
+            SpecConfig(beam_width=2, beam_depth=3),
+        )
+        got = [o.output_tokens
+               for o in mgr.generate(prompts, max_new_tokens=8)]
+        assert got == want
+        for eng in (mgr.engine, mgr.ssm):
+            eng.pager.check_no_leaks()
+            assert eng.pager.free_pages == eng.pager.num_pages
+
+
+# ---------------------------------------------------------------------------
+# sharded serving
+
+
+def test_paged_tp_serving_matches_single_device(tiny):
+    """Tensor-parallel paged serving: pages shard on ``data``, KV heads
+    on ``model`` — a tp2 mesh must produce the single-device tokens."""
+    from flexflow_tpu.core.mesh import MachineSpec
+    from flexflow_tpu.serve.llm import LLM
+
+    cfg, params = tiny
+    prompts = [[3, 17, 91, 42, 7], [9, 8, 7, 6, 5]]
+    single = RequestManager(make_engine(tiny, "paged"))
+    want = [o.output_tokens for o in single.generate(prompts, max_new_tokens=6)]
+
+    sc = ServingConfig(
+        max_requests_per_batch=4, max_sequence_length=64, prefill_chunk=8,
+        max_spec_tree_tokens=8, cache_dtype=jnp.float32,
+        kv_layout="paged", page_size=16,
+    )
+    mesh = MachineSpec(model=2).make_mesh(jax.devices()[:2])
+    m = LLM(llama, cfg, params, mesh=mesh)
+    m.compile(sc)
+    got = [o.output_tokens for o in m.generate(prompts, max_new_tokens=6)]
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# kernel parity
+
+
+class TestRaggedKernel:
+    def test_pallas_matches_xla_fallback(self):
+        """The fused ragged paged kernel (interpret mode off-TPU) must
+        match the jnp.take-based fallback — decode (C=1) and tree-
+        verify (C>1, ragged mask) shapes."""
+        from flexflow_tpu.serve import kernels as K
+
+        rng = np.random.default_rng(1)
+        for C in (1, 4):
+            R, H, KV, dk, P1, ps, NP = 3, 8, 4, 16, 9, 16, 4
+            q = jnp.asarray(rng.normal(size=(R, C, H, dk)), jnp.float32)
+            kp = jnp.asarray(rng.normal(size=(P1, ps, KV, dk)), jnp.float32)
+            vp = jnp.asarray(rng.normal(size=(P1, ps, KV, dk)), jnp.float32)
+            pt = jnp.asarray(rng.integers(0, P1, size=(R, NP)), jnp.int32)
+            mask = jnp.asarray(rng.random(size=(R, C, NP * ps)) < 0.4)
+            mask = mask.at[:, :, 0].set(True)
+            got = K.ragged_paged_attention(q, kp, vp, pt, mask)
+            want = K.ragged_paged_attention_xla(q, kp, vp, pt, mask)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-2, rtol=1e-2
+            )
+
+    def test_paged_pallas_serving_matches_xla(self, tiny):
+        """End-to-end: kernels='pallas' on a paged engine decodes the
+        same tokens as the XLA gather path."""
+        prompts = [[3, 17, 91, 42, 7], [9, 8, 7, 6, 5]]
+        outs = {}
+        for kern in ("xla", "pallas"):
+            rm = RequestManager(make_engine(tiny, "paged", kernels=kern))
+            outs[kern] = [
+                o.output_tokens
+                for o in rm.generate(prompts, max_new_tokens=8)
+            ]
+        assert outs["pallas"] == outs["xla"]

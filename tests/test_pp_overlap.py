@@ -16,7 +16,7 @@ import pytest
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from flexflow_tpu.core.mesh import DATA_AXIS, PIPE_AXIS, MachineSpec
+from flexflow_tpu.core.mesh import DATA_AXIS, PIPE_AXIS, MachineSpec, set_mesh as _set_mesh
 from flexflow_tpu.parallel.pipeline import make_pipelined_serve
 
 
@@ -59,7 +59,7 @@ def test_overlapped_schedule_matches_unoverlapped(pp):
     cache = jnp.zeros((L, R, 4, D), jnp.float32)
     row = {"scale": jnp.arange(R, dtype=jnp.float32)}
     outs = {}
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
         for M in (1, None):  # None -> defaults to pp groups
             piped = jax.jit(_make(mesh, M))
@@ -94,7 +94,7 @@ def test_overlap_reduces_total_work():
     scale = jnp.ones((R,), jnp.float32)
 
     times = {}
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
         args = (
             put(layers, P(PIPE_AXIS)),

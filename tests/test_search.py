@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import pytest
 
 import flexflow_tpu as ff
-from flexflow_tpu.core.mesh import MachineSpec
+from flexflow_tpu.core.mesh import MachineSpec, set_mesh as _set_mesh
 from flexflow_tpu.search import (
     CostModel,
     ParallelStrategy,
@@ -315,7 +315,7 @@ def test_planner_spec_runs_in_make_train_step():
         num_heads=cfg.num_attention_heads, batch=8, seq=32,
     )
     mesh = plan.spec.make_mesh(jax.devices()[:8])
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         init_fn, step, ds = llama.make_train_step(
             cfg, mesh, AdamOptimizer(lr=1e-3), remat=False,
             num_microbatches=2 if plan.spec.pipe > 1 else 1,

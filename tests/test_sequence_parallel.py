@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from flexflow_tpu.core.mesh import MachineSpec
+from flexflow_tpu.core.mesh import MachineSpec, set_mesh as _set_mesh
 from flexflow_tpu.parallel.sequence import ring_attention, ulysses_attention
 
 B, S, H, D = 2, 32, 4, 8
@@ -44,7 +44,7 @@ def mesh(request):
 def test_ring_attention_matches_dense(qkv, mesh, causal):
     q, k, v = qkv
     ref = _dense_reference(q, k, v, causal)
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         out = jax.jit(
             lambda a, b, c: ring_attention(a, b, c, mesh, causal=causal)
         )(q, k, v)
@@ -57,7 +57,7 @@ def test_ulysses_matches_dense(qkv, mesh, causal):
     if mesh.shape["seq"] > H // max(1, mesh.shape["model"]):
         pytest.skip("heads per TP shard not divisible by seq degree")
     ref = _dense_reference(q, k, v, causal)
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         out = jax.jit(
             lambda a, b, c: ulysses_attention(a, b, c, mesh, causal=causal)
         )(q, k, v)
@@ -77,7 +77,7 @@ def test_sp_odd_sequence_length(impl, causal):
     spec = MachineSpec(data=2, seq=4)
     mesh = spec.make_mesh(jax.devices()[:8])
     ref = _dense_reference(q, k, v, causal)
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         out = jax.jit(lambda a, b, c: impl(a, b, c, mesh, causal=causal))(q, k, v)
     assert out.shape == (B, S_odd, H, D)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
@@ -96,7 +96,7 @@ def test_llama_train_step_with_ring_sp():
         np.random.default_rng(0).integers(0, cfg.vocab_size, size=(4, 33)),
         jnp.int32,
     )
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         init_fn, step, data_sharding = llama.make_train_step(
             cfg, mesh, AdamOptimizer(lr=1e-3), remat=False
         )
@@ -106,7 +106,7 @@ def test_llama_train_step_with_ring_sp():
     # single-device reference loss on the same params
     spec1 = MachineSpec()
     mesh1 = spec1.make_mesh(jax.devices()[:1])
-    with jax.set_mesh(mesh1):
+    with _set_mesh(mesh1):
         init1, step1, ds1 = llama.make_train_step(
             cfg, mesh1, AdamOptimizer(lr=1e-3), remat=False,
             shard_activations=False,
