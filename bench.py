@@ -179,6 +179,9 @@ _PHASES = [
     # 64-slot paged-KV serving vs the dense 8-slot ceiling (the
     # reference's 64 request slots, VERDICT.md round 5 missing #3)
     ("serve_paged", 900, 600, True, True),
+    # continuous batching under Poisson arrivals at 64 slots vs the
+    # flush-on-admit scheduler (tokens/sec/chip + TTFT/TPOT p50/p99)
+    ("serve_continuous", 900, 600, True, True),
     ("serve_int8", 600, 400, True, True),
     ("searched", 700, 400, False, True),
     ("serve_int4", 600, 400, True, True),
@@ -244,6 +247,7 @@ def orchestrate(which):
     order = (
         "specinfer_tokens_per_sec_per_chip",
         "incr_decode_tokens_per_sec_per_chip",
+        "continuous_serve_tokens_per_sec_per_chip",
         "paged_serve_tokens_per_sec_per_chip",
         "specinfer_tokens_per_sec_7b_int4",
         "incr_decode_tokens_per_sec_int8",
@@ -775,6 +779,183 @@ def serve_paged_bench(on_tpu, kernels):
     return paged_tps
 
 
+def serve_continuous_bench(on_tpu, kernels):
+    """Continuous batching under churn: Poisson arrivals into 64 paged
+    request slots, continuous (pipelined mixed-step) scheduler vs the
+    flush-on-admit baseline (``continuous_batching=False`` — the prior
+    scheduler, which drains the dispatch-ahead pipeline and drops to a
+    blocking sync step whenever any request is PREFILLING). Reports
+    tokens/sec/chip with TTFT and TPOT p50/p99 for both schedulers;
+    vs_baseline is the throughput ratio.
+
+    Measurement caveat (CPU): XLA:CPU executes the step inline in the
+    dispatching thread and its GEMMs leave enough multicore slack that
+    step cost is nearly width-independent, so the two structural wins —
+    dispatch-ahead overlap across admissions, and narrow mixed steps
+    that stop charging decode rows the prompt-chunk width — both vanish
+    there: the schedulers measure step-for-step equivalent (~1.0x
+    throughput; the continuous side still shows lower TPOT, the
+    baseline lower TTFT because pipelined tokens surface dispatch_ahead
+    flushes late). The CPU run is therefore a parity/latency smoke; the
+    throughput claim is an accelerator property. On TPU the phase runs
+    narrow mixed steps (max_tokens_per_step=8 vs prefill_chunk=32)
+    where both effects are real. Greedy outputs are
+    asserted identical across schedulers (the mixed step's logits are
+    bitwise-equal to the sync path — tests/test_continuous_batching.py)."""
+    import jax
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import InferenceEngine, RequestManager, ServingConfig
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_slots = 64
+    n_req = 128 if on_tpu else 96
+    n_new = 32 if on_tpu else 16
+    prompt_len = 64 if on_tpu else 24
+    page_size = 64 if on_tpu else 16
+    # The baseline (flush-on-admit sync scheduler) runs its natural
+    # large-chunk operating point — one blocking round trip per chunk
+    # makes small chunks prohibitive for it. On TPU the continuous
+    # scheduler uses the same prefill_chunk but a small per-row
+    # mixed-step budget (max_tokens_per_step): the pipeline makes small
+    # steps cheap, so decode rows stop paying for prompt-wide batch
+    # rows under churn. On CPU steps are width-flat (see docstring), so
+    # the continuous side runs full-width mixed steps (budget 0).
+    prefill_chunk = 32 if on_tpu else 24
+    mixed_budget = 8 if on_tpu else 0
+    if not on_tpu and kernels == "pallas":
+        _log("serve_continuous: forcing kernels=xla off-TPU (interpret-"
+             "mode pallas would dominate the measurement)")
+        kernels = "xla"
+
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_req)
+    ]
+
+    def make_rm(continuous):
+        sc = ServingConfig(
+            max_requests_per_batch=n_slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=prefill_chunk,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=page_size,
+            # ample pool: churn, not preemption, is the variable here
+            max_cached_tokens=n_slots * (prompt_len + n_new + page_size),
+            continuous_batching=continuous,
+            max_tokens_per_step=mixed_budget if continuous else 0,
+        )
+        rm = RequestManager(InferenceEngine(llama, cfg, params, sc))
+        rm.generate(prompts[:n_slots], max_new_tokens=4)  # warm/compile
+        return rm
+
+    def percentiles(vals):
+        if not vals:
+            return 0.0, 0.0
+        import numpy as np
+
+        return (float(np.percentile(vals, 50)), float(np.percentile(vals, 99)))
+
+    def run(rm, arrival_s):
+        """Open-loop run: requests arrive on the wall-clock Poisson
+        schedule; the scheduler is stepped until everything drains."""
+        rids, outs = [], {}
+        due = list(zip(arrival_s, prompts))
+        t0 = time.perf_counter()
+        while due or any(
+            rm.requests[r].status.value not in ("completed", "error")
+            for r in rids
+        ):
+            now = time.perf_counter() - t0
+            while due and due[0][0] <= now:
+                _, p = due.pop(0)
+                rids.append(rm.submit(p, max_new_tokens=n_new))
+            if not rm.step() and due:
+                time.sleep(max(0.0, due[0][0] - (time.perf_counter() - t0)))
+        rm.drain()
+        wall = time.perf_counter() - t0
+        tokens = 0
+        ttft, tpot = [], []
+        for r in rids:
+            req = rm.requests[r]
+            out = req.output_tokens
+            outs[r] = list(out)
+            tokens += len(out)
+            ttft.append(req.profile.ttft_s * 1e3)
+            tpot.append(req.profile.tpot_s(len(out)) * 1e3)
+        return {
+            "tps": tokens / wall,
+            "ttft": percentiles(ttft),
+            "tpot": percentiles(tpot),
+            "outputs": [outs[r] for r in rids],
+            "stats": rm.stats.snapshot(),
+        }
+
+    # Calibrate the Poisson arrival rate to the continuous scheduler's
+    # closed-loop capacity: arrivals then span the WHOLE run (sustained
+    # churn — every iteration has prompts in flight) instead of a
+    # front-loaded burst followed by a pure-decode drain both schedulers
+    # serve identically. The slower scheduler falls behind the same
+    # offered load, which is exactly the claim under test.
+    rm_cont = make_rm(continuous=True)
+    t0 = time.perf_counter()
+    rm_cont.generate(prompts[:n_slots], max_new_tokens=n_new)
+    est_tps = (n_slots * n_new) / (time.perf_counter() - t0)
+    offered = 1.0 * est_tps
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    arrival_s = np.cumsum(
+        rng.exponential(scale=n_new / offered, size=n_req)
+    ).tolist()
+
+    # fresh stats for the measured run (the calibration generate above
+    # already warmed every program shape)
+    rm_cont.stats = type(rm_cont.stats)()
+    cont = run(rm_cont, arrival_s)
+    del rm_cont
+    base = run(make_rm(continuous=False), arrival_s)
+
+    assert cont["outputs"] == base["outputs"], (
+        "continuous vs flush-on-admit scheduler outputs diverged"
+    )
+    ratio = cont["tps"] / max(1e-9, base["tps"])
+    emit(
+        "continuous_serve_tokens_per_sec_per_chip",
+        round(cont["tps"], 2),
+        "tokens/sec/chip",
+        vs_baseline=ratio,
+        kernels=kernels,
+        n_requests=n_req,
+        n_slots=n_slots,
+        new_tokens_per_request=n_new,
+        prompt_len=prompt_len,
+        prefill_chunk=prefill_chunk,
+        max_tokens_per_step=mixed_budget,
+        offered_tokens_per_sec=round(offered, 1),
+        ttft_p50_ms=round(cont["ttft"][0], 1),
+        ttft_p99_ms=round(cont["ttft"][1], 1),
+        tpot_p50_ms=round(cont["tpot"][0], 2),
+        tpot_p99_ms=round(cont["tpot"][1], 2),
+        baseline_tokens_per_sec=round(base["tps"], 2),
+        baseline_ttft_p50_ms=round(base["ttft"][0], 1),
+        baseline_ttft_p99_ms=round(base["ttft"][1], 1),
+        baseline_tpot_p50_ms=round(base["tpot"][0], 2),
+        baseline_tpot_p99_ms=round(base["tpot"][1], 2),
+        scheduler_parity=1,
+        mean_occupancy=cont["stats"]["mean_occupancy"],
+        mean_budget_fill=cont["stats"]["mean_budget_fill"],
+        pipeline_drains=cont["stats"]["pipeline_drains"],
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return cont["tps"]
+
+
 def serve_quantized_bench(on_tpu, kernels, bits):
     """Weight-only int8/int4 serving (reference --8bit/4bit-quantization,
     file_loader.cc:651,710 + decompress kernels): decode is
@@ -923,6 +1104,8 @@ def child_main(phase, platform, kernels):
         serve_bench(on_tpu, kernels)
     elif phase == "serve_paged":
         serve_paged_bench(on_tpu, kernels)
+    elif phase == "serve_continuous":
+        serve_continuous_bench(on_tpu, kernels)
     elif phase == "serve_int8":
         serve_quantized_bench(on_tpu, kernels, bits=8)
     elif phase == "serve_int4":
@@ -939,7 +1122,8 @@ def main():
         "--metric",
         default="all",
         choices=["all", "train", "searched", "parity", "serve",
-                 "serve_paged", "serve_int8", "serve_int4", "serve_7b"],
+                 "serve_paged", "serve_continuous", "serve_int8",
+                 "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)

@@ -617,12 +617,13 @@ def serve_step(
     x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
     cos, sin = rope_freqs(cfg, positions)
     if mask is None:
-        # Causal-by-position: a token attends to every cache line at
-        # position <= its own. Only positions already written satisfy
-        # this, so stale lines from an evicted request are never read.
-        key_pos = jnp.arange(S1, dtype=jnp.int32)
-        mask = key_pos[None, None, :] <= positions[:, :, None]
-        mask = mask & (key_pos[None, None, :] < S1 - 1)  # never the scratch row
+        # Causal-by-position (serve/kernels.causal_serve_mask): a token
+        # attends every cache line at position <= its own. Only
+        # positions already written satisfy this, so stale lines from an
+        # evicted request are never read.
+        from ..serve.kernels import causal_serve_mask
+
+        mask = causal_serve_mask(positions, S1)
 
     def scan_body(h, xs):
         p_l, kc, vc = xs
@@ -717,9 +718,9 @@ def serve_debug_activations(
         return acts
     S1 = cache["k"].shape[2]
     if mask is None:
-        key_pos = jnp.arange(S1, dtype=jnp.int32)
-        mask = key_pos[None, None, :] <= positions[:, :, None]
-        mask = mask & (key_pos[None, None, :] < S1 - 1)
+        from ..serve.kernels import causal_serve_mask
+
+        mask = causal_serve_mask(positions, S1)
     for l in range(cfg.num_hidden_layers):
         p_l = jax.tree.map(lambda a: a[l], params["layers"])
         x, _, _ = serve_block(
@@ -818,17 +819,13 @@ def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
 def _paged_mask(mask, positions, page_table, page_size, cache_len):
     """Default causal-by-position mask over the virtual cache, or an
     explicit (R, C, cache_len+1) mask padded out to the page-aligned
-    virtual length (padding is never-attended)."""
-    S_virt = page_table.shape[1] * page_size
-    if mask is None:
-        key_pos = jnp.arange(S_virt, dtype=jnp.int32)
-        mask = key_pos[None, None, :] <= positions[:, :, None]
-        # never the scratch line (padding tokens write there)
-        return mask & (key_pos[None, None, :] < cache_len)
-    if mask.shape[-1] < S_virt:
-        pad = S_virt - mask.shape[-1]
-        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, pad)))
-    return mask
+    virtual length (serve/kernels.paged_serve_mask — shared with the
+    generic decoder)."""
+    from ..serve.kernels import paged_serve_mask
+
+    return paged_serve_mask(
+        mask, positions, page_table.shape[1], page_size, cache_len
+    )
 
 
 def serve_step_paged(

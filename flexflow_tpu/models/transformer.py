@@ -720,9 +720,9 @@ def serve_step(
     x = _embed_in(cfg, params, tokens, positions)
     rope = rope_freqs(cfg, positions) if cfg.positions == "rope" else None
     if mask is None:
-        key_pos = jnp.arange(S1, dtype=jnp.int32)
-        mask = key_pos[None, None, :] <= positions[:, :, None]
-        mask = mask & (key_pos[None, None, :] < S1 - 1)
+        from ..serve.kernels import causal_serve_mask
+
+        mask = causal_serve_mask(positions, S1)
 
     bias = None
     pos_cache = None
@@ -925,17 +925,11 @@ def _paged_serve_context(cfg, cache, positions, cache_positions, mask,
     """Shared prologue of the paged step/debug paths: page lookup, the
     causal-or-padded mask over the virtual cache, and the paged position
     buffer + ALiBi bias/sliding-window refinement."""
-    from ..serve.kernels import gather_pages
+    from ..serve.kernels import gather_pages, paged_serve_mask
 
     ps = cache["k"].shape[2]
-    S_virt = page_table.shape[1] * ps
     phys, off = _page_lookup(page_table, cache_positions, ps)
-    if mask is None:
-        key_pos = jnp.arange(S_virt, dtype=jnp.int32)
-        mask = key_pos[None, None, :] <= positions[:, :, None]
-        mask = mask & (key_pos[None, None, :] < cache_len)  # scratch line
-    elif mask.shape[-1] < S_virt:
-        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, S_virt - mask.shape[-1])))
+    mask = paged_serve_mask(mask, positions, page_table.shape[1], ps, cache_len)
 
     bias = None
     pos_pool = None
@@ -1084,9 +1078,9 @@ def serve_debug_activations(
     R = tokens.shape[0]
     S1 = cache["k"].shape[2]
     if mask is None:
-        key_pos = jnp.arange(S1, dtype=jnp.int32)
-        mask = key_pos[None, None, :] <= positions[:, :, None]
-        mask = mask & (key_pos[None, None, :] < S1 - 1)
+        from ..serve.kernels import causal_serve_mask
+
+        mask = causal_serve_mask(positions, S1)
     bias = None
     if needs_pos_cache(cfg):
         bidx = jnp.arange(R)[:, None]

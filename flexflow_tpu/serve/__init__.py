@@ -6,11 +6,16 @@ TPU-native counterpart of the reference serving layer (reference
 becomes an async host loop over donated-buffer jitted step functions; the
 three attention operators become one compiled program per static mode.
 """
-from .batch_config import BatchConfig, GenerationConfig, GenerationResult
+from .batch_config import (
+    BatchConfig,
+    GenerationConfig,
+    GenerationResult,
+    StreamEvent,
+)
 from .engine import InferenceEngine, ServingConfig
 from .llm import LLM, SSM, detect_family
 from .paging import PageAllocator
-from .request_manager import Request, RequestManager
+from .request_manager import Request, RequestManager, RequestStatus
 from .sampling import sample_tokens
 from .specinfer import SpecConfig, SpecInferManager, TokenTree
 
@@ -24,8 +29,10 @@ __all__ = [
     "SSM",
     "detect_family",
     "ServingConfig",
+    "StreamEvent",
     "Request",
     "RequestManager",
+    "RequestStatus",
     "sample_tokens",
     "SpecConfig",
     "SpecInferManager",

@@ -51,6 +51,12 @@ class BatchConfig:
     # tokens for each active slot (0 for idle slots) — the kernel-side
     # sequence-length metadata of the ragged batch.
     seq_lens: Optional[np.ndarray] = None  # (R,) int32
+    # Ragged per-row QUERY lengths: how many of this row's chunk columns
+    # carry real tokens this step (decode rows 1, prefill rows up to the
+    # chunk, idle rows 0). The mixed continuous-batching step pads every
+    # row to the static chunk; qlens is the ragged truth the scheduler
+    # and tests reason about.
+    qlens: Optional[np.ndarray] = None  # (R,) int32
 
     @property
     def num_slots(self) -> int:
@@ -90,10 +96,14 @@ class GenerationConfig:
 @dataclasses.dataclass
 class ProfileInfo:
     """Per-request profiling (reference ``ProfileInfo``,
-    request_manager.h:271-277: llm_decoding_steps + start/finish)."""
+    request_manager.h:271-277: llm_decoding_steps + start/finish).
+    ``first_token_time`` is stamped when the host observes the request's
+    first sampled token (TTFT as a client would measure it — with the
+    dispatch-ahead pipeline that is the flush, not the device sample)."""
 
     start_time: float = 0.0
     finish_time: float = 0.0
+    first_token_time: float = 0.0
     llm_decoding_steps: int = 0
     ssm_decoding_steps: int = 0
     speculated_tokens: int = 0
@@ -103,11 +113,29 @@ class ProfileInfo:
     def latency_s(self) -> float:
         return max(0.0, self.finish_time - self.start_time)
 
+    @property
+    def ttft_s(self) -> float:
+        """Time to first token (0 when no token was ever produced)."""
+        if not self.first_token_time:
+            return 0.0
+        return max(0.0, self.first_token_time - self.start_time)
+
+    def tpot_s(self, n_output_tokens: int) -> float:
+        """Time per output token over the decode phase (first token →
+        finish; 0 with fewer than two output tokens)."""
+        if n_output_tokens < 2 or not self.first_token_time:
+            return 0.0
+        span = max(0.0, self.finish_time - self.first_token_time)
+        return span / (n_output_tokens - 1)
+
 
 @dataclasses.dataclass
 class GenerationResult:
     """reference ``GenerationResult`` (request_manager.h): token ids in +
-    out, detokenized text, profiling."""
+    out, detokenized text, profiling. ``error`` is set (and the token
+    lists may be empty/partial) when the request failed instead of
+    completing — e.g. it could never be admitted under the configured
+    KV budget."""
 
     request_id: int
     prompt: str
@@ -115,3 +143,17 @@ class GenerationResult:
     output_tokens: List[int]
     output_text: str
     profile: ProfileInfo
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class StreamEvent:
+    """One ``generate_stream`` event: a newly drained token for
+    ``request_id``, or (``done=True``, ``token=None``) the request's
+    terminal event — with ``error`` set when it failed rather than
+    completed."""
+
+    request_id: int
+    token: Optional[int]
+    done: bool = False
+    error: Optional[str] = None

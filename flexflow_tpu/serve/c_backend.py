@@ -97,13 +97,15 @@ def step() -> int:
 
 
 def num_active() -> int:
-    """Requests not yet completed (pending + in slots)."""
-    from .request_manager import RequestStatus
+    """Requests not yet terminal (pending + in slots). ERROR requests
+    count as done — a request that can never be served must not keep
+    the C host's step loop spinning."""
+    from .request_manager import TERMINAL_STATUSES
 
     rm = _STATE["rm"]
     return sum(
         1 for r in rm.requests.values()
-        if r.status is not RequestStatus.COMPLETED
+        if r.status not in TERMINAL_STATUSES
     )
 
 

@@ -41,6 +41,27 @@ class ServingConfig:
     # step behind (the reference's 4-deep batch-future pipeline,
     # request_manager.cc:2310-2325).
     dispatch_ahead: int = 4
+    # Iteration-level continuous batching: prefill chunks ride in the
+    # SAME pipelined step as decode rows (one jitted "mixed step" with
+    # on-device sampling for decode rows and prefill-final rows), so
+    # admissions, chunk progression and completions never drain the
+    # dispatch-ahead pipeline. False restores the flush-on-admit
+    # scheduler (any PREFILLING request forces the blocking sync path) —
+    # kept as the bench baseline and an escape hatch.
+    continuous_batching: bool = True
+    # Per-step chunked-prefill token budget of the mixed step: each
+    # prefilling slot contributes at most this many NEW prompt tokens
+    # per iteration (decode rows are not budgeted — they always get
+    # their one token). It is the mixed step's compiled row width
+    # C = min(prefill_chunk, max_tokens_per_step), so it directly bounds
+    # the compute (R×C) — and therefore the latency — a joining prompt
+    # adds to in-flight decodes, Sarathi/vLLM-style: small mixed steps
+    # keep decode throughput high under churn, at the cost of slower
+    # prompt ingestion. The cap is per ROW, not across rows: the padded
+    # (R, C) step pays R×C compute regardless of how many rows carry
+    # prefill tokens, so limiting the number of prefilling rows per step
+    # would save nothing. 0 (default) = a full prefill_chunk per row.
+    max_tokens_per_step: int = 0
     # Serving-triage dump directory (reference inference_debugging,
     # serve/__init__.py:48 — per-op inputs/outputs saved to file): every
     # engine step additionally runs an eager per-layer forward and
@@ -66,6 +87,15 @@ class ServingConfig:
         # Committed tokens + in-flight speculative tree slack
         # (reference BatchConfig::MAX_SPEC_TREE_TOKEN_NUM headroom).
         return self.max_sequence_length + self.max_spec_tree_tokens
+
+    @property
+    def mixed_chunk(self) -> int:
+        """Static per-row chunk width of the mixed continuous-batching
+        step (its compiled token-matrix is (slots, mixed_chunk)) — the
+        per-slot per-step prefill token budget."""
+        if self.max_tokens_per_step <= 0:
+            return self.prefill_chunk
+        return max(1, min(self.prefill_chunk, self.max_tokens_per_step))
 
     @property
     def pages_per_slot(self) -> int:
@@ -117,7 +147,7 @@ class InferenceEngine:
         self.mesh = mesh or MachineSpec().make_mesh(jax.devices()[:1])
         self.params = params
         # Key: (chunk, all_logits, with_mask) for plain steps, or a
-        # string tag for fused variants ("decode_fused").
+        # tagged tuple for fused variants (("mixed_fused", chunk, ...)).
         self._steps: Dict[Any, Callable] = {}
         self._commit: Optional[Callable] = None
         self.paged = self.serving.kv_layout == "paged"
@@ -283,63 +313,98 @@ class InferenceEngine:
             self._steps[key] = jax.jit(step, donate_argnums=(1,))
         return self._steps[key]
 
-    def _get_decode_step(self):
-        """Fused decode step: token select (device feedback vs host) →
-        serve_step(C=1) → per-slot sampling, one program, cache donated.
-        The sampled tokens stay on device so the next step can consume
-        them without a host round-trip (kills the per-token blocking
-        device_get the reference avoids with its future pipeline)."""
-        key_id = "decode_fused"
+    def _get_mixed_step(self, chunk: int, with_logits: bool = False):
+        """Fused MIXED step — the continuous-batching workhorse: token
+        select (device feedback vs host) for column 0 → serve_step over
+        (R, chunk) ragged rows (decode rows use one column, prefill rows
+        up to ``chunk``; padding sits at the scratch position) →
+        per-slot sampling at each row's ``logits_idx``. One program,
+        cache donated, sampled tokens stay on device so decode rows AND
+        prefill-final rows feed the next step without a host round-trip.
+        With ``chunk == 1`` this is exactly the fused decode step (the
+        reference's 4-deep batch-future pipeline); larger chunks carry
+        chunked prefill in the same dispatch, which is what lets the
+        scheduler admit and prefill without ever draining the pipeline.
+        ``with_logits`` additionally returns the pre-sampling logits
+        (parity tests/debug only — the serving path skips the extra
+        output)."""
+        key_id = ("mixed_fused", chunk, with_logits)
         if key_id not in self._steps:
             from .sampling import sample_tokens
 
             fn = self._serve_step_fn(all_logits=False)
-            R = self.num_slots
             paged = self.paged
 
             def step(params, cache, last_tokens, host_tokens, use_last,
-                     positions, key, greedy, temperature, topp,
-                     page_table=None):
-                tokens = jnp.where(
-                    use_last[:, None], last_tokens[:, None], host_tokens
+                     positions, logits_idx, key, greedy, temperature,
+                     topp, topk, page_table=None):
+                first = jnp.where(use_last, last_tokens, host_tokens[:, 0])
+                tokens = jnp.concatenate(
+                    [first[:, None], host_tokens[:, 1:]], axis=1
                 )
-                args = (params, cache, tokens, positions,
-                        jnp.zeros((R,), jnp.int32), None, None)
+                args = (params, cache, tokens, positions, logits_idx,
+                        None, None)
                 if paged:
                     args = args + (page_table,)
                 logits, cache = fn(*args)
                 toks = sample_tokens(
                     logits, key,
                     greedy=greedy, temperature=temperature, topp=topp,
+                    topk_arr=topk,
                 )
+                if with_logits:
+                    return toks, logits, cache
                 return toks, cache
 
             self._steps[key_id] = jax.jit(step, donate_argnums=(1,))
         return self._steps[key_id]
 
-    def run_decode(self, last_tokens, host_tokens, use_last, positions,
-                   key, greedy, temperature, topp):
-        """Dispatch one fused decode step; returns the sampled tokens as
-        a DEVICE array (R,) — the caller fetches it a step later."""
+    def run_mixed(self, last_tokens, host_tokens, use_last, positions,
+                  logits_idx, key, greedy, temperature, topp, topk,
+                  with_logits: bool = False):
+        """Dispatch one fused mixed step over (R, C) host data; returns
+        the sampled tokens as a DEVICE array (R,) — the caller fetches
+        them up to ``dispatch_ahead`` steps later. ``with_logits``
+        additionally returns the (R, V) logits (device array)."""
         kw = {}
         if self.paged:
             kw["page_table"] = self.page_table_device()
+        host_tokens = np.asarray(host_tokens)
         with _set_mesh(self.mesh):
-            step = self._get_decode_step()
-            toks, self.cache = step(
+            step = self._get_mixed_step(host_tokens.shape[1], with_logits)
+            out = step(
                 self.params,
                 self.cache,
                 last_tokens,
                 jnp.asarray(host_tokens),
                 jnp.asarray(use_last),
                 jnp.asarray(positions),
+                jnp.asarray(logits_idx),
                 key,
                 jnp.asarray(greedy),
                 jnp.asarray(temperature),
                 jnp.asarray(topp),
+                jnp.asarray(topk),
                 **kw,
             )
+        if with_logits:
+            toks, logits, self.cache = out
+            return toks, logits
+        toks, self.cache = out
         return toks
+
+    def run_decode(self, last_tokens, host_tokens, use_last, positions,
+                   key, greedy, temperature, topp, topk=None):
+        """Dispatch one fused decode step (the C == 1 mixed step);
+        returns the sampled tokens as a DEVICE array (R,) — the caller
+        fetches it a step later."""
+        R = self.num_slots
+        if topk is None:
+            topk = np.zeros((R,), np.int32)
+        return self.run_mixed(
+            last_tokens, host_tokens, use_last, positions,
+            np.zeros((R,), np.int32), key, greedy, temperature, topp, topk,
+        )
 
     def _get_speculate(self, W: int, D: int):
         """Whole-tree SSM speculation as ONE compiled program: a scan

@@ -273,6 +273,49 @@ def verify_attention(
 
 
 # ---------------------------------------------------------------------------
+# Shared serving-mask construction. Every serving step — sync chunked
+# prefill, fused decode, and the mixed continuous-batching step — uses
+# the same causal-by-position contract: a query token attends every
+# cache line whose position is <= its own, never the scratch line, so
+# one static-shape program serves ragged rows (padding columns sit at
+# the scratch position and are masked out of nothing real). These were
+# previously duplicated across the model-family modules.
+
+
+def causal_serve_mask(positions: jnp.ndarray, S1: int) -> jnp.ndarray:
+    """Causal-by-position mask over a dense cache: positions (R, C) →
+    (R, C, S1) bool. Line S1-1 is the per-slot scratch row and is never
+    attended; only positions already written satisfy ``<=``, so stale
+    lines from an evicted slot occupant are never read."""
+    key_pos = jnp.arange(S1, dtype=jnp.int32)
+    mask = key_pos[None, None, :] <= positions[:, :, None]
+    return mask & (key_pos[None, None, :] < S1 - 1)
+
+
+def paged_serve_mask(
+    mask: Optional[jnp.ndarray],
+    positions: jnp.ndarray,
+    num_logical_pages: int,
+    page_size: int,
+    cache_len: int,
+) -> jnp.ndarray:
+    """Paged twin of :func:`causal_serve_mask` over the page-aligned
+    virtual cache (S_virt = NP * page_size): builds the causal mask when
+    ``mask`` is None, otherwise pads an explicit (R, C, cache_len+1)
+    mask out to S_virt (padding is never-attended). The scratch LINE
+    (index ``cache_len``, where padding tokens write) is excluded."""
+    S_virt = num_logical_pages * page_size
+    if mask is None:
+        key_pos = jnp.arange(S_virt, dtype=jnp.int32)
+        mask = key_pos[None, None, :] <= positions[:, :, None]
+        return mask & (key_pos[None, None, :] < cache_len)
+    if mask.shape[-1] < S_virt:
+        pad = S_virt - mask.shape[-1]
+        mask = jnp.pad(mask, ((0, 0), (0, 0), (0, pad)))
+    return mask
+
+
+# ---------------------------------------------------------------------------
 # Ragged paged attention (paged KV pool + per-request page table)
 
 

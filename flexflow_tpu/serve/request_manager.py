@@ -5,27 +5,42 @@ TPU-native counterpart of the reference ``RequestManager`` (reference
 requests, admit them into free batch slots, build per-step BatchConfigs
 (``prepare_next_batch``, :350), run the incremental-decoding loop
 (``generate_incr_decoding``, :2292), track per-request profiling, and
-free slots on completion. Prompt processing is *chunked prefill*: a
-prompt enters the batch in fixed-size chunks so prefill and decode share
-one program shape per mode and new arrivals join without a full-batch
-retrace (the reference's equivalent is padding to MAX_NUM_TOKENS).
+free slots on completion.
+
+Scheduling is **iteration-level continuous batching**: prompt processing
+is *chunked prefill* (a prompt enters the batch in fixed-size chunks so
+prefill and decode share one program shape), and — with
+``ServingConfig.continuous_batching`` (the default) — prefill chunks
+ride in the SAME dispatch-ahead pipelined step as decode rows. One
+jitted *mixed step* carries every decode row's single token plus up to
+``max_tokens_per_step`` new prompt tokens, samples on device for decode
+rows AND prefill-final rows, and feeds the sampled tokens to the next
+dispatch without a host round-trip. Admissions, chunk progression and
+completions therefore never drain the pipeline; host-side token append
+is deferred to drain (flush) time, ``dispatch_ahead`` steps behind the
+device. ``continuous_batching=False`` restores the flush-on-admit
+scheduler (any PREFILLING request forces the blocking sync path) — the
+bench baseline.
 """
 from __future__ import annotations
 
 import dataclasses
 import enum
 import time
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..logging_utils import get_logger
+from ..metrics import SchedulerStats
 from .batch_config import (
     BatchConfig,
     GenerationConfig,
     GenerationResult,
     ProfileInfo,
+    StreamEvent,
 )
 from .engine import InferenceEngine
 from .sampling import sample_tokens
@@ -36,6 +51,14 @@ class RequestStatus(enum.Enum):
     PREFILLING = "prefilling"
     DECODING = "decoding"
     COMPLETED = "completed"
+    # Terminal failure: the request can never be served under the
+    # configured limits (e.g. its prompt alone exceeds the KV budget).
+    # Surfaced via GenerationResult.error instead of live-locking the
+    # scheduler or crashing unrelated requests.
+    ERROR = "error"
+
+
+TERMINAL_STATUSES = (RequestStatus.COMPLETED, RequestStatus.ERROR)
 
 
 @dataclasses.dataclass
@@ -49,10 +72,18 @@ class Request:
     gen: GenerationConfig
     status: RequestStatus = RequestStatus.PENDING
     slot: int = -1
-    n_cached: int = 0                 # tokens whose K/V are in the cache
-    inflight: int = 0                 # dispatched decode steps not yet fetched
+    n_cached: int = 0                 # tokens whose K/V commit was flushed
+    n_sched: int = 0                  # prompt tokens dispatched (may run
+    # ahead of n_cached while prefill chunks are in flight)
+    inflight: int = 0                 # dispatched sampling steps not yet
+    # fetched (decode rows + the prefill-final chunk)
+    pipeline_refs: int = 0            # in-flight dispatches touching this
+    # request's slot — the slot (and its pages) may only be released once
+    # this drains to 0, or later garbage writes from already-dispatched
+    # steps would scribble on a reassigned slot/page
     admit_seq: int = -1               # admission order (preemption victims
     # are chosen newest-first, vLLM-style recompute preemption)
+    error: Optional[str] = None
     profile: ProfileInfo = dataclasses.field(default_factory=ProfileInfo)
 
     @property
@@ -100,11 +131,18 @@ class RequestManager:
         self._admit_counter = 0
         self._key = jax.random.PRNGKey(seed)
         self._step_counter = 0
-        # Dispatch-ahead decode pipeline (reference's 4-deep batch-future
+        # Dispatch-ahead pipeline (reference's 4-deep batch-future
         # queue, request_manager.cc:2310-2325): entries are
-        # (device_tokens, [(rid, slot), ...]) oldest-first.
+        # (device_tokens, [(rid, slot, ntoks, samples), ...])
+        # oldest-first; ``ntoks`` is the row's cache lines this dispatch
+        # wrote, ``samples`` whether its sampled token is meaningful
+        # (decode rows and prefill-final rows).
         self._inflight: List[tuple] = []
+        # Slots whose sampled token in the NEWEST dispatch is their next
+        # input (device feedback instead of a host token).
         self._prev_dispatch_slots: set = set()
+        self.stats = SchedulerStats()
+        self._log = get_logger("serve")
 
     # ------------------------------------------------------------------
     # registration (reference register_new_request, request_manager.cc:137)
@@ -142,6 +180,22 @@ class RequestManager:
         self.pending.append(rid)
         return rid
 
+    def submit(
+        self,
+        prompt: Union[str, Sequence[int]],
+        gen: Optional[GenerationConfig] = None,
+        max_new_tokens: Optional[int] = None,
+    ) -> int:
+        """Non-blocking submission: queue one request and return its id
+        immediately. Drive the scheduler with :meth:`step` (or a
+        concurrent :meth:`generate_stream`/:meth:`generate` call) and
+        read tokens from ``requests[rid]`` / :meth:`result` as they
+        drain."""
+        gen = gen or GenerationConfig()
+        if max_new_tokens is not None:
+            gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
+        return self.register_request(prompt, gen)
+
     # ------------------------------------------------------------------
     # paged-KV page management (serve/paging.py PageAllocator; one
     # allocator per engine — a SpecInfer LLM/SSM pair allocates
@@ -176,21 +230,27 @@ class RequestManager:
         queue, reclaiming its pages everywhere. Its prefix is recomputed
         on re-admission (prompt + tokens generated so far re-prefill —
         vLLM-style recompute preemption), so generation continues
-        exactly where it stopped."""
+        exactly where it stopped. Only called with the pipeline drained
+        (pipeline_refs == 0), so no in-flight dispatch can scribble on
+        the reclaimed pages."""
+        assert req.pipeline_refs == 0, "preempting a request with work in flight"
         self._release_pages(req.slot)
         self.slots[req.slot] = None
         req.slot = -1
         req.status = RequestStatus.PENDING
         req.n_cached = 0
+        req.n_sched = 0
         req.inflight = 0
         self.pending.insert(0, req.request_id)
+        self.stats.preemptions += 1
 
-    def _lines_needed(self, req: Request) -> int:
+    def _lines_needed(self, req: Request, chunk: Optional[int] = None) -> int:
         """Conservative cache-line bound the next step may touch."""
         if req.status is RequestStatus.PREFILLING:
+            chunk = chunk or self.engine.serving.prefill_chunk
             return min(
                 len(req.tokens),
-                req.n_cached + self.engine.serving.prefill_chunk,
+                max(req.n_cached, req.n_sched) + chunk,
             )
         # decode: reads lines [0, len-1], writes len-1 (+ dispatch-ahead
         # steps in flight advance the write line without a host sync)
@@ -199,8 +259,10 @@ class RequestManager:
     def _reserve_active_pages(self, lines_fn=None):
         """Grow every active slot's page table to cover this step's
         reads/writes; on pool exhaustion, preempt the newest admission
-        (reference eviction order) and retry. Raises only when a single
-        request alone exceeds the pool — a configuration error."""
+        (reference eviction order) and retry. A single request that
+        alone exceeds the pool can never be served — it fails with an
+        ERROR status (surfaced in its GenerationResult) instead of
+        crashing the scheduler and every healthy request with it."""
         if not self._paged:
             return
         lines_fn = lines_fn or self._lines_needed
@@ -232,11 +294,13 @@ class RequestManager:
                     in (RequestStatus.PREFILLING, RequestStatus.DECODING)
                 ]
                 if not victims:
-                    raise RuntimeError(
-                        "KV page pool exhausted by a single request — "
+                    self._fail_request(
+                        req,
+                        "KV page pool exhausted by this request alone — "
                         "raise ServingConfig.max_cached_tokens (or lower "
-                        "max_sequence_length/page_size)"
+                        "max_sequence_length/page_size)",
                     )
+                    break  # active set changed; re-derive
                 self._preempt(victims[-1])
                 break  # active set changed; re-derive
             else:
@@ -266,10 +330,49 @@ class RequestManager:
     # ------------------------------------------------------------------
     # slot management
 
+    def _admission_error(self, req: Request) -> Optional[str]:
+        """A reason this request can NEVER be admitted under the
+        configured limits, or None. Without this check such a request
+        either live-locks ``generate()`` (``step()`` keeps returning
+        True with the request parked in ``pending``) or eventually
+        preempts every healthy request before dying."""
+        sc = self.engine.serving
+        need = len(req.tokens) + 1  # prompt lines + the first output's line
+        if need > sc.cache_len + 1:
+            return (
+                f"prompt ({len(req.tokens)} tokens) exceeds the cache "
+                f"capacity ({sc.cache_len} lines)"
+            )
+        if self._paged:
+            if sc.max_cached_tokens is not None and need > sc.max_cached_tokens:
+                return (
+                    f"prompt ({len(req.tokens)} tokens) can never fit the "
+                    f"configured KV budget (max_cached_tokens="
+                    f"{sc.max_cached_tokens})"
+                )
+            for eng in self._engines():
+                cap = eng.pager.num_pages * eng.pager.page_size
+                if need > cap:
+                    return (
+                        f"prompt ({len(req.tokens)} tokens) exceeds the "
+                        f"KV page pool ({cap} tokens)"
+                    )
+        return None
+
     def _admit_pending(self):
         for i, occupant in enumerate(self.slots):
-            if occupant is not None or not self.pending:
+            if occupant is not None:
                 continue
+            # fail-fast unservable heads instead of parking them forever
+            while self.pending:
+                head = self.requests[self.pending[0]]
+                err = self._admission_error(head)
+                if err is None:
+                    break
+                self.pending.pop(0)
+                self._fail_request(head, err)
+            if not self.pending:
+                return
             rid = self.pending[0]
             req = self.requests[rid]
             req.slot = i
@@ -281,13 +384,17 @@ class RequestManager:
                 # roll back any partial cross-engine grant
                 self._release_pages(i)
                 req.slot = -1
-                break
+                return
             self.pending.pop(0)
             req.status = RequestStatus.PREFILLING
             req.n_cached = 0
+            req.n_sched = 0
+            req.inflight = 0
+            req.pipeline_refs = 0
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
             self.slots[i] = rid
+            self.stats.admitted += 1
 
     def _active(self, status: RequestStatus) -> List[Request]:
         out = []
@@ -299,16 +406,37 @@ class RequestManager:
                 out.append(r)
         return out
 
-    def _finish(self, req: Request):
-        req.status = RequestStatus.COMPLETED
+    def _release_slot(self, req: Request):
+        """Return the request's slot (and pages) to the free pool.
+        Callers must guarantee no in-flight dispatch still references
+        the slot (pipeline_refs == 0)."""
+        if req.slot < 0:
+            return
+        if self._paged:
+            self._release_pages(req.slot)
+        self.slots[req.slot] = None
+        req.slot = -1
+
+    def _finish(self, req: Request, error: Optional[str] = None):
+        req.status = RequestStatus.ERROR if error else RequestStatus.COMPLETED
+        req.error = error
         req.profile.finish_time = time.perf_counter()
-        if req.slot >= 0:
-            if self._paged:
-                self._release_pages(req.slot)
-            self.slots[req.slot] = None
-            req.slot = -1
-        if self.output_file:
+        # With dispatches still in flight for this slot, defer the
+        # release to the flush that drains the last of them: those
+        # dispatches keep writing (garbage) K/V through the page table
+        # they were launched with, so reallocating the pages or the slot
+        # now would corrupt whoever received them.
+        if req.slot >= 0 and req.pipeline_refs == 0:
+            self._release_slot(req)
+        if self.output_file and error is None:
             self._write_output_record(req)
+
+    def _fail_request(self, req: Request, reason: str):
+        self.stats.failed += 1
+        self._log.warning("request %d failed: %s", req.request_id, reason)
+        if req.request_id in self.pending:
+            self.pending.remove(req.request_id)
+        self._finish(req, error=reason)
 
     def _write_output_record(self, req: Request):
         """Append one finished request's telemetry — the format mirrors
@@ -345,12 +473,15 @@ class RequestManager:
         bc.positions[req.slot, :n] = np.arange(off, off + n)
         bc.active[req.slot] = True
         bc.logits_idx[req.slot] = n - 1
+        if bc.qlens is not None:
+            bc.qlens[req.slot] = n
 
     def _prepare_batch(self) -> Optional[BatchConfig]:
-        """Build one mixed prefill+decode batch. Decoding slots always
-        contribute their one pending token, so decode never stalls behind
-        a long prompt's prefill (no head-of-line blocking); the chunk is
-        1 when nobody is prefilling."""
+        """Build one blocking mixed prefill+decode batch (the sync
+        path). Decoding slots always contribute their one pending token,
+        so decode never stalls behind a long prompt's prefill (no
+        head-of-line blocking); the chunk is 1 when nobody is
+        prefilling."""
         prefilling = self._active(RequestStatus.PREFILLING)
         decoding = self._active(RequestStatus.DECODING)
         if not prefilling and not decoding:
@@ -358,6 +489,7 @@ class RequestManager:
         sc = self.engine.serving
         chunk = sc.prefill_chunk if prefilling else 1
         bc = BatchConfig.empty(self.engine.num_slots, chunk, self.engine.scratch_pos)
+        bc.qlens = np.zeros((self.engine.num_slots,), np.int32)
         for req in prefilling:
             self._fill_prefill_row(bc, req, chunk)
         for req in decoding:
@@ -365,6 +497,7 @@ class RequestManager:
             bc.positions[req.slot, 0] = len(req.tokens) - 1
             bc.active[req.slot] = True
             bc.logits_idx[req.slot] = 0
+            bc.qlens[req.slot] = 1
         self._attach_paging_metadata(bc)
         return bc
 
@@ -373,21 +506,23 @@ class RequestManager:
 
     def _decode_head_params(self, reqs: Sequence[Request]):
         """Per-slot decode-head arrays for ``reqs`` (greedy/temperature/
-        top-p; top-p >= 1 disables the nucleus filter)."""
+        top-k/top-p; top-p >= 1 and top-k <= 0 disable the filters)."""
         R = self.engine.num_slots
         greedy = np.ones((R,), bool)
         temp = np.ones((R,), np.float32)
         topp = np.full((R,), 2.0, np.float32)  # disabled
+        topk = np.zeros((R,), np.int32)        # disabled
         for req in reqs:
             greedy[req.slot] = not req.gen.do_sample
             temp[req.slot] = req.gen.temperature
             topp[req.slot] = req.gen.topp if req.gen.do_sample else 2.0
-        return greedy, temp, topp
+            topk[req.slot] = req.gen.topk if req.gen.do_sample else 0
+        return greedy, temp, topp, topk
 
     def _sample(self, logits) -> np.ndarray:
         """Sample one token per slot from (R, V) logits using each slot's
         GenerationConfig (mixed greedy/sampling in one program)."""
-        greedy, temp, topp = self._decode_head_params(
+        greedy, temp, topp, topk = self._decode_head_params(
             [self.requests[r] for r in self.slots if r is not None]
         )
         self._key, sub = jax.random.split(self._key)
@@ -397,10 +532,15 @@ class RequestManager:
             greedy=jnp.asarray(greedy),
             temperature=jnp.asarray(temp),
             topp=jnp.asarray(topp),
+            topk_arr=jnp.asarray(topk),
         )
         return np.asarray(jax.device_get(toks))
 
     def _append_token(self, req: Request, token: int):
+        if len(req.tokens) == req.prompt_len and not req.profile.first_token_time:
+            # the request's first generated token, as the host observes
+            # it (TTFT the way a streaming client would measure it)
+            req.profile.first_token_time = time.perf_counter()
         req.tokens.append(int(token))
         gen_len = len(req.tokens) - req.prompt_len
         eos = self.eos_token_id
@@ -424,11 +564,22 @@ class RequestManager:
         return self.engine.run(bc)
 
     # ------------------------------------------------------------------
-    # dispatch-ahead decode pipeline (reference request_manager.cc:2310)
+    # dispatch-ahead pipeline (reference request_manager.cc:2310)
+
+    def _sched_exhausted(self, req: Request) -> bool:
+        """Everything this request will ever need is already dispatched
+        — scheduling more rows would only compute garbage (its
+        completion lands at a pending flush)."""
+        gen_dispatched = len(req.tokens) - req.prompt_len + req.inflight
+        return (
+            gen_dispatched >= req.gen.max_new_tokens
+            or len(req.tokens) + req.inflight
+            >= self.engine.serving.max_sequence_length
+        )
 
     def _dispatch_decode(self, decoding: List[Request]):
         """Dispatch one fused decode step WITHOUT waiting for the
-        previous one: decode rows that were in the previous dispatch
+        previous one: decode rows that sampled in the previous dispatch
         take their input token from the on-device sampled tokens; rows
         entering the pipeline take it from host state. Positions advance
         deterministically, so no host sync is needed."""
@@ -437,7 +588,7 @@ class RequestManager:
         host_tokens = np.zeros((R, 1), np.int32)
         use_last = np.zeros((R,), bool)
         positions = np.full((R, 1), scratch, np.int32)
-        greedy, temp, topp = self._decode_head_params(decoding)
+        greedy, temp, topp, topk = self._decode_head_params(decoding)
         snapshot = []
         last = self._inflight[-1][0] if self._inflight else None
         for req in decoding:
@@ -448,60 +599,251 @@ class RequestManager:
             else:
                 host_tokens[s, 0] = req.tokens[-1]
             req.inflight += 1
-            snapshot.append((req.request_id, s))
+            req.pipeline_refs += 1
+            snapshot.append((req.request_id, s, 1, True))
         if last is None:
             last = jnp.zeros((R,), jnp.int32)
         self._key, sub = jax.random.split(self._key)
         toks = self.engine.run_decode(
-            last, host_tokens, use_last, positions, sub, greedy, temp, topp
+            last, host_tokens, use_last, positions, sub, greedy, temp, topp,
+            topk,
         )
         self._inflight.append((toks, snapshot))
-        self._prev_dispatch_slots = {s for _, s in snapshot}
+        self._prev_dispatch_slots = {s for _, s, _, _ in snapshot}
         self._step_counter += 1
+        self.stats.record_step(
+            "decode", active_slots=len(decoding), num_slots=R,
+            decode_tokens=len(decoding),
+        )
+        self._maybe_log_stats()
+
+    def _dispatch_mixed(self, prefilling: List[Request],
+                        decoding: List[Request]):
+        """Dispatch one pipelined MIXED step: every decode row's single
+        token plus chunked prefill under the per-step token budget, in
+        ONE (R, mixed_chunk) ragged dispatch through the shared step
+        (paged layouts go through ``ragged_paged_attention`` via the
+        per-row query lengths — padding columns sit at the scratch
+        position). Prefill rows whose final chunk is in this dispatch
+        transition to DECODING immediately: their sampled token is on
+        device, so the next iteration schedules them as decode rows fed
+        by device feedback — an admission never costs a pipeline
+        drain."""
+        eng = self.engine
+        sc = eng.serving
+        R = eng.num_slots
+        C = sc.mixed_chunk
+        bc = BatchConfig.empty(R, C, eng.scratch_pos)
+        bc.qlens = np.zeros((R,), np.int32)
+        use_last = np.zeros((R,), bool)
+        snapshot = []
+        sampled_slots = set()
+        last = self._inflight[-1][0] if self._inflight else None
+        greedy, temp, topp, topk = self._decode_head_params(
+            list(decoding) + list(prefilling)
+        )
+        for req in decoding:
+            s = req.slot
+            bc.positions[s, 0] = len(req.tokens) - 1 + req.inflight
+            if s in self._prev_dispatch_slots and last is not None:
+                use_last[s] = True
+            else:
+                bc.tokens[s, 0] = req.tokens[-1]
+            bc.logits_idx[s] = 0
+            bc.active[s] = True
+            bc.qlens[s] = 1
+            req.inflight += 1
+            req.pipeline_refs += 1
+            snapshot.append((req.request_id, s, 1, True))
+            sampled_slots.add(s)
+        spent = 0
+        for req in sorted(prefilling, key=lambda r: r.admit_seq):
+            n = min(C, len(req.tokens) - req.n_sched)
+            if n <= 0:
+                continue
+            s = req.slot
+            off = req.n_sched
+            bc.tokens[s, :n] = req.tokens[off : off + n]
+            bc.positions[s, :n] = np.arange(off, off + n)
+            bc.logits_idx[s] = n - 1
+            bc.active[s] = True
+            bc.qlens[s] = n
+            final = off + n >= len(req.tokens)
+            req.n_sched += n
+            req.pipeline_refs += 1
+            spent += n
+            if final:
+                # prompt fully dispatched: this step samples the first
+                # output token on device — decode from the next step on
+                req.status = RequestStatus.DECODING
+                req.inflight += 1
+                sampled_slots.add(s)
+            snapshot.append((req.request_id, s, n, final))
+        if last is None:
+            last = jnp.zeros((R,), jnp.int32)
+        self._key, sub = jax.random.split(self._key)
+        toks = eng.run_mixed(
+            last, bc.tokens, use_last, bc.positions, bc.logits_idx,
+            sub, greedy, temp, topp, topk,
+        )
+        self._inflight.append((toks, snapshot))
+        self._prev_dispatch_slots = sampled_slots
+        self._step_counter += 1
+        self.stats.record_step(
+            "mixed", active_slots=int(bc.active.sum()), num_slots=R,
+            prefill_tokens=spent, decode_tokens=len(decoding),
+            budget=C * max(1, len(prefilling)),
+        )
+        self._maybe_log_stats()
 
     def _flush_one(self):
-        """Fetch the oldest in-flight step's tokens and do the host
-        bookkeeping (append, EOS/max-length checks, slot release)."""
+        """Fetch the oldest in-flight step's tokens and do the deferred
+        host bookkeeping: advance each row's committed-line count, and
+        for sampling rows append the token (EOS/length checks). A
+        request finished by an earlier flush skips the bookkeeping but
+        still drains its pipeline refs — its slot/pages are released at
+        the flush that drains the last reference."""
         toks, snapshot = self._inflight.pop(0)
         toks = np.asarray(jax.device_get(toks))
-        for rid, slot in snapshot:
+        self.stats.flushes += 1
+        for rid, slot, ntoks, samples in snapshot:
             req = self.requests.get(rid)
             if req is None:
                 continue
-            req.inflight = max(0, req.inflight - 1)
-            if req.status is not RequestStatus.DECODING:
-                continue  # finished by an earlier flush; row is garbage
-            req.n_cached += 1
-            req.profile.llm_decoding_steps += 1
-            self._append_token(req, toks[slot])
+            req.pipeline_refs = max(0, req.pipeline_refs - 1)
+            if samples:
+                req.inflight = max(0, req.inflight - 1)
+            alive = (
+                req.status
+                in (RequestStatus.PREFILLING, RequestStatus.DECODING)
+                and req.slot == slot
+            )
+            if alive:
+                req.n_cached += ntoks
+                if samples:
+                    req.profile.llm_decoding_steps += 1
+                    self._append_token(req, toks[slot])
+            if (
+                req.status in TERMINAL_STATUSES
+                and req.slot == slot
+                and req.pipeline_refs == 0
+            ):
+                self._release_slot(req)
 
     def _flush_all(self):
+        if self._inflight:
+            self.stats.pipeline_drains += 1
         while self._inflight:
             self._flush_one()
         self._prev_dispatch_slots = set()
 
+    def drain(self):
+        """Flush every in-flight dispatch: appends all outstanding
+        tokens and releases slots/pages held by finished requests whose
+        tail dispatches were still in the pipeline."""
+        self._flush_all()
+
+    def _trim_pipeline(self):
+        depth = max(1, self.engine.serving.dispatch_ahead)
+        while len(self._inflight) >= depth:
+            self._flush_one()
+
+    def _slots_reclaimable(self) -> bool:
+        """Some slot is held by a request that only needs flushes to
+        leave: already terminal (zombie refs in flight) or with its
+        whole generation budget dispatched."""
+        for rid in self.slots:
+            if rid is None:
+                continue
+            req = self.requests[rid]
+            if req.status in TERMINAL_STATUSES:
+                return True
+            if (
+                req.status is RequestStatus.DECODING
+                and self._sched_exhausted(req)
+            ):
+                return True
+        return False
+
+    def _reclaim_slots_for_admission(self):
+        """Under saturation (pending queue non-empty, no free slot),
+        flush ahead of the dispatch_ahead cadence to reclaim slots held
+        by finished/fully-dispatched requests. Flushing drains steps the
+        device has already computed (it runs up to ``dispatch_ahead``
+        ahead), so this trades a little pipeline depth for slot
+        occupancy — the right trade whenever admissions are waiting;
+        without it a completion holds its slot for up to dispatch_ahead
+        extra iterations and effective concurrency sags."""
+        if not self.pending or any(s is None for s in self.slots):
+            return
+        while (
+            self._inflight
+            and self.pending
+            and not any(s is None for s in self.slots)
+            and self._slots_reclaimable()
+        ):
+            self._flush_one()
+        self._admit_pending()
+
+    def _maybe_log_stats(self):
+        if self._step_counter % 200 == 0:
+            self._log.debug("%s", self.stats.report())
+
     # ------------------------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduling step. Returns False when no work remains."""
+        """One scheduling step. Returns False when no work remains.
+
+        Fast managers run everything through the dispatch-ahead
+        pipeline: pure-decode iterations through the fused C==1 step,
+        and — with ``continuous_batching`` — iterations with PREFILLING
+        slots through the fused mixed step, so admissions and chunk
+        progression never drain the pipeline. The blocking sync path
+        remains for SpecInfer/triage managers, for the flush-on-admit
+        baseline scheduler, and as the idle drain."""
         self._admit_pending()
-        # paged KV: grow page tables to cover this step's writes BEFORE
-        # any dispatch (may preempt the newest admission on exhaustion)
-        self._reserve_active_pages()
-        prefilling = self._active(RequestStatus.PREFILLING)
-        decoding = self._active(RequestStatus.DECODING)
-        if self.supports_fast_decode and decoding and not prefilling:
-            # (a queued request waiting for a slot doesn't force the
-            # sync path: it only becomes schedulable once a flush frees
-            # a slot, and the resulting PREFILLING admission is itself
-            # the sync point)
+        sc = self.engine.serving
+        if self.supports_fast_decode:
+            self._reclaim_slots_for_admission()
+            prefilling = self._active(RequestStatus.PREFILLING)
+            decoding = self._active(RequestStatus.DECODING)
+            if decoding and not prefilling:
+                self._reserve_active_pages()
+                return self._step_pipelined(mixed=False)
+            if sc.continuous_batching and (prefilling or decoding):
+                self._reserve_active_pages(
+                    lambda r: self._lines_needed(r, sc.mixed_chunk)
+                )
+                return self._step_pipelined(mixed=True)
+        # Sync path (SpecInfer/triage managers; prefill under the
+        # flush-on-admit baseline; idle drain): blocking host round trip.
+        return self._step_sync()
+
+    def _step_pipelined(self, mixed: bool) -> bool:
+        # page reservation may have preempted or failed requests —
+        # re-derive the schedulable set
+        prefilling = self._active(RequestStatus.PREFILLING) if mixed else []
+        decoding = [
+            r for r in self._active(RequestStatus.DECODING)
+            if not self._sched_exhausted(r)
+        ]
+        if prefilling:
+            self._dispatch_mixed(prefilling, decoding)
+        elif decoding:
             self._dispatch_decode(decoding)
-            depth = max(1, self.engine.serving.dispatch_ahead)
-            while len(self._inflight) >= depth:
-                self._flush_one()
+        elif self._inflight:
+            # every row is fully dispatched: make flush progress so the
+            # pending completions land
+            self._flush_one()
             return True
-        # Mode change (prefill joining, admissions, drain): sync point.
+        else:
+            return bool(self.pending)
+        self._trim_pipeline()
+        return True
+
+    def _step_sync(self) -> bool:
         self._flush_all()
+        self._reserve_active_pages()
         bc = self._prepare_batch()
         if bc is None:
             return bool(self.pending)
@@ -511,18 +853,51 @@ class RequestManager:
         sampled = self._sample(logits)
         for req in decoding:
             req.n_cached += 1
+            req.n_sched = req.n_cached
             req.profile.llm_decoding_steps += 1
             self._append_token(req, sampled[req.slot])
         for req in prefilling:
             n = int(bc.logits_idx[req.slot]) + 1  # tokens cached this chunk
             req.n_cached += n
+            req.n_sched = req.n_cached
             if req.n_cached >= len(req.tokens):
                 # prompt fully cached: first output token sampled now
                 req.status = RequestStatus.DECODING
                 req.profile.llm_decoding_steps += 1
                 self._append_token(req, sampled[req.slot])
         self._step_counter += 1
+        self.stats.record_step(
+            "sync",
+            active_slots=len(prefilling) + len(decoding),
+            num_slots=self.engine.num_slots,
+            prefill_tokens=int(
+                sum(bc.qlens[r.slot] for r in prefilling)
+            ) if prefilling else 0,
+            decode_tokens=len(decoding),
+        )
+        self._maybe_log_stats()
         return True
+
+    # ------------------------------------------------------------------
+    # blocking + streaming frontends
+
+    def result(self, rid: int) -> GenerationResult:
+        """Build the GenerationResult for a (terminal or in-flight)
+        request."""
+        req = self.requests[rid]
+        out = req.output_tokens
+        text = (
+            self.tokenizer.decode(out) if self.tokenizer is not None else ""
+        )
+        return GenerationResult(
+            request_id=rid,
+            prompt=req.prompt,
+            input_tokens=req.tokens[: req.prompt_len],
+            output_tokens=list(out),
+            output_text=text,
+            profile=req.profile,
+            error=req.error,
+        )
 
     def generate(
         self,
@@ -539,25 +914,56 @@ class RequestManager:
             gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
         rids = [self.register_request(p, gen) for p in prompts]
         while any(
-            self.requests[r].status is not RequestStatus.COMPLETED for r in rids
+            self.requests[r].status not in TERMINAL_STATUSES for r in rids
         ):
             if not self.step():
                 break
-        results = []
-        for rid in rids:
-            req = self.requests[rid]
-            out = req.output_tokens
-            text = (
-                self.tokenizer.decode(out) if self.tokenizer is not None else ""
-            )
-            results.append(
-                GenerationResult(
-                    request_id=rid,
-                    prompt=req.prompt,
-                    input_tokens=req.tokens[: req.prompt_len],
-                    output_tokens=list(out),
-                    output_text=text,
-                    profile=req.profile,
-                )
-            )
-        return results
+        # the tail of the pipeline may still hold finished requests'
+        # dispatches (and their slots/pages)
+        self.drain()
+        return [self.result(rid) for rid in rids]
+
+    def generate_stream(
+        self,
+        prompts: Union[str, Sequence[Union[str, Sequence[int]]]],
+        gen: Optional[GenerationConfig] = None,
+        max_new_tokens: Optional[int] = None,
+    ) -> Iterator[StreamEvent]:
+        """Streaming generate: yields a :class:`StreamEvent` per token
+        the moment the pipeline drains it to the host (tokens arrive up
+        to ``dispatch_ahead`` steps behind the device), then one
+        terminal event per request (``done=True``; ``error`` set if the
+        request failed). Interleaves arbitrarily across requests."""
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        gen = gen or GenerationConfig()
+        if max_new_tokens is not None:
+            gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
+        rids = [self.register_request(p, gen) for p in prompts]
+        sent = {r: 0 for r in rids}
+        finished: set = set()
+
+        def drain_events():
+            for r in rids:
+                if r in finished:
+                    continue
+                req = self.requests[r]
+                out = req.output_tokens
+                while sent[r] < len(out):
+                    tok = out[sent[r]]
+                    sent[r] += 1
+                    yield StreamEvent(r, int(tok))
+                if req.status in TERMINAL_STATUSES:
+                    finished.add(r)
+                    yield StreamEvent(r, None, done=True, error=req.error)
+
+        while len(finished) < len(rids):
+            progressed = self.step()
+            yield from drain_events()
+            if not progressed and len(finished) < len(rids):
+                self.drain()
+                yield from drain_events()
+                if len(finished) < len(rids):
+                    break  # nothing schedulable remains — avoid spinning
+        self.drain()
+        yield from drain_events()

@@ -26,6 +26,21 @@ def _apply_topk(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.where(logits < kth, NEG_INF, logits)
 
 
+def _topk_filter(logits: jnp.ndarray, topk: jnp.ndarray) -> jnp.ndarray:
+    """Per-row top-k filter (``topk`` (R,) int32; <=0 disables for that
+    row) — the dynamic-k counterpart of :func:`_apply_topk` so mixed
+    batches honor each request's ``GenerationConfig.topk`` in ONE
+    program (the reference dispatches a per-model arg_topk op,
+    ``src/ops/arg_topk.cc``). Uses a sorted threshold instead of
+    ``lax.top_k`` because k is a traced per-row value."""
+    V = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    kk = jnp.clip(topk, 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (kk - 1)[..., None], axis=-1)
+    keep_all = (topk <= 0)[..., None]
+    return jnp.where(keep_all | (logits >= kth), logits, NEG_INF)
+
+
 def _topp_filter(logits: jnp.ndarray, topp: jnp.ndarray) -> jnp.ndarray:
     """Top-p (nucleus) filter — sorted cumulative-probability cut exactly
     like the reference's sorted-cumsum kernel (sampling.cc). ``topp`` is
@@ -51,6 +66,7 @@ def sample_tokens(
     temperature: jnp.ndarray, # (R,) float
     topp: jnp.ndarray,        # (R,) float; >=1 disables
     topk: int = 0,            # static; 0 disables
+    topk_arr: Optional[jnp.ndarray] = None,  # (R,) int32; <=0 disables per row
 ) -> jnp.ndarray:
     """Sample one token per request slot. Returns (R,) int32."""
     logits = logits.astype(jnp.float32)
@@ -58,6 +74,8 @@ def sample_tokens(
     t = jnp.maximum(temperature, 1e-6)[..., None]
     scaled = logits / t
     scaled = _apply_topk(scaled, topk)
+    if topk_arr is not None:
+        scaled = _topk_filter(scaled, topk_arr)
     scaled = _topp_filter(scaled, topp)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(greedy, greedy_tok, sampled)

@@ -159,6 +159,36 @@ class TestSampling:
             )
             assert np.all(np.asarray(toks) == 5)
 
+    def test_per_row_topk_restricts_support(self):
+        """GenerationConfig.topk is honored per row in one program:
+        k=1 forces the argmax even at high temperature; k<=0 leaves the
+        row unrestricted."""
+        from flexflow_tpu.serve.sampling import sample_tokens
+
+        logits = np.tile(np.arange(32, dtype=np.float32), (2, 1))
+        for i in range(20):
+            toks = sample_tokens(
+                jnp.asarray(logits * 0.01),  # nearly flat
+                jax.random.PRNGKey(i),
+                greedy=jnp.zeros((2,), bool),
+                temperature=jnp.ones((2,)) * 5.0,
+                topp=jnp.full((2,), 2.0),
+                topk_arr=jnp.asarray([1, 0], np.int32),
+            )
+            assert int(toks[0]) == 31  # k=1 → always the max
+        # the k=0 row must explore beyond the argmax at this temperature
+        seen = {
+            int(sample_tokens(
+                jnp.asarray(logits * 0.01), jax.random.PRNGKey(i),
+                greedy=jnp.zeros((2,), bool),
+                temperature=jnp.ones((2,)) * 5.0,
+                topp=jnp.full((2,), 2.0),
+                topk_arr=jnp.asarray([1, 0], np.int32),
+            )[1])
+            for i in range(20)
+        }
+        assert len(seen) > 1
+
     def test_eos_stops_generation(self, tiny):
         cfg, params = tiny
         eng = make_engine(tiny)
