@@ -97,6 +97,8 @@ def cmd_serve(args):
         kv_layout=args.kv_layout,
         page_size=args.page_size,
         max_cached_tokens=args.max_cached_tokens,
+        prefix_caching=args.prefix_caching,
+        cache_policy=args.cache_policy,
     )
     ssms = []
     spec = None
@@ -195,6 +197,15 @@ def main(argv=None):
                    help="paged KV pool budget in tokens (default: worst "
                         "case slots*max_len; smaller oversubscribes with "
                         "recompute preemption)")
+    s.add_argument("--prefix-caching", action="store_true",
+                   help="automatic prefix caching (paged layout only): "
+                        "reuse cached KV pages for shared prompt "
+                        "prefixes, prefilling only the uncached suffix")
+    s.add_argument("--cache-policy", choices=["complete", "prefill"],
+                   default="complete",
+                   help="when prompt blocks enter the prefix cache: at "
+                        "request completion incl. generated tokens "
+                        "(complete) or as soon as prefill ends (prefill)")
     # reference -output-file (request_manager.cc:417-440): append each
     # finished request's latency/steps/token-ids
     s.add_argument("--output-file", "-output-file", default=None)

@@ -84,6 +84,16 @@ class SchedulerStats:
     decode_tokens: int = 0        # decode tokens dispatched
     occupancy_sum: float = 0.0    # active slots / total, summed per step
     budget_fill_sum: float = 0.0  # prefill tokens / budget, per mixed step
+    # Automatic prefix caching (serve/prefix_cache.py): admissions that
+    # reused cached KV pages vs cold admissions, tokens whose prefill
+    # the cache skipped, pages published to / evicted from the radix
+    # tree, and copy-on-write page copies for partially-matched tails.
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    prefix_hit_tokens: int = 0
+    prefix_inserts: int = 0
+    prefix_evictions: int = 0
+    prefix_cows: int = 0
 
     def record_step(
         self,
@@ -119,6 +129,12 @@ class SchedulerStats:
             self.budget_fill_sum / self.mixed_steps if self.mixed_steps else 0.0
         )
 
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admissions that reused at least one cached page."""
+        n = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / n if n else 0.0
+
     def snapshot(self) -> Dict[str, float]:
         return {
             "steps": self.steps,
@@ -134,6 +150,13 @@ class SchedulerStats:
             "decode_tokens": self.decode_tokens,
             "mean_occupancy": round(self.mean_occupancy, 4),
             "mean_budget_fill": round(self.mean_budget_fill, 4),
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_hit_rate": round(self.prefix_hit_rate, 4),
+            "prefix_inserts": self.prefix_inserts,
+            "prefix_evictions": self.prefix_evictions,
+            "prefix_cows": self.prefix_cows,
         }
 
     def report(self) -> str:
@@ -145,7 +168,10 @@ class SchedulerStats:
             f"occ={s['mean_occupancy']:.2f} fill={s['mean_budget_fill']:.2f} "
             f"prefill_toks={s['prefill_tokens']} "
             f"decode_toks={s['decode_tokens']} adm={s['admitted']} "
-            f"preempt={s['preemptions']} failed={s['failed']}"
+            f"preempt={s['preemptions']} failed={s['failed']} "
+            f"pfx_hit={s['prefix_hits']}/{s['prefix_hits'] + s['prefix_misses']}"
+            f" pfx_toks={s['prefix_hit_tokens']} "
+            f"pfx_evict={s['prefix_evictions']} pfx_cow={s['prefix_cows']}"
         )
 
 

@@ -14,6 +14,7 @@ from .transformer import (  # noqa: F401  (engine serving protocol)
     DecoderConfig,
     commit_kv,
     commit_kv_paged,
+    copy_page_kv,
     forward,
     init_kv_cache,
     init_paged_kv_cache,

@@ -881,6 +881,21 @@ def serve_step_paged(
     return logits, {"k": k_new, "v": v_new}
 
 
+def copy_page_kv(
+    cache: Dict[str, jnp.ndarray],
+    src: jnp.ndarray,  # () int32 physical page
+    dst: jnp.ndarray,  # () int32 physical page
+) -> Dict[str, jnp.ndarray]:
+    """Copy one physical page's K/V lines (all layers) to another page —
+    the device half of prefix-cache copy-on-write (serve/
+    prefix_cache.py): a request appending into a shared cached tail page
+    writes into a private copy, never the cached original."""
+    return {
+        name: buf.at[:, dst].set(buf[:, src])  # (L, P+1, ps, KV, dk)
+        for name, buf in cache.items()
+    }
+
+
 def commit_kv_paged(
     cache: Dict[str, jnp.ndarray],
     page_table: jnp.ndarray,  # (R, NP) int32

@@ -1005,6 +1005,19 @@ def serve_step_paged(
     return logits, new_cache
 
 
+def copy_page_kv(cache, src, dst):
+    """Copy one physical page's lines to another page (prefix-cache
+    copy-on-write; see models.llama.copy_page_kv) — the position pool
+    pages like K/V but without the layer dim."""
+    out = {}
+    for name, buf in cache.items():
+        if name == "pos":  # (P+1, ps)
+            out[name] = buf.at[dst].set(buf[src])
+        else:              # (L, P+1, ps, KV, dk)
+            out[name] = buf.at[:, dst].set(buf[:, src])
+    return out
+
+
 def commit_kv_paged(cache, page_table, src, dst):
     """:func:`commit_kv` through the page table (see
     models.llama.commit_kv_paged); the position pool pages like K/V but

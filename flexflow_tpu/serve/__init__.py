@@ -15,6 +15,7 @@ from .batch_config import (
 from .engine import InferenceEngine, ServingConfig
 from .llm import LLM, SSM, detect_family
 from .paging import PageAllocator
+from .prefix_cache import PrefixCache
 from .request_manager import Request, RequestManager, RequestStatus
 from .sampling import sample_tokens
 from .specinfer import SpecConfig, SpecInferManager, TokenTree
@@ -26,6 +27,7 @@ __all__ = [
     "InferenceEngine",
     "LLM",
     "PageAllocator",
+    "PrefixCache",
     "SSM",
     "detect_family",
     "ServingConfig",

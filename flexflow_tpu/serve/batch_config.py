@@ -57,6 +57,13 @@ class BatchConfig:
     # row to the static chunk; qlens is the ragged truth the scheduler
     # and tests reason about.
     qlens: Optional[np.ndarray] = None  # (R,) int32
+    # Per-row prefill START offset: the first prompt token position this
+    # dispatch carries for each prefilling row (0 for cold prefills;
+    # past the cached prefix on a prefix-cache hit — serve/
+    # prefix_cache.py). ``positions`` already encode it on the device
+    # side (the kernels handle ragged rows unchanged); this field
+    # carries it explicitly for telemetry and tests.
+    prefill_offsets: Optional[np.ndarray] = None  # (R,) int32
 
     @property
     def num_slots(self) -> int:
@@ -104,6 +111,9 @@ class ProfileInfo:
     start_time: float = 0.0
     finish_time: float = 0.0
     first_token_time: float = 0.0
+    # Prompt tokens served from the prefix cache at admission (prefill
+    # started past them); 0 on a miss or with caching off.
+    cached_prefix_len: int = 0
     llm_decoding_steps: int = 0
     ssm_decoding_steps: int = 0
     speculated_tokens: int = 0

@@ -81,6 +81,22 @@ class ServingConfig:
     # allocated lazily). Set it below the worst case to oversubscribe:
     # the RequestManager preempts (recompute-on-readmit) on exhaustion.
     max_cached_tokens: Optional[int] = None
+    # Automatic prefix caching (serve/prefix_cache.py, paged layout
+    # only — a no-op passthrough on dense): finished requests' prompt
+    # pages stay live in a radix tree; a new request whose prompt shares
+    # a cached page-aligned prefix splices those pages into its table
+    # and prefills only the uncached suffix. Cached-but-idle pages are
+    # LRU-evicted before any allocation fails, so the cache never causes
+    # a preemption a cold pool would not. Off by default: cached pages
+    # intentionally outlive their requests, which changes the pool
+    # accounting benchmarks/tests of the cold allocator assert on.
+    prefix_caching: bool = False
+    # What gets published into the prefix tree: "complete" (default) —
+    # the whole sequence, prompt + generated, at request completion (the
+    # multi-turn case: the next turn's prompt extends this turn's
+    # transcript); "prefill" — the prompt alone, as soon as its last
+    # chunk is dispatched (concurrent same-prompt requests hit sooner).
+    cache_policy: str = "complete"
 
     @property
     def cache_len(self) -> int:
@@ -155,6 +171,11 @@ class InferenceEngine:
             raise ValueError(
                 f"unknown kv_layout {self.serving.kv_layout!r} "
                 "(expected 'dense' or 'paged')"
+            )
+        if self.serving.cache_policy not in ("complete", "prefill"):
+            raise ValueError(
+                f"unknown cache_policy {self.serving.cache_policy!r} "
+                "(expected 'complete' or 'prefill')"
             )
         self.pager = None  # PageAllocator when paged (host-side tables)
         if self.pipelined:
@@ -589,6 +610,23 @@ class InferenceEngine:
             logits, self.cache = step(self.params, self.cache, *args)
         return logits
 
+    def copy_page(self, src: int, dst: int):
+        """Device-side copy of one physical page's K/V lines across all
+        layers (prefix-cache copy-on-write, serve/prefix_cache.py:
+        a request that must append into a SHARED cached tail page gets a
+        private copy first). One jitted program, page ids traced — the
+        compile is paid once."""
+        if "copy_page" not in self._steps:
+            self._steps["copy_page"] = jax.jit(
+                self.model.copy_page_kv, donate_argnums=(0,)
+            )
+        with _set_mesh(self.mesh):
+            self.cache = self._steps["copy_page"](
+                self.cache,
+                jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32),
+            )
+
     def reorder(self, src_slots: np.ndarray):
         """Slot permutation/gather of the whole cache (beam search
         hypothesis reordering): new slot r holds old slot src_slots[r].
@@ -634,5 +672,7 @@ class InferenceEngine:
 
     def reset(self):
         """Drop all cached sequences (fresh KV cache; paged: fresh
-        allocator — all pages back on the free list)."""
+        allocator — all pages back on the free list). Any PrefixCache
+        built over the old allocator is invalidated with it — managers
+        are expected to be rebuilt alongside an engine reset."""
         self.cache = self._alloc_cache()

@@ -1,0 +1,359 @@
+"""Automatic prefix caching — radix-tree KV reuse over the paged pool.
+
+Serving traffic is dominated by shared prompt prefixes: system prompts,
+few-shot templates, multi-turn conversations that resend the whole
+history. Re-prefilling those tokens recomputes K/V the pool already
+holds. This module keeps a **token-block radix tree** mapping page-
+aligned prompt blocks to live physical KV pages (vLLM's automatic
+prefix caching / SGLang's RadixAttention, grafted onto serve/paging.py's
+refcounted pool): on admission the RequestManager walks the tree with
+the new prompt, splices every matched page into the request's page
+table, and starts prefill at the first uncached token — a full hit
+turns a multi-chunk prefill into a single-token step.
+
+Design points:
+
+* **Blocks are page-sized** (one tree node per physical page) and keys
+  are hash-chained — ``node.key = hash((parent.key, block_tokens))`` —
+  so a block's identity pins the entire prefix behind it, never just
+  its own tokens. Lookup walks the tree (children keyed by the exact
+  block tuple); the hash chain is carried for logging/telemetry and as
+  a cheap cross-check that two walks agree on identity.
+* **Pages are shared, never copied, on the hit path.** A matched page
+  is spliced by reference (``PageAllocator.splice`` bumps refcounts);
+  attention only ever READS the shared prefix, so any number of
+  requests can hang off the same physical pages.
+* **Copy-on-write for partial tails.** When the match ends inside a
+  page (a prompt shorter than the cached one, or a cached partial tail
+  block), the request must append K/V lines into that page — so it
+  gets a private copy first (``PageAllocator.cow`` + the engine's
+  device-side ``copy_page``). Full-page matches never COW: the next
+  write lands in a fresh page.
+* **The cache never causes preemption.** Tree-held pages with no slot
+  references (refcount 1) are idle and reclaimable; the allocator's
+  ``reclaim_cb`` points at :meth:`PrefixCache.reclaim`, which evicts
+  LRU leaves until the shortfall is covered — so a cold pool and a
+  cached pool admit exactly the same requests, the cached one just
+  starts them further along.
+* **Insertion is pure bookkeeping.** On completion (cache_policy
+  "complete", the default — caches prompt AND generated tokens, the
+  multi-turn case) or at prefill end ("prefill"), the request's valid
+  prefix blocks are inserted/refreshed; the pages already hold the K/V,
+  the tree just takes a reference. Only lines actually written on
+  device are published: ``valid`` excludes the final sampled token
+  (its K/V is only written when it becomes a later step's input).
+
+Cache hits change only the page table and the prefill start offset —
+never the jitted step (MPK-style: reuse logic stays out of the kernel;
+the kernels already handle ragged rows).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..logging_utils import get_logger
+from .paging import PageAllocator
+
+
+class _Node:
+    """One cached token block: ``tokens`` (≤ page_size; shorter only for
+    tail blocks) backed by physical ``page`` whose first ``len(tokens)``
+    lines hold those tokens' K/V. ``key`` is the hash chain identifying
+    the whole prefix ending at this block."""
+
+    __slots__ = ("tokens", "page", "key", "parent", "children", "partials",
+                 "last_used")
+
+    def __init__(self, tokens: Tuple[int, ...], page: int, key: int,
+                 parent: "_Node"):
+        self.tokens = tokens
+        self.page = page
+        self.key = key
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], _Node] = {}  # full blocks
+        self.partials: Dict[Tuple[int, ...], _Node] = {}  # tail blocks
+        self.last_used = 0
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self.children and not self.partials
+
+
+def _chain(parent_key: int, tokens: Tuple[int, ...]) -> int:
+    return hash((parent_key, tokens))
+
+
+def _common_prefix(a: Sequence[int], b: Sequence[int]) -> int:
+    n = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        n += 1
+    return n
+
+
+class PrefixCache:
+    """Radix tree of cached prompt blocks over a :class:`PageAllocator`.
+
+    ``copy_page(src, dst)`` is the device-side page copy used by COW
+    (engine.copy_page); None skips the data movement (allocator-level
+    tests that only exercise the bookkeeping invariants). ``stats`` is
+    a SchedulerStats or a zero-arg callable returning one — the
+    RequestManager passes a callable so event counters follow when a
+    bench swaps ``rm.stats`` for a fresh object mid-run.
+    """
+
+    def __init__(
+        self,
+        pager: PageAllocator,
+        *,
+        copy_page: Optional[Callable[[int, int], None]] = None,
+        policy: str = "complete",
+        stats=None,
+    ):
+        if policy not in ("complete", "prefill"):
+            raise ValueError(
+                f"unknown cache_policy {policy!r} "
+                "(expected 'complete' or 'prefill')"
+            )
+        self.pager = pager
+        self.page_size = pager.page_size
+        self.copy_page = copy_page
+        self.policy = policy
+        self._stats_src = stats
+        self._root = _Node((), pager.scratch_page, hash(()), parent=None)
+        self._tick = itertools.count(1)
+        self._log = get_logger("serve")
+
+    @property
+    def stats(self):
+        return self._stats_src() if callable(self._stats_src) else self._stats_src
+
+    # ------------------------------------------------------------------
+    # accounting
+
+    def _nodes(self) -> List[_Node]:
+        out, stack = [], [self._root]
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                out.append(c)
+                stack.append(c)
+            for c in n.partials.values():
+                out.append(c)
+                stack.append(c)
+        return out
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._nodes())
+
+    def page_refs(self) -> Dict[int, int]:
+        """References the tree holds per physical page (each page lives
+        in exactly one node) — feeds
+        ``PageAllocator.check_no_leaks(external=...)``."""
+        refs: Dict[int, int] = {}
+        for n in self._nodes():
+            refs[n.page] = refs.get(n.page, 0) + 1
+        return refs
+
+    # ------------------------------------------------------------------
+    # lookup
+
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of ``tokens``: returns the physical
+        pages covering it and the matched token count. Capped at
+        ``len(tokens) - 1`` — the last prompt token is always
+        recomputed so its logit exists to sample the first output from.
+        A tail block may match partially (the new prompt diverges or
+        ends inside it); the caller COWs that page before any write."""
+        limit = len(tokens) - 1
+        node, pages, matched = self._root, [], 0
+        tick = next(self._tick)
+        ps = self.page_size
+        while matched < limit:
+            rem = limit - matched
+            if rem >= ps:
+                child = node.children.get(tuple(tokens[matched:matched + ps]))
+                if child is not None:
+                    child.last_used = tick
+                    pages.append(child.page)
+                    matched += ps
+                    node = child
+                    continue
+            # no full-block descent: best partial overlap with any block
+            # hanging off this node (a full block used partially, or a
+            # cached tail block)
+            want = tokens[matched:limit]
+            best, best_len = None, 0
+            for cand in itertools.chain(
+                node.children.values(), node.partials.values()
+            ):
+                n = _common_prefix(cand.tokens, want)
+                if n > best_len:
+                    best, best_len = cand, n
+            if best is not None:
+                best.last_used = tick
+                pages.append(best.page)
+                matched += best_len
+            break
+        return pages, matched
+
+    # ------------------------------------------------------------------
+    # admission: splice + COW
+
+    def attach(self, slot: int, tokens: Sequence[int]) -> int:
+        """Admission-time hit path: match ``tokens``, splice the matched
+        pages into ``slot``'s (empty) table, COW the tail page when the
+        match ends mid-page, and return the matched token count — the
+        request's prefill start offset. Falls back block-by-block when
+        COW cannot get a page (drops the partial tail rather than fail
+        the admission); returns 0 on a miss."""
+        pages, matched = self.match(tokens)
+        cow_src = None
+        if matched % self.page_size:
+            # the request appends K/V into the tail page → private copy
+            fresh = self.pager.take_free_page()
+            if fresh is None:
+                matched -= matched % self.page_size
+                pages = pages[:-1]
+            else:
+                cow_src = pages[-1]
+                pages[-1] = fresh
+        if not matched:
+            return 0
+        self.pager.splice(slot, pages)
+        if cow_src is not None:
+            if self.stats is not None:
+                self.stats.prefix_cows += 1
+            if self.copy_page is not None:
+                self.copy_page(cow_src, pages[-1])
+            self._log.debug(
+                "prefix COW: slot %d page %d -> %d (tail at %d)",
+                slot, cow_src, pages[-1], matched,
+            )
+        self._log.debug(
+            "prefix hit: slot %d matched %d/%d tokens (%d pages)",
+            slot, matched, len(tokens), len(pages),
+        )
+        return matched
+
+    # ------------------------------------------------------------------
+    # insertion
+
+    def _adopt(self, node: _Node, blk: Tuple[int, ...], page: int,
+               tick: int, full: bool) -> Optional[_Node]:
+        """Insert/refresh one block under ``node``; returns the child to
+        descend into (full blocks only). A physical page lives in at
+        most ONE node: re-inserting the page this slot spliced from the
+        tree refreshes in place, and a tail block the owner has since
+        extended (same page, longer tokens) is re-keyed rather than
+        duplicated."""
+        bucket = node.children if full else node.partials
+        hit = bucket.get(blk)
+        if hit is not None:
+            hit.last_used = tick
+            return hit
+        # same page already cached here under a shorter tail? The owner
+        # extended the block in place (decode grew the page) — re-key.
+        for key, cand in list(node.partials.items()):
+            if cand.page == page:
+                if _common_prefix(cand.tokens, blk) == len(cand.tokens):
+                    del node.partials[key]
+                    cand.tokens = blk
+                    cand.key = _chain(node.key, blk)
+                    cand.last_used = tick
+                    bucket[blk] = cand
+                    return cand
+                return None  # diverged content on one page — stale; skip
+        child = _Node(blk, page, _chain(node.key, blk), parent=node)
+        child.last_used = tick
+        self.pager.acquire(page)
+        bucket[blk] = child
+        if self.stats is not None:
+            self.stats.prefix_inserts += 1
+        return child
+
+    def insert(self, slot: int, tokens: Sequence[int], valid: int) -> None:
+        """Publish ``slot``'s pages for ``tokens[:valid]`` into the tree
+        (``valid`` = cache lines actually written on device). Existing
+        nodes are refreshed (LRU) and kept — the tree's page wins over
+        the slot's duplicate, which simply drains with the slot. The
+        pages keep serving this slot unchanged; the tree just holds an
+        extra reference from here on."""
+        ps = self.page_size
+        valid = min(int(valid), len(tokens))
+        row = self.pager.table[slot]
+        node = self._root
+        tick = next(self._tick)
+        for d in range(-(-valid // ps)):
+            lo = d * ps
+            blk = tuple(int(t) for t in tokens[lo:min(lo + ps, valid)])
+            if not blk:
+                break
+            page = int(row[d])
+            if page == self.pager.scratch_page:
+                break  # lines beyond the slot's materialized pages
+            child = self._adopt(node, blk, page, tick, full=len(blk) == ps)
+            if child is None or len(blk) < ps:
+                break
+            node = child
+        self._log.debug(
+            "prefix insert: slot %d published %d tokens (%d blocks, "
+            "%d cached pages total)",
+            slot, valid, -(-valid // ps), self.cached_pages,
+        )
+
+    # ------------------------------------------------------------------
+    # eviction (the allocator's reclaim_cb)
+
+    def _evict_one(self) -> bool:
+        """Free the least-recently-used idle leaf (refcount 1 — held
+        only by the tree, no slot references, no children pinning it as
+        interior). Returns False when nothing is evictable."""
+        victim = None
+        for n in self._nodes():
+            if not n.is_leaf:
+                continue
+            if int(self.pager.refcount[n.page]) != 1:
+                continue  # spliced into a live slot — not idle
+            if victim is None or n.last_used < victim.last_used:
+                victim = n
+        if victim is None:
+            return False
+        parent = victim.parent
+        bucket = (
+            parent.children if victim.tokens in parent.children
+            and parent.children[victim.tokens] is victim else parent.partials
+        )
+        del bucket[victim.tokens]
+        self.pager.release_ref(victim.page)
+        if self.stats is not None:
+            self.stats.prefix_evictions += 1
+        self._log.debug(
+            "prefix evict: page %d (chain %x, lru %d)",
+            victim.page, victim.key & 0xFFFFFFFF, victim.last_used,
+        )
+        return True
+
+    def reclaim(self, shortfall: int) -> int:
+        """Evict LRU idle cached pages until ``shortfall`` pages hit the
+        free list (or nothing idle remains). Evicting a leaf can expose
+        its parent as the next leaf, so deep idle chains peel bottom-up.
+        Returns the number of pages freed."""
+        freed = 0
+        while freed < shortfall and self._evict_one():
+            freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every cached page (tree refs released; pages with no
+        slot references return to the free list). Returns the number of
+        nodes released."""
+        nodes = self._nodes()
+        for n in nodes:
+            self.pager.release_ref(n.page)
+        self._root.children.clear()
+        self._root.partials.clear()
+        return len(nodes)

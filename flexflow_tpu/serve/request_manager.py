@@ -95,6 +95,11 @@ class RequestManager:
     # Subclasses that keep a second engine's cache in sync (SpecInfer)
     # must not use the LLM-only fast decode pipeline.
     supports_fast_decode = True
+    # Automatic prefix caching (serve/prefix_cache.py) assumes ONE
+    # engine owns the page pool; managers that mirror slot state across
+    # engines (SpecInfer: the SSM pool pages independently, so a splice
+    # into the LLM table has no SSM counterpart) opt out.
+    supports_prefix_cache = True
 
     def __init__(
         self,
@@ -143,6 +148,27 @@ class RequestManager:
         self._prev_dispatch_slots: set = set()
         self.stats = SchedulerStats()
         self._log = get_logger("serve")
+        # Automatic prefix caching (paged layout only — on dense,
+        # prefix_caching=True is a documented passthrough: there are no
+        # pages to share). The radix tree owns one reference per cached
+        # page; the allocator's reclaim hook evicts idle cached pages
+        # before any allocation fails.
+        self.prefix_cache = None
+        sc = engine.serving
+        if (
+            self.supports_prefix_cache
+            and sc.prefix_caching
+            and getattr(engine, "paged", False)
+        ):
+            from .prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                engine.pager,
+                copy_page=engine.copy_page,
+                policy=sc.cache_policy,
+                stats=lambda: self.stats,
+            )
+            engine.pager.reclaim_cb = self.prefix_cache.reclaim
 
     # ------------------------------------------------------------------
     # registration (reference register_new_request, request_manager.cc:137)
@@ -376,8 +402,20 @@ class RequestManager:
             rid = self.pending[0]
             req = self.requests[rid]
             req.slot = i
+            # Prefix-cache hit path: splice cached prompt pages into the
+            # (empty) slot table and jump prefill past them — the mixed/
+            # sync steps then only chunk the uncached suffix. A rolled-
+            # back admission releases the spliced references with the
+            # slot, so retrying is clean.
+            matched = 0
+            if self.prefix_cache is not None:
+                matched = self.prefix_cache.attach(i, req.tokens)
             if self._paged and not self._ensure_pages(
-                req, min(len(req.tokens), self.engine.serving.prefill_chunk)
+                req,
+                min(
+                    len(req.tokens),
+                    matched + self.engine.serving.prefill_chunk,
+                ),
             ):
                 # pool cannot take the first chunk: stop admitting (a
                 # flush will free pages; the request stays queued) and
@@ -387,12 +425,19 @@ class RequestManager:
                 return
             self.pending.pop(0)
             req.status = RequestStatus.PREFILLING
-            req.n_cached = 0
-            req.n_sched = 0
+            req.n_cached = matched
+            req.n_sched = matched
             req.inflight = 0
             req.pipeline_refs = 0
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
+            req.profile.cached_prefix_len = matched
+            if self.prefix_cache is not None:
+                if matched:
+                    self.stats.prefix_hits += 1
+                    self.stats.prefix_hit_tokens += matched
+                else:
+                    self.stats.prefix_misses += 1
             self.slots[i] = rid
             self.stats.admitted += 1
 
@@ -421,6 +466,20 @@ class RequestManager:
         req.status = RequestStatus.ERROR if error else RequestStatus.COMPLETED
         req.error = error
         req.profile.finish_time = time.perf_counter()
+        if (
+            self.prefix_cache is not None
+            and error is None
+            and req.slot >= 0
+            and self.prefix_cache.policy == "complete"
+        ):
+            # Publish the finished sequence's blocks (prompt + generated
+            # — the next conversation turn extends this transcript).
+            # Only lines written on device are valid: the final sampled
+            # token's K/V never was (it would have been the next step's
+            # input), so the insertable prefix ends one short.
+            self.prefix_cache.insert(
+                req.slot, req.tokens, len(req.tokens) - 1
+            )
         # With dispatches still in flight for this slot, defer the
         # release to the flush that drains the last of them: those
         # dispatches keep writing (garbage) K/V through the page table
@@ -475,6 +534,8 @@ class RequestManager:
         bc.logits_idx[req.slot] = n - 1
         if bc.qlens is not None:
             bc.qlens[req.slot] = n
+        if bc.prefill_offsets is not None:
+            bc.prefill_offsets[req.slot] = off
 
     def _prepare_batch(self) -> Optional[BatchConfig]:
         """Build one blocking mixed prefill+decode batch (the sync
@@ -490,6 +551,7 @@ class RequestManager:
         chunk = sc.prefill_chunk if prefilling else 1
         bc = BatchConfig.empty(self.engine.num_slots, chunk, self.engine.scratch_pos)
         bc.qlens = np.zeros((self.engine.num_slots,), np.int32)
+        bc.prefill_offsets = np.zeros((self.engine.num_slots,), np.int32)
         for req in prefilling:
             self._fill_prefill_row(bc, req, chunk)
         for req in decoding:
@@ -635,6 +697,7 @@ class RequestManager:
         C = sc.mixed_chunk
         bc = BatchConfig.empty(R, C, eng.scratch_pos)
         bc.qlens = np.zeros((R,), np.int32)
+        bc.prefill_offsets = np.zeros((R,), np.int32)
         use_last = np.zeros((R,), bool)
         snapshot = []
         sampled_slots = set()
@@ -668,6 +731,7 @@ class RequestManager:
             bc.logits_idx[s] = n - 1
             bc.active[s] = True
             bc.qlens[s] = n
+            bc.prefill_offsets[s] = off
             final = off + n >= len(req.tokens)
             req.n_sched += n
             req.pipeline_refs += 1
@@ -678,6 +742,16 @@ class RequestManager:
                 req.status = RequestStatus.DECODING
                 req.inflight += 1
                 sampled_slots.add(s)
+                if (
+                    self.prefix_cache is not None
+                    and self.prefix_cache.policy == "prefill"
+                ):
+                    # every prompt line's write is dispatched — publish
+                    # the prompt now so concurrent same-prefix
+                    # admissions hit before this request even finishes
+                    self.prefix_cache.insert(
+                        s, req.tokens[: req.prompt_len], req.prompt_len
+                    )
             snapshot.append((req.request_id, s, n, final))
         if last is None:
             last = jnp.zeros((R,), jnp.int32)
@@ -863,6 +937,14 @@ class RequestManager:
             if req.n_cached >= len(req.tokens):
                 # prompt fully cached: first output token sampled now
                 req.status = RequestStatus.DECODING
+                if (
+                    self.prefix_cache is not None
+                    and self.prefix_cache.policy == "prefill"
+                ):
+                    self.prefix_cache.insert(
+                        req.slot, req.tokens[: req.prompt_len],
+                        req.prompt_len,
+                    )
                 req.profile.llm_decoding_steps += 1
                 self._append_token(req, sampled[req.slot])
         self._step_counter += 1

@@ -173,6 +173,10 @@ class SpecInferManager(RequestManager):
     # The fused decode pipeline bypasses _run_batch and would desync the
     # SSM cache; spec rounds have their own device-side batching anyway.
     supports_fast_decode = False
+    # Prefix caching splices pages in ONE engine's pool; the SSM pools
+    # page independently, so a spliced LLM prefix would leave the SSM
+    # cache cold and desync verification — opt out.
+    supports_prefix_cache = False
 
     def __init__(
         self,

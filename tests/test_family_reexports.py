@@ -1,0 +1,51 @@
+"""Tier-1 wiring of scripts/check_family_reexports.py: the PR-1
+re-export pattern (family modules re-exporting models/transformer.py's
+serving protocol) has no compile-time guard — a serve symbol added to
+transformer.py/llama.py but missed in a family module only explodes
+when an engine feature touches it at runtime. This test rots loudly
+instead."""
+import importlib.util
+import os
+
+
+def _load_checker():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "check_family_reexports.py",
+    )
+    spec = importlib.util.spec_from_file_location("check_family_reexports", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_families_reexport_full_serve_api():
+    checker = _load_checker()
+    missing = checker.check()
+    assert not missing, (
+        "family modules missing serve API symbols (add them to the "
+        f"re-export block): {missing}"
+    )
+
+
+def test_guard_covers_the_engine_call_surface():
+    """The guard's SERVE_API list must itself track what the engine
+    actually calls — if InferenceEngine grows a model hook that the
+    list misses, the guard silently stops guarding. Cross-check the
+    hooks the engine resolves via ``self.model.<name>``."""
+    import re
+
+    checker = _load_checker()
+    eng_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "flexflow_tpu", "serve", "engine.py",
+    )
+    src = open(eng_path).read()
+    called = set(re.findall(r"self\.model\.(\w+)", src))
+    called -= {"__name__"}  # logging, not protocol
+    hooks = called - set(checker.SERVE_API)
+    assert not hooks, (
+        f"engine calls model hooks the re-export guard misses: {hooks} "
+        "— add them to scripts/check_family_reexports.py SERVE_API"
+    )

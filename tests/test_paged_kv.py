@@ -92,6 +92,113 @@ class TestPageAllocator:
         with pytest.raises(ValueError, match="smaller than one request"):
             PageAllocator(2, 4, 2, 16)
 
+    def test_refcounted_sharing_and_cow(self):
+        """Shared pages (prefix-cache splicing) survive their other
+        holders; COW swaps in a private page and drops the shared ref."""
+        pa = PageAllocator(16, 4, 4, 8)
+        assert pa.ensure(0, 20)  # slot 0 owns 3 pages
+        shared = [int(p) for p in pa.table[0][:2]]
+        pa.splice(1, shared)     # slot 1 shares slot 0's first 2 pages
+        assert [int(p) for p in pa.table[1][:2]] == shared
+        assert all(int(pa.refcount[p]) == 2 for p in shared)
+        fresh = pa.cow(1, 1)     # slot 1 appends into the shared tail
+        assert fresh is not None and fresh != shared[1]
+        assert int(pa.refcount[shared[1]]) == 1  # back to slot 0 alone
+        assert int(pa.refcount[fresh]) == 1
+        pa.check_no_leaks()
+        assert pa.release(1) == 1      # frees only the COW page
+        assert int(pa.refcount[shared[0]]) == 1
+        assert pa.release(0) == 3      # now everything returns
+        assert pa.free_pages == 16
+        pa.check_no_leaks()
+
+    def test_reclaim_cb_feeds_ensure(self):
+        """An exhausted free list asks the reclaim hook (prefix-cache
+        LRU eviction) before failing."""
+        pa = PageAllocator(4, 4, 2, 8)
+        assert pa.ensure(0, 32)  # all 4 pages
+        calls = []
+
+        def reclaim(n):
+            calls.append(n)
+            return pa.release(0)  # evict "the cache"
+
+        pa.reclaim_cb = reclaim
+        assert pa.ensure(1, 16)  # succeeds via the hook
+        assert calls == [2]
+        pa.check_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# randomized property test: allocator + prefix-cache refcount invariants
+
+
+class TestAllocatorProperty:
+    def test_randomized_interleavings_keep_invariants(self):
+        """Random admit/grow/share(attach)/COW/insert/release
+        interleavings across 64 slots: after EVERY step the pool must
+        hold no leak, no double-free, and refcount-zero-iff-free
+        (check_no_leaks audits all three against the slot tables plus
+        the prefix tree's external refs)."""
+        from flexflow_tpu.serve.prefix_cache import PrefixCache
+
+        rng = np.random.default_rng(1234)
+        slots, ps, pps = 64, 4, 6
+        pa = PageAllocator(160, pps, slots, ps)
+        cache = PrefixCache(pa, copy_page=None)  # bookkeeping-only COW
+        pa.reclaim_cb = cache.reclaim
+        max_lines = pps * ps
+        # a handful of shared stems makes attach hit real cached blocks
+        stems = [
+            [int(t) for t in rng.integers(0, 97, size=rng.integers(5, 16))]
+            for _ in range(6)
+        ]
+        active = {}  # slot -> (tokens, lines ensured)
+
+        def check():
+            pa.check_no_leaks(external=cache.page_refs())
+
+        for _ in range(600):
+            op = rng.choice(["admit", "grow", "insert", "release"])
+            free_slots = [s for s in range(slots) if s not in active]
+            if op == "admit" and free_slots:
+                s = int(rng.choice(free_slots))
+                toks = list(stems[int(rng.integers(len(stems)))]) + [
+                    int(t) for t in rng.integers(0, 97,
+                                                 size=rng.integers(1, 9))
+                ]
+                toks = toks[:max_lines - 1]
+                matched = cache.attach(s, toks)
+                assert matched < len(toks)
+                want = min(len(toks), matched + ps)
+                if pa.ensure(s, want):
+                    active[s] = (toks, want)
+                else:  # admission failed: roll back the splice
+                    pa.release(s)
+            elif op == "grow" and active:
+                s = int(rng.choice(list(active)))
+                toks, lines = active[s]
+                want = min(len(toks), lines + int(rng.integers(1, 2 * ps)))
+                if pa.ensure(s, want):
+                    active[s] = (toks, want)
+            elif op == "insert" and active:
+                s = int(rng.choice(list(active)))
+                toks, lines = active[s]
+                cache.insert(s, toks, min(lines, len(toks)))
+            elif op == "release" and active:
+                s = int(rng.choice(list(active)))
+                pa.release(s)
+                del active[s]
+            check()
+        # drain: every slot released; only tree refs remain, and
+        # clearing the tree returns the pool to fully free
+        for s in list(active):
+            pa.release(s)
+        check()
+        cache.clear()
+        pa.check_no_leaks()
+        assert pa.free_pages == pa.num_pages
+
 
 # ---------------------------------------------------------------------------
 # paged vs dense parity
