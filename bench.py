@@ -712,6 +712,9 @@ def serve_paged_bench(on_tpu, kernels):
                 n_req * (prompt_len + n_new + page_size)
                 if layout == "paged" else None
             ),
+            # retrace sentinel: a steady-state recompile raises at the
+            # offending dispatch instead of silently deflating tps
+            sanitizers=("retrace",),
         )
 
     def timed(rm, n_req):
@@ -756,6 +759,9 @@ def serve_paged_bench(on_tpu, kernels):
         pass  # drain before the timed run
 
     paged_tps = timed(rm, 64)
+    # one compile per step key over warmup + both timed runs — the
+    # zero-steady-state-recompiles claim, asserted
+    eng.retrace_guard.assert_one_compile_per_key()
     emit(
         "paged_kv_hbm_bytes_per_live_token",
         round(bytes_per_live_token, 1),
@@ -777,6 +783,8 @@ def serve_paged_bench(on_tpu, kernels):
         dense_8slot_tokens_per_sec=round(dense_tps, 2),
         new_tokens_per_request=n_new,
         kv_hbm_bytes_per_live_token=round(bytes_per_live_token, 1),
+        jit_compiles=eng.retrace_guard.total_compiles,
+        steady_state_recompiles=eng.retrace_guard.retraces,
         model_params_b=round(llama.num_params(cfg) / 1e9, 3),
         platform=_platform(),
     )
@@ -852,6 +860,12 @@ def serve_continuous_bench(on_tpu, kernels):
             max_cached_tokens=n_slots * (prompt_len + n_new + page_size),
             continuous_batching=continuous,
             max_tokens_per_step=mixed_budget if continuous else 0,
+            # retrace sentinel (analysis/retrace.py): any steady-state
+            # step recompile raises at the offending dispatch (and the
+            # measured run's compile counters are asserted zero below)
+            # — a host-side shape/dtype drift would otherwise hide as
+            # scheduler noise in this phase's throughput numbers
+            sanitizers=("retrace",),
         )
         rm = RequestManager(InferenceEngine(llama, cfg, params, sc))
         rm.generate(prompts[:n_slots], max_new_tokens=4)  # warm/compile
@@ -927,6 +941,12 @@ def serve_continuous_bench(on_tpu, kernels):
     assert cont["outputs"] == base["outputs"], (
         "continuous vs flush-on-admit scheduler outputs diverged"
     )
+    # stats were reset after warmup, so compiles/retraces here count the
+    # MEASURED run only: steady state must replay warmed programs
+    assert cont["stats"]["retraces"] == 0 and base["stats"]["retraces"] == 0, (
+        f"steady-state recompiles in the measured serve run: "
+        f"cont={cont['stats']['retraces']} base={base['stats']['retraces']}"
+    )
     ratio = cont["tps"] / max(1e-9, base["tps"])
     emit(
         "continuous_serve_tokens_per_sec_per_chip",
@@ -954,6 +974,8 @@ def serve_continuous_bench(on_tpu, kernels):
         mean_occupancy=cont["stats"]["mean_occupancy"],
         mean_budget_fill=cont["stats"]["mean_budget_fill"],
         pipeline_drains=cont["stats"]["pipeline_drains"],
+        jit_compiles_measured=cont["stats"]["compiles"],
+        steady_state_recompiles=cont["stats"]["retraces"],
         model_params_b=round(llama.num_params(cfg) / 1e9, 3),
         platform=_platform(),
     )
@@ -1018,6 +1040,9 @@ def serve_prefix_bench(on_tpu, kernels):
             # pressure enough that LRU eviction stays exercised
             max_cached_tokens=n_slots * (prompt_len + n_new + page_size),
             prefix_caching=caching,
+            # retrace sentinel: splice/COW churn must replay the warmed
+            # programs — a recompile raises instead of skewing the A/B
+            sanitizers=("retrace",),
         )
         rm = RequestManager(InferenceEngine(llama, cfg, params, sc))
         rm.generate(prompts[:n_slots], max_new_tokens=4)  # warm/compile
@@ -1084,6 +1109,13 @@ def serve_prefix_bench(on_tpu, kernels):
         "prefix-cached vs cold scheduler outputs diverged"
     )
     s = warm["stats"]
+    # zero steady-state recompiles on both sides of the A/B (the
+    # copy_page COW program may legitimately compile ONCE mid-run —
+    # only RE-compiles of a known step key are the hazard)
+    assert s["retraces"] == 0 and base["stats"]["retraces"] == 0, (
+        f"steady-state recompiles: warm={s['retraces']} "
+        f"base={base['stats']['retraces']}"
+    )
     total_prompt = n_req * prompt_len
     emit(
         "prefix_serve_tokens_per_sec_per_chip",
@@ -1104,6 +1136,8 @@ def serve_prefix_bench(on_tpu, kernels):
         ),
         prefix_evictions=s["prefix_evictions"],
         prefix_cows=s["prefix_cows"],
+        jit_compiles_measured=s["compiles"],
+        steady_state_recompiles=s["retraces"],
         ttft_p50_ms=round(warm["ttft"][0], 1),
         ttft_p99_ms=round(warm["ttft"][1], 1),
         baseline_ttft_p50_ms=round(base["ttft"][0], 1),

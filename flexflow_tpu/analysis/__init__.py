@@ -1,0 +1,99 @@
+"""ffcheck — JAX/TPU hazard analysis for the serving stack.
+
+FlexFlow's pitch is that the *runtime* keeps the execution plan optimal
+(SURVEY.md: Unity's simulator-guided search, SpecInfer's batched
+verify). In a JAX port the equivalent silent killers are unplanned XLA
+recompiles, host↔device syncs inside the decode loop, and
+use-after-donate on the KV cache — none of which fail a test until they
+cost a 100x step-latency spike (or corrupted pages) in production.
+This package is the correctness tooling for that class of bug, in
+three parts:
+
+1. **AST lint** (:mod:`.lint` + :mod:`.rules`) — static rules over the
+   package, run by ``scripts/ffcheck.py`` and the tier-1 guard
+   ``tests/test_ffcheck.py`` (zero unsuppressed findings required).
+2. **Retrace sentinel** (:mod:`.retrace` — :class:`RetraceGuard`) —
+   records every compile of every engine step program via the
+   ``InferenceEngine._jit`` chokepoint; strict mode raises
+   :class:`RetraceError` on any recompile of a known step key.
+3. **Donation sanitizer** (:mod:`.donation` —
+   :class:`DonationSanitizer`) — after every donated dispatch the old
+   cache pytree is poisoned (:class:`DeletedBufferProxy`), so
+   use-after-donate — the PR-2 page-corruption bug class — raises
+   :class:`UseAfterDonateError` at the faulty read.
+
+Runtime sanitizers are enabled per engine with
+``ServingConfig(sanitizers=("retrace", "donation"))`` (or
+``"retrace-warn"`` for record-only), or globally with
+``FF_SANITIZERS=retrace,donation`` in the environment.
+
+Rule catalog
+------------
+========  ====================  ==============================================
+Code      Slug                  Hazard
+========  ====================  ==============================================
+FF101     host-sync             ``jax.device_get``/``np.asarray``/``.item()``/
+                                ``float(tracer)`` reachable inside jit-traced
+                                code: a forced device sync per step, or host
+                                data constant-folded into the program.
+FF102     tracer-control-flow   Python ``if``/``while``/``assert`` on a value
+                                computed by ``jnp``/``jax.lax`` in traced
+                                code: concretization error, or one branch
+                                baked in forever.
+FF103     weak-dtype            ``jnp.asarray``/``jnp.array`` without an
+                                explicit dtype: the abstract signature follows
+                                the caller's host types — weak-type promotion
+                                (or an int-list → np.int32 flip) keys a
+                                retrace of every jitted consumer.
+FF104     unordered-iteration   Iterating a ``set``/``frozenset`` (or
+                                ``vars()``/``globals()``) in traced code: the
+                                compiled program depends on hash order.
+FF105     missing-donation      ``jax.jit`` of a function threading a
+                                ``cache``/``opt_state`` buffer without
+                                ``donate_argnums``: a full buffer copy per
+                                step.
+FF106     static-hashability    ``static_argnums``/``static_argnames`` whose
+                                parameter defaults/annotations are unhashable
+                                (list/dict/set): jit raises, or retraces per
+                                call.
+========  ====================  ==============================================
+
+Suppressions: ``# ffcheck: disable=FF101 -- reason`` on (or alone
+above) the offending line; ``# ffcheck: disable-file=RULE`` for a whole
+file; rule codes and slugs both work, ``all`` disables everything.
+
+Standalone::
+
+    python scripts/ffcheck.py                  # lint flexflow_tpu/
+    python scripts/ffcheck.py --diff main      # only files changed vs main
+    python scripts/ffcheck.py --list-rules
+"""
+from __future__ import annotations
+
+from .donation import (
+    DeletedBufferProxy,
+    DonationSanitizer,
+    UseAfterDonateError,
+)
+from .lint import (
+    Finding,
+    Rule,
+    get_rules,
+    lint_paths,
+    lint_source,
+)
+from .retrace import CompileEvent, RetraceError, RetraceGuard
+
+__all__ = [
+    "CompileEvent",
+    "DeletedBufferProxy",
+    "DonationSanitizer",
+    "Finding",
+    "RetraceError",
+    "RetraceGuard",
+    "Rule",
+    "UseAfterDonateError",
+    "get_rules",
+    "lint_paths",
+    "lint_source",
+]

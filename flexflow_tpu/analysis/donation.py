@@ -1,0 +1,158 @@
+"""Donation sanitizer — make use-after-donate fail loudly.
+
+Every engine step donates the KV cache (``donate_argnums`` on all of
+``engine._steps``/``_commit``): on TPU the output cache aliases the
+input buffer, so any host-side reference into the OLD cache pytree now
+reads (or scribbles on) memory the new step owns — the exact corruption
+class PR-2 fixed in the paged fast-decode path (a released page reused
+while an in-flight dispatch still wrote through the old table). On
+backends/paths where donation is ignored, the stale reference silently
+*works*, which is worse: tests pass, production corrupts.
+
+The sanitizer turns the hazard into a deterministic error: after each
+donated call the engine hands the OLD cache pytree to :meth:`poison`,
+which
+
+* deletes any leaf buffer jax did not already invalidate (simulating
+  the TPU aliasing semantics on backends that ignored donation), and
+* swaps every leaf entry of the (mutable) pytree for a
+  :class:`DeletedBufferProxy` that raises :class:`UseAfterDonateError`
+  — naming the donating step and dispatch ordinal — on ANY access.
+
+Holders of the cache *container* hit the proxy with a descriptive
+error; holders of a raw leaf array hit jax's own deleted-buffer error.
+Either way the use-after-donate fails at the faulty read in tests,
+instead of corrupting pages under load.
+
+Enable via ``ServingConfig(sanitizers=("donation",))`` or
+``FF_SANITIZERS=donation``.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class UseAfterDonateError(RuntimeError):
+    """A buffer that was donated to a jitted step was touched again."""
+
+
+_RAISING_DUNDERS = (
+    "__getitem__", "__setitem__", "__delitem__", "__iter__", "__len__",
+    "__contains__", "__array__", "__float__", "__int__", "__bool__",
+    "__index__", "__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+    "__rmul__", "__truediv__", "__rtruediv__", "__matmul__", "__rmatmul__",
+    "__neg__", "__pos__", "__abs__", "__eq__", "__ne__", "__lt__",
+    "__le__", "__gt__", "__ge__", "__call__", "__format__",
+)
+
+
+class DeletedBufferProxy:
+    """Poison value swapped in for donated buffers: any use raises
+    :class:`UseAfterDonateError` naming the donation site."""
+
+    __slots__ = ("_ffcheck_context",)
+
+    def __init__(self, context: str):
+        object.__setattr__(self, "_ffcheck_context", context)
+
+    def _ffcheck_raise(self, op: str):
+        raise UseAfterDonateError(
+            f"use-after-donate: {op} on a buffer donated to {self._ffcheck_context}. "
+            "This reference went stale when the step donated its cache "
+            "(donate_argnums) — on TPU the memory now belongs to the new "
+            "cache and this access would read/corrupt it. Re-read the "
+            "engine's current cache instead of holding the old pytree."
+        )
+
+    def __getattr__(self, name):
+        object.__getattribute__(self, "_ffcheck_raise")(
+            f"attribute access .{name}"
+        )
+
+    def __setattr__(self, name, value):
+        object.__getattribute__(self, "_ffcheck_raise")(
+            f"attribute write .{name}"
+        )
+
+    def __repr__(self):  # keep debuggers/logging safe
+        return (
+            f"<DeletedBufferProxy donated at "
+            f"{object.__getattribute__(self, '_ffcheck_context')}>"
+        )
+
+
+def _add_raising_dunders():
+    for name in _RAISING_DUNDERS:
+        def method(self, *a, _op=name, **k):
+            object.__getattribute__(self, "_ffcheck_raise")(f"{_op}()")
+        method.__name__ = name
+        setattr(DeletedBufferProxy, name, method)
+
+
+_add_raising_dunders()
+
+
+class DonationSanitizer:
+    """Poisons donated pytrees after each donated dispatch (see module
+    docstring). One instance per engine; ``n_poisoned`` counts poisoned
+    call sites for telemetry/tests."""
+
+    def __init__(self):
+        self.n_poisoned = 0
+        self.contexts: List[str] = []
+
+    def poison(self, tree: Any, context: str = "a donated step") -> int:
+        """Invalidate every array leaf of ``tree`` and swap leaf entries
+        of mutable containers (dict/list) for :class:`DeletedBufferProxy`.
+        Returns the number of leaves poisoned. Safe to call on an
+        already-poisoned tree (idempotent)."""
+        self.n_poisoned += 1
+        self.contexts.append(context)
+        if len(self.contexts) > 64:  # bounded telemetry
+            del self.contexts[:32]
+        return self._poison(tree, context)
+
+    def _poison(self, node: Any, context: str) -> int:
+        import jax
+
+        n = 0
+        if isinstance(node, dict):
+            for k, v in list(node.items()):
+                if isinstance(v, (dict, list)):
+                    n += self._poison(v, context)
+                else:
+                    n += self._poison_leaf(v)
+                    node[k] = DeletedBufferProxy(
+                        f"{context} (cache leaf {k!r})"
+                    )
+        elif isinstance(node, list):
+            for i, v in enumerate(list(node)):
+                if isinstance(v, (dict, list)):
+                    n += self._poison(v, context)
+                else:
+                    n += self._poison_leaf(v)
+                    node[i] = DeletedBufferProxy(
+                        f"{context} (cache leaf [{i}])"
+                    )
+        else:
+            # immutable container (tuple) or a bare leaf: can't swap in
+            # a proxy, but deleting the buffers still trips jax's own
+            # deleted-array error on use
+            for leaf in jax.tree.leaves(node):
+                n += self._poison_leaf(leaf)
+        return n
+
+    @staticmethod
+    def _poison_leaf(leaf: Any) -> int:
+        import jax
+
+        if isinstance(leaf, DeletedBufferProxy):
+            return 0
+        if isinstance(leaf, jax.Array):
+            try:
+                if not leaf.is_deleted():
+                    leaf.delete()
+            except RuntimeError:
+                pass
+            return 1
+        return 0

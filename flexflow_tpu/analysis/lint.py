@@ -1,0 +1,522 @@
+"""ffcheck AST lint core — trace-context discovery + rule driver.
+
+Static analysis over the package for JAX-on-TPU hazards that no runtime
+test catches until they cost a 100x slowdown in production: host↔device
+syncs inside jit-traced code, Python control flow on tracer values,
+weak-dtype ``jnp.asarray`` at jit-call boundaries, unordered-container
+iteration in trace code, cache buffers threaded through ``jax.jit``
+without donation, and unhashable static arguments.
+
+The analyzer is file-local and heuristic by design: it never imports
+the code under analysis (safe on broken trees, no device needed) and it
+prefers precision over recall — a rule that cries wolf gets suppressed
+into uselessness. Rules live one-per-file in ``analysis/rules/`` and
+register by exposing a module-level ``RULE`` object; see
+``analysis/__init__.py`` for the catalog.
+
+Trace-context discovery
+-----------------------
+A function is considered **traced** (its body runs under ``jax.jit``
+tracing, so host-sync and Python-control-flow hazards apply) when any
+of these hold:
+
+* decorated with ``jax.jit``/``pjit`` (bare, called, or via
+  ``functools.partial(jax.jit, ...)``) or a tracing transform
+  (``vmap``/``grad``/``checkpoint``/...);
+* passed by name to ``jax.jit``/``pjit``/``jax.lax.scan``/``cond``/
+  ``while_loop``/``vmap``/... anywhere in the same file (including the
+  engine's ``self._jit`` sanitizer chokepoint);
+* a module-level function whose name matches the serving-protocol trace
+  roots (``serve_step*``, ``commit_kv*``, ``forward``, ... — the model
+  hooks the InferenceEngine jits from another file);
+* defined inside, or called (by simple name, intra-file) from, a traced
+  function — computed to a fixpoint.
+
+Suppressions
+------------
+``# ffcheck: disable=RULE[,RULE...] [-- reason]`` on the offending line
+(or alone on the line above it) suppresses by rule code (``FF101``) or
+slug (``host-sync``); ``all`` suppresses every rule.
+``# ffcheck: disable-file=RULE`` anywhere in a file suppresses the rule
+for the whole file. Give a reason after ``--``; the repo guard
+(tests/test_ffcheck.py) keeps the suppression inventory reviewable.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# findings
+
+FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit: ``path:line:col: CODE [slug] message``."""
+
+    path: str
+    line: int
+    col: int
+    rule: str      # rule code, e.g. "FF101"
+    slug: str      # human slug, e.g. "host-sync"
+    message: str
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} [{self.slug}] {self.message}"
+        )
+
+
+class Rule:
+    """Base class for lint rules (one module per rule in
+    ``analysis/rules/``; expose an instance as ``RULE``)."""
+
+    code: str = "FF000"
+    slug: str = "abstract"
+    doc: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str) -> Finding:
+        return Finding(
+            path=ctx.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            rule=self.code,
+            slug=self.slug,
+            message=message,
+        )
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ffcheck:\s*(disable(?:-file)?)\s*=\s*"
+    r"([A-Za-z0-9_\-, ]+?)\s*(?:--\s*(?P<reason>.*))?$"
+)
+
+
+def parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Returns (line -> suppressed rule tokens, file-level tokens).
+
+    A suppression comment alone on its line also guards the next line
+    (the common "comment above the offending statement" layout)."""
+    line_rules: Dict[int, Set[str]] = {}
+    file_rules: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return line_rules, file_rules
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        kind = m.group(1)
+        rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+        if kind == "disable-file":
+            file_rules |= rules
+            continue
+        line_rules.setdefault(tok.start[0], set()).update(rules)
+        if not tok.line[: tok.start[1]].strip():
+            # standalone comment: guard the following statement line
+            line_rules.setdefault(tok.start[0] + 1, set()).update(rules)
+    return line_rules, file_rules
+
+
+def _is_suppressed(
+    f: Finding, line_rules: Dict[int, Set[str]], file_rules: Set[str]
+) -> bool:
+    keys = {f.rule, f.slug, "all"}
+    if keys & file_rules:
+        return True
+    return bool(keys & line_rules.get(f.line, set()))
+
+
+# ---------------------------------------------------------------------------
+# trace-context analysis
+
+# Dotted paths that create a jit-compiled callable from their first arg.
+JIT_PATHS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+# Method names treated as jit wrappers regardless of the receiver — the
+# engine's sanitizer chokepoint (engine._jit) and bare `jit` imports.
+JIT_METHOD_NAMES = {"jit", "pjit", "_jit"}
+
+# Transforms whose function arguments are traced.
+TRANSFORM_PATHS = {
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.jacfwd",
+    "jax.jacrev",
+    "jax.hessian",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.custom_vjp",
+    "jax.custom_jvp",
+    "jax.experimental.shard_map.shard_map",
+}
+
+# Module-level serving-protocol functions the InferenceEngine jits from
+# another file — the cross-file trace roots file-local analysis cannot
+# see. Methods (functions taking ``self``) never match these: protocol
+# hooks are module-level by convention.
+DEFAULT_TRACE_ROOT_PATTERNS = (
+    r"^serve_",
+    r"^commit_kv",
+    r"^reorder_slots",
+    r"^copy_page_kv$",
+    r"^forward$",
+    r"^attention$",
+    r"^block$",
+    r"^apply_rope$",
+    r"^rope_freqs$",
+    r"^sample_tokens$",
+    r"^log_softmax$",
+    r"^next_token_loss$",
+)
+# Protocol-adjacent functions that are EAGER by design (triage dumps run
+# outside jit so they can fetch per-layer activations to host).
+TRACE_ROOT_EXCLUDE = {"serve_debug_activations"}
+
+
+class FileContext:
+    """Parsed file + alias resolution + traced-function analysis, handed
+    to every rule's ``check``."""
+
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        trace_root_patterns: Sequence[str] = DEFAULT_TRACE_ROOT_PATTERNS,
+    ):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source)
+        self._parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parent[child] = node
+        self.aliases = self._collect_aliases()
+        self.functions: List[ast.AST] = [
+            n for n in ast.walk(self.tree) if isinstance(n, FuncDef)
+        ]
+        self._fn_by_name: Dict[str, List[ast.AST]] = {}
+        for fn in self.functions:
+            self._fn_by_name.setdefault(fn.name, []).append(fn)
+        self.jit_calls = self._collect_jit_calls()
+        self.traced: Set[ast.AST] = self._find_traced(trace_root_patterns)
+
+    # -- alias / dotted-path resolution ---------------------------------
+
+    def _collect_aliases(self) -> Dict[str, str]:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: Optional[ast.AST]) -> Optional[str]:
+        """Dotted path of a Name/Attribute with import aliases expanded
+        (``np.asarray`` -> ``numpy.asarray``), or None."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    # -- jit call inventory ----------------------------------------------
+
+    def is_jit_call(self, call: ast.AST) -> bool:
+        if not isinstance(call, ast.Call):
+            return False
+        path = self.resolve(call.func)
+        if path in JIT_PATHS:
+            return True
+        return (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in JIT_METHOD_NAMES
+        )
+
+    def is_partial_jit(self, call: ast.AST) -> bool:
+        """``functools.partial(jax.jit, ...)`` (decorator form)."""
+        if not isinstance(call, ast.Call):
+            return False
+        return (
+            self.resolve(call.func) in ("functools.partial", "partial")
+            and bool(call.args)
+            and self.resolve(call.args[0]) in JIT_PATHS
+        )
+
+    def _collect_jit_calls(self) -> List[dict]:
+        """Every jit creation site: plain calls, bare decorators, and
+        partial-jit decorators, with the target function def resolved
+        when it is a simple local name."""
+        out: List[dict] = []
+        for node in ast.walk(self.tree):
+            if self.is_jit_call(node):
+                target = node.args[0] if node.args else None
+                out.append(
+                    {
+                        "call": node,
+                        "keywords": {k.arg: k.value for k in node.keywords},
+                        "target": target,
+                        "target_fn": self.lookup_function(target),
+                    }
+                )
+        for fn in self.functions:
+            for dec in fn.decorator_list:
+                if self.resolve(dec) in JIT_PATHS:
+                    out.append(
+                        {"call": dec, "keywords": {}, "target": None,
+                         "target_fn": fn}
+                    )
+                elif self.is_partial_jit(dec) or (
+                    isinstance(dec, ast.Call) and self.is_jit_call(dec)
+                    and not dec.args
+                ):
+                    out.append(
+                        {
+                            "call": dec,
+                            "keywords": {k.arg: k.value for k in dec.keywords},
+                            "target": None,
+                            "target_fn": fn,
+                        }
+                    )
+        return out
+
+    def lookup_function(self, node: Optional[ast.AST]) -> Optional[ast.AST]:
+        """A Name argument -> the (single) local def it denotes. None
+        when the name is absent OR ambiguous (several same-named defs) —
+        precision matters for the rules that inspect the target."""
+        cands = self.lookup_all(node)
+        return cands[0] if len(cands) == 1 else None
+
+    def lookup_all(self, node: Optional[ast.AST]) -> List[ast.AST]:
+        """Every local def a Name argument could denote — the safe
+        over-approximation traced-detection wants (a nested ``step``
+        defined per branch and jitted under one name)."""
+        if isinstance(node, ast.Name):
+            return list(self._fn_by_name.get(node.id, []))
+        return []
+
+    @staticmethod
+    def param_names(fn: ast.AST) -> Set[str]:
+        a = fn.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    @staticmethod
+    def positional_params(fn: ast.AST) -> List[str]:
+        a = fn.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    # -- traced-function discovery ---------------------------------------
+
+    def _decorated_traced(self, fn: ast.AST) -> bool:
+        for dec in fn.decorator_list:
+            path = self.resolve(dec)
+            if path in JIT_PATHS or path in TRANSFORM_PATHS:
+                return True
+            if isinstance(dec, ast.Call):
+                if self.is_jit_call(dec) or self.is_partial_jit(dec):
+                    return True
+                if self.resolve(dec.func) in TRANSFORM_PATHS:
+                    return True
+        return False
+
+    def _find_traced(self, patterns: Sequence[str]) -> Set[ast.AST]:
+        traced: Set[ast.AST] = set()
+        pats = [re.compile(p) for p in patterns]
+        for fn in self.functions:
+            if fn.name in TRACE_ROOT_EXCLUDE:
+                continue
+            if self._decorated_traced(fn):
+                traced.add(fn)
+                continue
+            # protocol roots: module-level functions only (methods take
+            # self and are never the cross-file jit targets)
+            if (
+                isinstance(self._parent.get(fn), ast.Module)
+                and "self" not in self.positional_params(fn)[:1]
+                and any(p.search(fn.name) for p in pats)
+            ):
+                traced.add(fn)
+        # functions passed by name to jit/transform calls
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_transform = self.resolve(node.func) in TRANSFORM_PATHS
+            if not (is_transform or self.is_jit_call(node)):
+                continue
+            args = list(node.args) + [k.value for k in node.keywords]
+            for arg in args:
+                traced.update(self.lookup_all(arg))
+        # fixpoint: nested defs + intra-file callees of traced functions
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if fn in traced:
+                    continue
+                anc = self._parent.get(fn)
+                while anc is not None:
+                    if anc in traced:
+                        traced.add(fn)
+                        changed = True
+                        break
+                    anc = self._parent.get(anc)
+            for fn in list(traced):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callees = self.lookup_all(node.func)
+                    if not callees and self.resolve(node.func) in (
+                        "functools.partial", "partial"
+                    ) and node.args:
+                        callees = self.lookup_all(node.args[0])
+                    for callee in callees:
+                        if callee not in traced:
+                            traced.add(callee)
+                            changed = True
+        return traced
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        anc = self._parent.get(node)
+        while anc is not None:
+            if isinstance(anc, FuncDef):
+                return anc
+            anc = self._parent.get(anc)
+        return None
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """Is this node inside the body of a traced function?"""
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def enclosing_traced_function(self, node: ast.AST) -> Optional[ast.AST]:
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced:
+                return fn
+            fn = self.enclosing_function(fn)
+        return None
+
+    def walk_traced(self, types) -> Iterator[ast.AST]:
+        """Every node of the given AST type(s) inside traced code. The
+        traced function's own body only — decorators and parameter
+        defaults evaluate eagerly and are excluded."""
+        seen: Set[int] = set()
+        for fn in self.traced:
+            for stmt in fn.body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, types) and id(node) not in seen:
+                        seen.add(id(node))
+                        yield node
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+def get_rules() -> Tuple[Rule, ...]:
+    from .rules import ALL_RULES
+
+    return ALL_RULES
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence[Rule]] = None,
+    trace_root_patterns: Sequence[str] = DEFAULT_TRACE_ROOT_PATTERNS,
+    with_suppressed: bool = False,
+) -> List[Finding]:
+    """Lint one file's source. Returns findings sorted by position,
+    suppression comments applied (unless ``with_suppressed``)."""
+    rules = tuple(rules) if rules is not None else get_rules()
+    try:
+        ctx = FileContext(path, source, trace_root_patterns)
+    except SyntaxError as e:
+        return [
+            Finding(path, e.lineno or 0, e.offset or 0, "FF000",
+                    "syntax-error", f"file does not parse: {e.msg}")
+        ]
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    if not with_suppressed:
+        line_rules, file_rules = parse_suppressions(source)
+        findings = [
+            f for f in findings
+            if not _is_suppressed(f, line_rules, file_rules)
+        ]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".venv")
+                ]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py"):
+            yield p
+
+
+def lint_paths(
+    paths: Iterable[str],
+    rules: Optional[Sequence[Rule]] = None,
+    with_suppressed: bool = False,
+) -> List[Finding]:
+    """Lint every ``.py`` under the given files/directories."""
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, "r") as fh:
+            src = fh.read()
+        findings.extend(
+            lint_source(src, path, rules=rules, with_suppressed=with_suppressed)
+        )
+    return findings

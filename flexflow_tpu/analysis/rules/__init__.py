@@ -1,0 +1,30 @@
+"""ffcheck rule registry — one module per rule, auto-collected.
+
+Adding a rule is one file: drop ``my_rule.py`` in this package exposing
+a module-level ``RULE`` (an ``analysis.lint.Rule`` instance) and list it
+in ``_RULE_MODULES`` below. The catalog in ``analysis/__init__.py`` and
+``scripts/ffcheck.py --list-rules`` render from the registry.
+"""
+from __future__ import annotations
+
+from . import (
+    host_sync,
+    missing_donation,
+    static_hashability,
+    tracer_control_flow,
+    unordered_iteration,
+    weak_dtype,
+)
+
+_RULE_MODULES = (
+    host_sync,
+    tracer_control_flow,
+    weak_dtype,
+    unordered_iteration,
+    missing_donation,
+    static_hashability,
+)
+
+ALL_RULES = tuple(m.RULE for m in _RULE_MODULES)
+
+__all__ = ["ALL_RULES"]

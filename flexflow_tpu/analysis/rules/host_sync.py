@@ -1,0 +1,89 @@
+"""FF101 host-sync: host↔device synchronization inside jit-traced code.
+
+Inside a traced function every value is an abstract tracer;
+``jax.device_get``/``np.asarray``/``.item()``/``float(tracer)`` either
+raise a ConcretizationTypeError at trace time or — worse, when the value
+happens to be concrete — silently constant-fold host data into the
+compiled program, baking one step's runtime values into every future
+step. In the serving hot path a surviving host sync also serializes the
+dispatch-ahead pipeline: the decode loop stalls on a device round-trip
+per step, the exact failure mode continuous batching exists to avoid.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..lint import FileContext, Finding, Rule
+
+# Dotted calls that force a transfer / concretization.
+HOST_SYNC_PATHS = {
+    "jax.device_get",
+    "jax.device_put",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.copy",
+}
+# Zero-arg methods that force a transfer on an array receiver.
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# Builtin casts that concretize a tracer.
+CAST_BUILTINS = {"float", "int", "bool", "complex"}
+# Parameters that are static configuration, never tracers.
+STATIC_PARAM_NAMES = {"self", "cls", "cfg", "config", "mesh", "serving"}
+
+
+class HostSyncRule(Rule):
+    code = "FF101"
+    slug = "host-sync"
+    doc = (
+        "host-sync call (jax.device_get / np.asarray / .item() / "
+        "float(tracer) / ...) reachable inside jit-traced code"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for call in ctx.walk_traced(ast.Call):
+            path = ctx.resolve(call.func)
+            if path in HOST_SYNC_PATHS:
+                yield self.finding(
+                    ctx, call,
+                    f"call to {path} inside jit-traced code forces a "
+                    "host sync (or constant-folds runtime data into the "
+                    "compiled program)",
+                )
+                continue
+            if (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr in HOST_SYNC_METHODS
+                and not call.args
+            ):
+                yield self.finding(
+                    ctx, call,
+                    f".{call.func.attr}() inside jit-traced code forces "
+                    "a device->host transfer per call",
+                )
+                continue
+            if (
+                isinstance(call.func, ast.Name)
+                and call.func.id in CAST_BUILTINS
+                and len(call.args) == 1
+                and isinstance(call.args[0], ast.Name)
+            ):
+                fn = ctx.enclosing_traced_function(call)
+                if fn is None:
+                    continue
+                arg = call.args[0].id
+                if (
+                    arg in ctx.param_names(fn)
+                    and arg not in STATIC_PARAM_NAMES
+                ):
+                    yield self.finding(
+                        ctx, call,
+                        f"{call.func.id}({arg}) concretizes a traced "
+                        "argument — a ConcretizationTypeError at trace "
+                        "time, or a silent constant-fold if it happens "
+                        "to be static",
+                    )
+
+
+RULE = HostSyncRule()

@@ -1,0 +1,70 @@
+"""FF105 missing-donation: a cache/state buffer threaded through
+``jax.jit`` without ``donate_argnums``.
+
+The serving KV cache (and a training step's optimizer state) flows
+in-and-out of every step. Without donation XLA must preserve the input
+buffer while producing the output — steady-state decode then allocates
+a full cache copy per step, doubling KV HBM and capping concurrency at
+half the budget. Every engine program donates its cache
+(engine._jit(... donate_argnums=...)); this rule keeps new jit sites
+honest.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..lint import FileContext, Finding, Rule
+
+# Parameter names that, by repo convention, are device buffers updated
+# in place per step — the donate-or-copy-per-step set.
+DONATABLE_PARAMS = {"cache", "kv_cache", "opt_state"}
+# Attribute targets (model hooks) that thread the cache by contract.
+CACHE_HOOK_RE = re.compile(
+    r"^(commit_kv(_paged)?|reorder_slots(_paged)?|copy_page_kv|"
+    r"init_kv_cache|serve_step(_paged)?)$"
+)
+DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+class MissingDonationRule(Rule):
+    code = "FF105"
+    slug = "missing-donation"
+    doc = (
+        "jax.jit of a function threading a cache/opt_state buffer "
+        "without donate_argnums — a full buffer copy per step"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for jc in ctx.jit_calls:
+            if DONATE_KWARGS & set(jc["keywords"]):
+                continue
+            fn = jc["target_fn"]
+            if fn is not None:
+                hot = sorted(
+                    set(ctx.positional_params(fn)) & DONATABLE_PARAMS
+                )
+                if hot:
+                    yield self.finding(
+                        ctx, jc["call"],
+                        f"jit of {fn.name}() threads buffer parameter(s) "
+                        f"{', '.join(hot)} without donate_argnums — "
+                        "steady state allocates a full copy per step",
+                    )
+                continue
+            target = jc["target"]
+            if (
+                isinstance(target, ast.Attribute)
+                and CACHE_HOOK_RE.match(target.attr)
+                and not target.attr.startswith("init_")
+            ):
+                yield self.finding(
+                    ctx, jc["call"],
+                    f"jit of cache-threading hook .{target.attr} without "
+                    "donate_argnums — steady state allocates a full "
+                    "cache copy per step",
+                )
+
+
+RULE = MissingDonationRule()

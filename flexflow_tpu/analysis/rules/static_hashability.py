@@ -1,0 +1,125 @@
+"""FF106 static-hashability: unhashable static jit arguments.
+
+``static_argnums``/``static_argnames`` values become part of the jit
+cache key, so they must be hashable AND cheaply equality-comparable. A
+list/dict/set default (or annotation) on a static parameter either
+raises ``ValueError: non-hashable static arguments`` at the first call
+— or, when callers pass tuples sometimes and lists other times, keys a
+fresh compile per call. Statics should be scalars, strings, or tuples.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from ..lint import FileContext, Finding, Rule
+
+UNHASHABLE_ANNOTATIONS = {"list", "dict", "set", "List", "Dict", "Set",
+                          "bytearray"}
+
+
+def _static_params(fn, keywords) -> List[Tuple[str, Optional[ast.AST], Optional[ast.AST]]]:
+    """(name, default, annotation) for each static parameter we can
+    resolve from static_argnums/static_argnames literals."""
+    pos = fn.args.posonlyargs + fn.args.args
+    names = [p.arg for p in pos]
+    # defaults align to the TAIL of the positional list
+    defaults: dict = {}
+    for p, d in zip(pos[len(pos) - len(fn.args.defaults):], fn.args.defaults):
+        defaults[p.arg] = d
+    for p, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if d is not None:
+            defaults[p.arg] = d
+    annotations = {p.arg: p.annotation for p in pos + fn.args.kwonlyargs}
+    picked: List[str] = []
+    argnums = keywords.get("static_argnums")
+    if argnums is not None:
+        nums = []
+        if isinstance(argnums, ast.Constant) and isinstance(argnums.value, int):
+            nums = [argnums.value]
+        elif isinstance(argnums, (ast.Tuple, ast.List)):
+            nums = [
+                e.value for e in argnums.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ]
+        picked += [names[n] for n in nums if 0 <= n < len(names)]
+    argnames = keywords.get("static_argnames")
+    if argnames is not None:
+        if isinstance(argnames, ast.Constant) and isinstance(argnames.value, str):
+            picked.append(argnames.value)
+        elif isinstance(argnames, (ast.Tuple, ast.List)):
+            picked += [
+                e.value for e in argnames.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+    return [
+        (n, defaults.get(n), annotations.get(n))
+        for n in picked
+        if n in set(names) | {p.arg for p in fn.args.kwonlyargs}
+    ]
+
+
+def _unhashable_expr(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("list", "dict", "set", "bytearray"):
+            return node.func.id
+    return None
+
+
+def _unhashable_annotation(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    base = node.value if isinstance(node, ast.Subscript) else node
+    if isinstance(base, ast.Name) and base.id in UNHASHABLE_ANNOTATIONS:
+        return base.id
+    if isinstance(base, ast.Attribute) and base.attr in UNHASHABLE_ANNOTATIONS:
+        return base.attr
+    return None
+
+
+class StaticHashabilityRule(Rule):
+    code = "FF106"
+    slug = "static-hashability"
+    doc = (
+        "static_argnums/static_argnames parameter whose default or "
+        "annotation is unhashable (list/dict/set) — jit raises, or "
+        "retraces per call"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for jc in ctx.jit_calls:
+            fn = jc["target_fn"]
+            if fn is None:
+                continue
+            kw = jc["keywords"]
+            if not ({"static_argnums", "static_argnames"} & set(kw)):
+                continue
+            for name, default, annotation in _static_params(fn, kw):
+                bad = _unhashable_expr(default)
+                if bad:
+                    yield self.finding(
+                        ctx, jc["call"],
+                        f"static argument {name!r} of {fn.name}() has an "
+                        f"unhashable {bad} default — jit will raise "
+                        "(or, with mixed caller types, retrace per call)",
+                    )
+                    continue
+                bad = _unhashable_annotation(annotation)
+                if bad:
+                    yield self.finding(
+                        ctx, jc["call"],
+                        f"static argument {name!r} of {fn.name}() is "
+                        f"annotated {bad} — statics must be hashable "
+                        "(use a tuple)",
+                    )
+
+
+RULE = StaticHashabilityRule()

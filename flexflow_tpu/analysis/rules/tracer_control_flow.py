@@ -1,0 +1,58 @@
+"""FF102 tracer-control-flow: Python branching on traced array values.
+
+``if jnp.any(x):`` inside a traced function is not a device-side branch
+— it concretizes the array at trace time (ConcretizationTypeError), or,
+when tracing happens to succeed, bakes ONE side of the branch into the
+compiled program forever. Device-dependent control flow belongs in
+``jnp.where``/``jax.lax.cond``/``jax.lax.switch``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..lint import FileContext, Finding, Rule
+
+# A call into these namespaces produces a traced array; branching on it
+# in Python is the hazard.
+ARRAY_NAMESPACES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.")
+
+
+class TracerControlFlowRule(Rule):
+    code = "FF102"
+    slug = "tracer-control-flow"
+    doc = (
+        "Python if/while/assert on a value computed by jnp/jax.lax "
+        "inside jit-traced code"
+    )
+
+    def _array_call(self, ctx: FileContext, test: ast.AST) -> Optional[str]:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Call):
+                path = ctx.resolve(node.func)
+                if path and (
+                    path.startswith(ARRAY_NAMESPACES)
+                    or path in ("jax.numpy", "jax.lax")
+                ):
+                    return path
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.walk_traced((ast.If, ast.While, ast.Assert)):
+            test = node.test
+            path = self._array_call(ctx, test)
+            if path is None:
+                continue
+            kind = {
+                ast.If: "if", ast.While: "while", ast.Assert: "assert"
+            }[type(node)]
+            yield self.finding(
+                ctx, node,
+                f"Python `{kind}` on the result of {path} inside "
+                "jit-traced code — concretization error at trace time "
+                "or one branch baked into the compiled program; use "
+                "jnp.where / jax.lax.cond",
+            )
+
+
+RULE = TracerControlFlowRule()

@@ -1,0 +1,61 @@
+"""FF104 unordered-iteration: iterating a set inside traced code.
+
+Trace order is program order: a ``for x in {...}`` loop inside a traced
+function linearizes its iterations into the compiled program in
+whatever order the set yields — which for int/str sets depends on hash
+seeding and insertion history. Two processes tracing the "same" step
+can compile different programs (non-deterministic numerics,
+cache-key-identical but result-divergent executables). Iterate sorted
+containers (or lists/dicts, which preserve insertion order) in trace
+code.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..lint import FileContext, Finding, Rule
+
+UNORDERED_CALLS = {"set", "frozenset", "vars", "globals", "locals", "dir"}
+
+
+class UnorderedIterationRule(Rule):
+    code = "FF104"
+    slug = "unordered-iteration"
+    doc = (
+        "iteration over a set/frozenset (or vars()/globals()) inside "
+        "jit-traced code — trace order, and so the compiled program, "
+        "becomes nondeterministic"
+    )
+
+    def _unordered(self, ctx: FileContext, it: ast.AST) -> Optional[str]:
+        if isinstance(it, (ast.Set, ast.SetComp)):
+            return "a set literal"
+        if isinstance(it, ast.Call):
+            path = ctx.resolve(it.func)
+            if path in UNORDERED_CALLS:
+                return f"{path}()"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.walk_traced(
+            (ast.For, ast.ListComp, ast.SetComp, ast.DictComp,
+             ast.GeneratorExp)
+        ):
+            iters = (
+                [node.iter] if isinstance(node, ast.For)
+                else [g.iter for g in node.generators]
+            )
+            for it in iters:
+                what = self._unordered(ctx, it)
+                if what:
+                    yield self.finding(
+                        ctx, it,
+                        f"iterating {what} inside jit-traced code makes "
+                        "the traced program depend on hash order — "
+                        "sort it (or use a list/dict, which preserve "
+                        "insertion order)",
+                    )
+
+
+RULE = UnorderedIterationRule()

@@ -1,0 +1,62 @@
+"""FF103 weak-dtype: ``jnp.asarray``/``jnp.array`` without an explicit
+dtype.
+
+``jnp.asarray`` of host data inherits whatever dtype the host side
+happened to produce — and for Python scalars/lists the result is
+*weak-typed*, which participates in jit cache keys. One call site that
+sometimes receives ``np.int32`` and sometimes a Python list retraces
+the step program on every flip; with x64 enabled the same site silently
+doubles every buffer. On the serving hot path a single such retrace is
+a 100x step-latency spike. Pinning ``dtype=`` makes the abstract
+signature — and therefore the compile cache key — independent of the
+caller's host types.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..lint import FileContext, Finding, Rule
+
+CONVERTERS = {"jax.numpy.asarray", "jax.numpy.array"}
+
+
+class WeakDtypeRule(Rule):
+    code = "FF103"
+    slug = "weak-dtype"
+    doc = (
+        "jnp.asarray/jnp.array without an explicit dtype — weak-type "
+        "promotion (or a host-side type flip) can key an XLA retrace"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path = ctx.resolve(node.func)
+            if path not in CONVERTERS:
+                continue
+            if len(node.args) >= 2:  # positional dtype
+                continue
+            if any(k.arg == "dtype" for k in node.keywords):
+                continue
+            if len(node.args) != 1:
+                continue
+            arg = node.args[0]
+            # converting a value that is already a jax expression keeps
+            # its (strong) dtype — no weak-type hazard
+            if isinstance(arg, ast.Call):
+                apath = ctx.resolve(arg.func)
+                if apath and apath.startswith("jax."):
+                    continue
+            name = path.rsplit(".", 1)[-1]
+            yield self.finding(
+                ctx, node,
+                f"jnp.{name}(...) without an explicit dtype — the "
+                "result's (possibly weak) dtype follows the caller's "
+                "host types and can key a retrace of every jitted "
+                "consumer; pass dtype=",
+            )
+
+
+RULE = WeakDtypeRule()

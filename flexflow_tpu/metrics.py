@@ -94,6 +94,14 @@ class SchedulerStats:
     prefix_inserts: int = 0
     prefix_evictions: int = 0
     prefix_cows: int = 0
+    # Retrace sentinel (analysis/retrace.py, wired when the engine runs
+    # with ServingConfig.sanitizers=("retrace",)): XLA compiles of step
+    # programs observed at the engine's jit chokepoint, and how many of
+    # them were RE-compiles of an already-compiled step key — the
+    # steady-state perf hazard. Healthy serving: compiles settles after
+    # warmup and retraces stays 0.
+    compiles: int = 0
+    retraces: int = 0
 
     def record_step(
         self,
@@ -157,6 +165,8 @@ class SchedulerStats:
             "prefix_inserts": self.prefix_inserts,
             "prefix_evictions": self.prefix_evictions,
             "prefix_cows": self.prefix_cows,
+            "compiles": self.compiles,
+            "retraces": self.retraces,
         }
 
     def report(self) -> str:
@@ -171,7 +181,8 @@ class SchedulerStats:
             f"preempt={s['preemptions']} failed={s['failed']} "
             f"pfx_hit={s['prefix_hits']}/{s['prefix_hits'] + s['prefix_misses']}"
             f" pfx_toks={s['prefix_hit_tokens']} "
-            f"pfx_evict={s['prefix_evictions']} pfx_cow={s['prefix_cows']}"
+            f"pfx_evict={s['prefix_evictions']} pfx_cow={s['prefix_cows']} "
+            f"compiles={s['compiles']} retraces={s['retraces']}"
         )
 
 

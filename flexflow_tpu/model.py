@@ -1393,9 +1393,16 @@ class FFModel:
             "call compile() first"
         )
         cur = self.opt_state["lr"]
-        self.opt_state["lr"] = jax.device_put(
-            jnp.asarray(lr, jnp.float32), cur.sharding
-        )
+        new = jnp.asarray(lr, jnp.float32)
+        if isinstance(cur.sharding, NamedSharding):
+            new = jax.device_put(new, cur.sharding)
+        # else: before the first train step the scalar is still the
+        # UNCOMMITTED device-0 array compile() made; committing the
+        # replacement would pin it there and the next train_step fails
+        # with mixed device sets (params already live on the mesh — the
+        # LearningRateScheduler-before-first-epoch case). Leave it
+        # uncommitted and let jit place it with everything else.
+        self.opt_state["lr"] = new
 
     def get_weights(self, layer_name: str):
         return jax.device_get(self.params[layer_name])

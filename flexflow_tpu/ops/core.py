@@ -113,7 +113,8 @@ class ConstantOp(OpDef):
         ).reshape(tuple(attrs["shape"]))
 
     def forward(self, weights, inputs, attrs, ctx):
-        return [jnp.asarray(self._value(attrs))]
+        val = self._value(attrs)
+        return [jnp.asarray(val, dtype=val.dtype)]
 
     def flops(self, in_specs, attrs):
         return 0

@@ -39,6 +39,28 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
+def _shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking OFF, across jax versions
+    (``check_vma`` on new jax, ``check_rep`` on 0.4.x — same compat
+    shim as parallel/pipeline._partial_shard_map). The checker in jax
+    0.4.37 mis-types the scan carry when these collectives run inside a
+    layer scan over a mesh with unrelated (expert/pipe) axes: the carry
+    enters untyped (None) and leaves typed replicated-over-the-unused-
+    axes, which the scan fixpoint rejects. The attention math is an
+    exact layout transform (tested against the dense reference), so
+    disabling the static replication check is sound."""
+    try:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
 def _online_block(q, k, v, o, m, l, qpos, kpos, scale, causal, kv_len=None):
     """One K/V block of online-softmax attention.
 
@@ -133,7 +155,7 @@ def ring_attention(
     if pad:
         q, k, v = (jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0))) for t in (q, k, v))
     qspec = P(DATA_AXIS, SEQ_AXIS, h_axis, None)
-    fn = shard_map(
+    fn = _shard_map_unchecked(
         functools.partial(
             _ring_attention_local, axis_name=SEQ_AXIS, causal=causal, scale=scale,
             kv_len=S if pad else None,
@@ -210,7 +232,7 @@ def ulysses_attention(
     if pad:
         q, k, v = (jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0))) for t in (q, k, v))
     spec = P(DATA_AXIS, SEQ_AXIS, h_axis, None)
-    fn = shard_map(
+    fn = _shard_map_unchecked(
         functools.partial(
             _ulysses_local, axis_name=SEQ_AXIS, causal=causal, scale=scale,
             kv_len=S if pad else None,

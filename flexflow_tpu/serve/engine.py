@@ -97,6 +97,17 @@ class ServingConfig:
     # transcript); "prefill" — the prompt alone, as soon as its last
     # chunk is dispatched (concurrent same-prompt requests hit sooner).
     cache_policy: str = "complete"
+    # Runtime hazard sanitizers (flexflow_tpu/analysis/): "retrace" — a
+    # strict RetraceGuard on the engine's jit chokepoint that raises on
+    # any step recompile after its first compile (the shape/dtype-drift
+    # perf-bug class caught at test time instead of as a 100x TPU
+    # slowdown); "retrace-warn" — record + FF_LOG=serve=debug log only;
+    # "donation" — poison donated cache pytrees after every dispatch so
+    # use-after-donate (the PR-2 page-corruption class) raises loudly.
+    # Off by default (zero steady-state overhead); tests and bench flip
+    # them on, and FF_SANITIZERS=retrace,donation enables them from the
+    # environment without touching code.
+    sanitizers: Tuple[str, ...] = ()
 
     @property
     def cache_len(self) -> int:
@@ -166,6 +177,34 @@ class InferenceEngine:
         # tagged tuple for fused variants (("mixed_fused", chunk, ...)).
         self._steps: Dict[Any, Callable] = {}
         self._commit: Optional[Callable] = None
+        # Hazard sanitizers (flexflow_tpu/analysis — see
+        # ServingConfig.sanitizers): every step program is created
+        # through self._jit, which the RetraceGuard hooks; every donated
+        # dispatch hands the old cache to self._poison_donated.
+        self.retrace_guard = None
+        self.donation_sanitizer = None
+        sanitizers = self.serving.sanitizers
+        if isinstance(sanitizers, str):
+            sanitizers = tuple(
+                s.strip() for s in sanitizers.split(",") if s.strip()
+            )
+        if not sanitizers:
+            env = os.environ.get("FF_SANITIZERS", "")
+            sanitizers = tuple(s.strip() for s in env.split(",") if s.strip())
+        for name in sanitizers:
+            if name in ("retrace", "retrace-warn"):
+                from ..analysis.retrace import RetraceGuard
+
+                self.retrace_guard = RetraceGuard(strict=(name == "retrace"))
+            elif name == "donation":
+                from ..analysis.donation import DonationSanitizer
+
+                self.donation_sanitizer = DonationSanitizer()
+            else:
+                raise ValueError(
+                    f"unknown sanitizer {name!r} (expected 'retrace', "
+                    "'retrace-warn' or 'donation')"
+                )
         self.paged = self.serving.kv_layout == "paged"
         if self.serving.kv_layout not in ("dense", "paged"):
             raise ValueError(
@@ -262,7 +301,7 @@ class InferenceEngine:
         cached = getattr(self, "_table_cache", None)
         if cached is not None and cached[0] == self.pager.version:
             return cached[1]
-        dev = jnp.asarray(self.pager.table)
+        dev = jnp.asarray(self.pager.table, dtype=jnp.int32)
         self._table_cache = (self.pager.version, dev)
         return dev
 
@@ -294,6 +333,31 @@ class InferenceEngine:
     @property
     def num_slots(self) -> int:
         return self.serving.max_requests_per_batch
+
+    # ------------------------------------------------------------------
+    # sanitizer chokepoints (flexflow_tpu/analysis)
+
+    def _jit(self, fn: Callable, *, key: Any,
+             donate_argnums: Tuple[int, ...] = ()) -> Callable:
+        """Every step program (``_steps``/``_commit``) is compiled
+        through this chokepoint so the retrace sentinel can observe it:
+        the guard wraps ``fn`` to record each trace — which is exactly
+        one XLA compile — under ``key`` and, in strict mode, raises on
+        any recompile of a known key (analysis/retrace.py)."""
+        if self.retrace_guard is not None:
+            fn = self.retrace_guard.instrument(fn, key=key)
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    def _poison_donated(self, donated: Any, key: Any) -> None:
+        """Donation-sanitizer hook: after a donated dispatch the OLD
+        cache pytree is poisoned (leaves deleted, entries swapped for
+        DeletedBufferProxy) so any lingering host-side reference raises
+        UseAfterDonateError at the faulty read instead of silently
+        reading donated memory (analysis/donation.py)."""
+        if self.donation_sanitizer is not None and donated is not self.cache:
+            self.donation_sanitizer.poison(
+                donated, context=f"engine step {key!r}"
+            )
 
     # ------------------------------------------------------------------
 
@@ -331,7 +395,7 @@ class InferenceEngine:
                     return fn(params, cache, tokens, positions, logits_idx,
                               mask, cpos)
 
-            self._steps[key] = jax.jit(step, donate_argnums=(1,))
+            self._steps[key] = self._jit(step, key=key, donate_argnums=(1,))
         return self._steps[key]
 
     def _get_mixed_step(self, chunk: int, with_logits: bool = False):
@@ -377,7 +441,9 @@ class InferenceEngine:
                     return toks, logits, cache
                 return toks, cache
 
-            self._steps[key_id] = jax.jit(step, donate_argnums=(1,))
+            self._steps[key_id] = self._jit(
+                step, key=key_id, donate_argnums=(1,)
+            )
         return self._steps[key_id]
 
     def run_mixed(self, last_tokens, host_tokens, use_last, positions,
@@ -391,27 +457,38 @@ class InferenceEngine:
         if self.paged:
             kw["page_table"] = self.page_table_device()
         host_tokens = np.asarray(host_tokens)
+        # every jit-call argument converts with a PINNED dtype: the
+        # abstract signature — and so the compile-cache key — must not
+        # follow whatever host types the scheduler happened to produce
+        # (weak-type/x64 retrace hazard, ffcheck FF103)
+        donated = self.cache
         with _set_mesh(self.mesh):
             step = self._get_mixed_step(host_tokens.shape[1], with_logits)
             out = step(
                 self.params,
                 self.cache,
                 last_tokens,
-                jnp.asarray(host_tokens),
-                jnp.asarray(use_last),
-                jnp.asarray(positions),
-                jnp.asarray(logits_idx),
+                jnp.asarray(host_tokens, dtype=jnp.int32),
+                jnp.asarray(use_last, dtype=jnp.bool_),
+                jnp.asarray(positions, dtype=jnp.int32),
+                jnp.asarray(logits_idx, dtype=jnp.int32),
                 key,
-                jnp.asarray(greedy),
-                jnp.asarray(temperature),
-                jnp.asarray(topp),
-                jnp.asarray(topk),
+                jnp.asarray(greedy, dtype=jnp.bool_),
+                jnp.asarray(temperature, dtype=jnp.float32),
+                jnp.asarray(topp, dtype=jnp.float32),
+                jnp.asarray(topk, dtype=jnp.int32),
                 **kw,
             )
         if with_logits:
             toks, logits, self.cache = out
+            self._poison_donated(
+                donated, ("mixed_fused", host_tokens.shape[1], with_logits)
+            )
             return toks, logits
         toks, self.cache = out
+        self._poison_donated(
+            donated, ("mixed_fused", host_tokens.shape[1], with_logits)
+        )
         return toks
 
     def run_decode(self, last_tokens, host_tokens, use_last, positions,
@@ -505,7 +582,9 @@ class InferenceEngine:
                 )
                 return toks, parents, logps, cache  # each (D, R, W)
 
-            self._steps[key_id] = jax.jit(speculate, donate_argnums=(1,))
+            self._steps[key_id] = self._jit(
+                speculate, key=key_id, donate_argnums=(1,)
+            )
         return self._steps[key_id]
 
     def run_speculate(self, root_tokens, prefix, active, W: int, D: int):
@@ -515,6 +594,7 @@ class InferenceEngine:
         kw = {}
         if self.paged:
             kw["page_table"] = self.page_table_device()
+        donated = self.cache
         with _set_mesh(self.mesh):
             step = self._get_speculate(W, D)
             toks, parents, logps, self.cache = step(
@@ -522,9 +602,10 @@ class InferenceEngine:
                 self.cache,
                 jnp.asarray(root_tokens, jnp.int32),
                 jnp.asarray(prefix, jnp.int32),
-                jnp.asarray(active),
+                jnp.asarray(active, dtype=jnp.bool_),
                 **kw,
             )
+        self._poison_donated(donated, ("speculate", W, D))
         return toks, parents, logps
 
     def _dump_debug(self, bc: BatchConfig):
@@ -565,10 +646,11 @@ class InferenceEngine:
             kw["page_table"] = self.page_table_device()
             kw["cache_len"] = self.serving.cache_len
         acts = fn(
-            self.params, self.cache, jnp.asarray(bc.tokens),
-            jnp.asarray(bc.positions),
-            jnp.asarray(bc.mask) if bc.mask is not None else None,
-            jnp.asarray(bc.cache_positions)
+            self.params, self.cache, jnp.asarray(bc.tokens, dtype=jnp.int32),
+            jnp.asarray(bc.positions, dtype=jnp.int32),
+            jnp.asarray(bc.mask, dtype=jnp.bool_)
+            if bc.mask is not None else None,
+            jnp.asarray(bc.cache_positions, dtype=jnp.int32)
             if bc.cache_positions is not None else None,
             **kw,
         )
@@ -592,11 +674,12 @@ class InferenceEngine:
             with _set_mesh(self.mesh):
                 self._dump_debug(bc)
         args = (
-            jnp.asarray(bc.tokens),
-            jnp.asarray(bc.positions),
-            jnp.asarray(bc.logits_idx),
-            jnp.asarray(bc.mask) if bc.mask is not None else None,
-            jnp.asarray(bc.cache_positions)
+            jnp.asarray(bc.tokens, dtype=jnp.int32),
+            jnp.asarray(bc.positions, dtype=jnp.int32),
+            jnp.asarray(bc.logits_idx, dtype=jnp.int32),
+            jnp.asarray(bc.mask, dtype=jnp.bool_)
+            if bc.mask is not None else None,
+            jnp.asarray(bc.cache_positions, dtype=jnp.int32)
             if bc.cache_positions is not None
             else None,
         )
@@ -605,9 +688,13 @@ class InferenceEngine:
             # shares one BatchConfig across engines whose pools differ);
             # bc.page_table is carried as host-side metadata
             args = args + (self.page_table_device(),)
+        donated = self.cache
         with _set_mesh(self.mesh):
             step = self._get_step(bc.chunk, all_logits, bc.mask is not None)
             logits, self.cache = step(self.params, self.cache, *args)
+        self._poison_donated(
+            donated, (bc.chunk, all_logits, bc.mask is not None)
+        )
         return logits
 
     def copy_page(self, src: int, dst: int):
@@ -617,15 +704,18 @@ class InferenceEngine:
         private copy first). One jitted program, page ids traced — the
         compile is paid once."""
         if "copy_page" not in self._steps:
-            self._steps["copy_page"] = jax.jit(
-                self.model.copy_page_kv, donate_argnums=(0,)
+            self._steps["copy_page"] = self._jit(
+                self.model.copy_page_kv, key="copy_page",
+                donate_argnums=(0,),
             )
+        donated = self.cache
         with _set_mesh(self.mesh):
             self.cache = self._steps["copy_page"](
                 self.cache,
                 jnp.asarray(src, jnp.int32),
                 jnp.asarray(dst, jnp.int32),
             )
+        self._poison_donated(donated, "copy_page")
 
     def reorder(self, src_slots: np.ndarray):
         """Slot permutation/gather of the whole cache (beam search
@@ -634,13 +724,16 @@ class InferenceEngine:
         through the table (model.reorder_slots_paged)."""
         if "reorder" not in self._steps:
             if self.paged:
-                self._steps["reorder"] = jax.jit(
-                    self.model.reorder_slots_paged, donate_argnums=(0,)
+                self._steps["reorder"] = self._jit(
+                    self.model.reorder_slots_paged, key="reorder",
+                    donate_argnums=(0,),
                 )
             else:
-                self._steps["reorder"] = jax.jit(
-                    self.model.reorder_slots, donate_argnums=(0,)
+                self._steps["reorder"] = self._jit(
+                    self.model.reorder_slots, key="reorder",
+                    donate_argnums=(0,),
                 )
+        donated = self.cache
         with _set_mesh(self.mesh):
             if self.paged:
                 self.cache = self._steps["reorder"](
@@ -651,6 +744,7 @@ class InferenceEngine:
                 self.cache = self._steps["reorder"](
                     self.cache, jnp.asarray(src_slots, jnp.int32)
                 )
+        self._poison_donated(donated, "reorder")
 
     def commit(self, src: np.ndarray, dst: np.ndarray):
         """Move accepted speculative cache lines to committed positions
@@ -658,17 +752,21 @@ class InferenceEngine:
         if self._commit is None:
             fn = (self.model.commit_kv_paged if self.paged
                   else self.model.commit_kv)
-            self._commit = jax.jit(fn, donate_argnums=(0,))
+            self._commit = self._jit(fn, key="commit", donate_argnums=(0,))
+        donated = self.cache
         with _set_mesh(self.mesh):
             if self.paged:
                 self.cache = self._commit(
                     self.cache, self.page_table_device(),
-                    jnp.asarray(src), jnp.asarray(dst),
+                    jnp.asarray(src, dtype=jnp.int32),
+                    jnp.asarray(dst, dtype=jnp.int32),
                 )
             else:
                 self.cache = self._commit(
-                    self.cache, jnp.asarray(src), jnp.asarray(dst)
+                    self.cache, jnp.asarray(src, dtype=jnp.int32),
+                    jnp.asarray(dst, dtype=jnp.int32),
                 )
+        self._poison_donated(donated, "commit")
 
     def reset(self):
         """Drop all cached sequences (fresh KV cache; paged: fresh

@@ -148,6 +148,15 @@ class RequestManager:
         self._prev_dispatch_slots: set = set()
         self.stats = SchedulerStats()
         self._log = get_logger("serve")
+        # Retrace sentinel telemetry (analysis/retrace.py): compile
+        # events recorded at the engine's jit chokepoint surface in the
+        # scheduler stats (FF_LOG=serve=debug + bench reports). The
+        # callable indirection survives bench-style stat swaps
+        # (rm.stats = SchedulerStats()) the same way the prefix cache's
+        # stats hook does.
+        guard = getattr(engine, "retrace_guard", None)
+        if guard is not None:
+            guard.stats_cb = lambda: self.stats
         # Automatic prefix caching (paged layout only — on dense,
         # prefix_caching=True is a documented passthrough: there are no
         # pages to share). The radix tree owns one reference per cached
@@ -591,10 +600,10 @@ class RequestManager:
         toks = sample_tokens(
             logits,
             sub,
-            greedy=jnp.asarray(greedy),
-            temperature=jnp.asarray(temp),
-            topp=jnp.asarray(topp),
-            topk_arr=jnp.asarray(topk),
+            greedy=jnp.asarray(greedy, dtype=jnp.bool_),
+            temperature=jnp.asarray(temp, dtype=jnp.float32),
+            topp=jnp.asarray(topp, dtype=jnp.float32),
+            topk_arr=jnp.asarray(topk, dtype=jnp.int32),
         )
         return np.asarray(jax.device_get(toks))
 

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""ffcheck — static JAX/TPU hazard lint over the package (CI-style).
+
+Runs the ``flexflow_tpu.analysis`` rule set (host-sync in traced code,
+tracer control flow, weak-dtype ``jnp.asarray``, unordered iteration,
+missing donation, unhashable statics — see
+``flexflow_tpu/analysis/__init__.py`` for the catalog) and exits
+non-zero on any unsuppressed finding. Wired into tier-1 via
+``tests/test_ffcheck.py`` — the repo must stay at zero findings modulo
+``# ffcheck: disable=RULE -- reason`` suppressions.
+
+Usage::
+
+    python scripts/ffcheck.py                    # lint flexflow_tpu/
+    python scripts/ffcheck.py serve engine.py    # specific paths
+    python scripts/ffcheck.py --diff main        # only files changed vs ref
+    python scripts/ffcheck.py --list-rules
+    python scripts/ffcheck.py --show-suppressed  # include suppressed hits
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_TARGET = os.path.join(REPO_ROOT, "flexflow_tpu")
+
+
+def changed_files(base: str) -> List[str]:
+    """Python files changed vs ``base`` (committed + staged + worktree),
+    for fast local iteration: ``ffcheck.py --diff main``. Scoped to the
+    guarded package (``flexflow_tpu/``) so the exit code agrees with
+    the tier-1 repo guard — pass explicit paths to lint anything else."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", base, "--", "flexflow_tpu/*.py",
+         "flexflow_tpu/**/*.py"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=True,
+    ).stdout
+    files = []
+    for line in out.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        path = os.path.join(REPO_ROOT, line)
+        if os.path.exists(path):  # deleted files have nothing to lint
+            files.append(path)
+    return files
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: flexflow_tpu/)",
+    )
+    ap.add_argument(
+        "--diff", metavar="BASE",
+        help="lint only .py files changed vs this git ref",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    ap.add_argument(
+        "--show-suppressed", action="store_true",
+        help="report findings even where a suppression comment applies",
+    )
+    args = ap.parse_args(argv)
+
+    from flexflow_tpu.analysis import get_rules, lint_paths
+
+    if args.list_rules:
+        for rule in get_rules():
+            print(f"{rule.code}  {rule.slug:22s} {rule.doc}")
+        return 0
+
+    if args.diff:
+        paths = changed_files(args.diff)
+        if not paths:
+            print(f"ffcheck: no .py files changed vs {args.diff}")
+            return 0
+    else:
+        paths = args.paths or [DEFAULT_TARGET]
+
+    findings = lint_paths(paths, with_suppressed=args.show_suppressed)
+    for f in findings:
+        print(f.format())
+    nfiles = len(list(__import__(
+        "flexflow_tpu.analysis.lint", fromlist=["iter_py_files"]
+    ).iter_py_files(paths)))
+    if findings:
+        print(f"ffcheck: {len(findings)} finding(s) in {nfiles} file(s)")
+        return 1
+    print(f"ffcheck: clean ({nfiles} file(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,16 @@ def test_cli_train():
 
 
 def test_cli_serve_spec_reference_style_flags(tmp_path):
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # the tp2×pp2 serve mesh puts TP inside the partial-manual
+        # pipeline shard_map, whose PartitionId the XLA:CPU SPMD
+        # partitioner rejects as UNIMPLEMENTED (same limitation as
+        # test_serve_parallel[tp2pp2]); the flag PARSING path is still
+        # covered by the other CLI tests. TPU compiles this layout.
+        pytest.skip("XLA:CPU SPMD partitioner lacks PartitionId support "
+                    "for TP-inside-pipeline shard_map — TPU-only layout")
     r = _run([
         "serve", "--spec", "--max-new-tokens", "8",
         "-tensor-parallelism-degree", "2",

@@ -1,0 +1,398 @@
+"""Tier-1 wiring of scripts/ffcheck.py + unit tests for the lint rules.
+
+The repo-wide guard is the same pattern as tests/test_family_reexports:
+``flexflow_tpu/`` must lint clean (zero unsuppressed findings) so a new
+JAX/TPU hazard — a host sync sneaking into a traced function, a weak
+``jnp.asarray`` at a jit boundary, a cache threaded through jit without
+donation — fails CI at the PR that introduces it instead of shipping as
+a silent 100x TPU slowdown.
+"""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.analysis import get_rules, lint_paths, lint_source  # noqa: E402
+from flexflow_tpu.analysis.lint import (  # noqa: E402
+    FileContext,
+    parse_suppressions,
+)
+
+
+def _load_ffcheck():
+    path = os.path.join(REPO, "scripts", "ffcheck.py")
+    spec = importlib.util.spec_from_file_location("ffcheck", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _codes(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# the CI-style guard: the package must stay clean
+
+
+def test_package_lints_clean():
+    findings = lint_paths([os.path.join(REPO, "flexflow_tpu")])
+    assert not findings, (
+        "new ffcheck findings (fix them, or suppress with a reason: "
+        "`# ffcheck: disable=RULE -- why`):\n"
+        + "\n".join(f.format() for f in findings)
+    )
+
+
+def test_ffcheck_script_exits_zero():
+    mod = _load_ffcheck()
+    assert mod.main([]) == 0
+
+
+def test_ffcheck_list_rules():
+    mod = _load_ffcheck()
+    assert mod.main(["--list-rules"]) == 0
+    # the catalog in analysis/__init__ must cover every registered rule
+    import flexflow_tpu.analysis as analysis
+
+    for rule in get_rules():
+        assert rule.code in analysis.__doc__, (
+            f"rule {rule.code} missing from the analysis/__init__.py "
+            "rule catalog"
+        )
+        assert rule.slug in analysis.__doc__
+
+
+def test_ffcheck_diff_mode(tmp_path):
+    """--diff lints only files changed vs a base ref."""
+    mod = _load_ffcheck()
+    # vs HEAD there may be changes or not — the call must succeed either way
+    rc = mod.main(["--diff", "HEAD"])
+    assert rc in (0, 1)
+    files = mod.changed_files("HEAD")
+    assert isinstance(files, list)
+    for f in files:
+        assert f.endswith(".py") and os.path.exists(f)
+
+
+# ---------------------------------------------------------------------------
+# FF101 host-sync
+
+
+def test_host_sync_in_jitted_function():
+    src = (
+        "import jax\nimport numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    assert _codes(lint_source(src)) == ["FF101"]
+
+
+def test_host_sync_item_and_device_get():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = x.item()\n"
+        "    return jax.device_get(y)\n"
+    )
+    assert _codes(lint_source(src)) == ["FF101", "FF101"]
+
+
+def test_host_sync_float_cast_of_traced_param():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, cfg):\n"
+        "    return float(x) + float(cfg)\n"
+    )
+    # cfg is a conventional static — only float(x) is flagged
+    assert _codes(lint_source(src)) == ["FF101"]
+
+
+def test_host_sync_via_intra_file_call_graph():
+    src = (
+        "import jax\nimport numpy as np\n"
+        "def helper(q):\n"
+        "    return np.asarray(q)\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return helper(x)\n"
+    )
+    assert _codes(lint_source(src)) == ["FF101"]
+
+
+def test_host_sync_ok_outside_trace():
+    src = (
+        "import numpy as np\n"
+        "def host_fetch(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_serve_protocol_functions_are_trace_roots():
+    src = (
+        "import numpy as np\n"
+        "def serve_step(params, cache, tokens):\n"
+        "    return np.asarray(tokens)\n"
+    )
+    assert _codes(lint_source(src)) == ["FF101"]
+    # ...but serve_debug_activations is eager by design
+    src2 = (
+        "import numpy as np\n"
+        "def serve_debug_activations(params, cache, tokens):\n"
+        "    return np.asarray(tokens)\n"
+    )
+    assert lint_source(src2) == []
+
+
+def test_engine_jit_chokepoint_marks_traced():
+    """Functions handed to the engine's self._jit sanitizer chokepoint
+    count as traced — the refactor must not blind the lint."""
+    src = (
+        "import numpy as np\n"
+        "class E:\n"
+        "    def g(self):\n"
+        "        def step(params, cache):\n"
+        "            return np.asarray(params)\n"
+        "        self._steps['k'] = self._jit(step, key='k',"
+        " donate_argnums=(1,))\n"
+    )
+    assert _codes(lint_source(src)) == ["FF101"]
+
+
+# ---------------------------------------------------------------------------
+# FF102 tracer-control-flow
+
+
+def test_tracer_control_flow_if():
+    src = (
+        "import jax\nimport jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if jnp.any(x > 0):\n"
+        "        x = x + 1\n"
+        "    return x\n"
+    )
+    assert _codes(lint_source(src)) == ["FF102"]
+
+
+def test_tracer_control_flow_static_branch_ok():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, mask=None):\n"
+        "    if mask is None:\n"
+        "        x = x + 1\n"
+        "    return x\n"
+    )
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# FF103 weak-dtype
+
+
+def test_weak_dtype_flags_bare_asarray():
+    src = "import jax.numpy as jnp\nx = jnp.asarray([1, 2])\n"
+    assert _codes(lint_source(src)) == ["FF103"]
+
+
+def test_weak_dtype_ok_with_dtype():
+    src = (
+        "import jax.numpy as jnp\n"
+        "a = jnp.asarray([1, 2], dtype=jnp.int32)\n"
+        "b = jnp.asarray([1, 2], jnp.int32)\n"   # positional dtype
+        "c = jnp.asarray(jnp.zeros((2,)))\n"      # already a jax value
+    )
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# FF104 unordered-iteration
+
+
+def test_unordered_iteration_set_literal():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    for s in {1, 2, 3}:\n"
+        "        x = x + s\n"
+        "    return x\n"
+    )
+    assert _codes(lint_source(src)) == ["FF104"]
+
+
+def test_unordered_iteration_list_ok():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    for s in [1, 2, 3]:\n"
+        "        x = x + s\n"
+        "    return x\n"
+    )
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# FF105 missing-donation
+
+
+def test_missing_donation_on_cache_param():
+    src = (
+        "import jax\n"
+        "def step(params, cache, x):\n"
+        "    return cache\n"
+        "f = jax.jit(step)\n"
+    )
+    assert _codes(lint_source(src)) == ["FF105"]
+
+
+def test_missing_donation_ok_with_donate():
+    src = (
+        "import jax\n"
+        "def step(params, cache, x):\n"
+        "    return cache\n"
+        "f = jax.jit(step, donate_argnums=(1,))\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_missing_donation_cache_hook_attribute():
+    src = "import jax\nf = jax.jit(model.commit_kv_paged)\n"
+    assert _codes(lint_source(src)) == ["FF105"]
+
+
+# ---------------------------------------------------------------------------
+# FF106 static-hashability
+
+
+def test_static_hashability_list_default():
+    src = (
+        "import jax, functools\n"
+        "@functools.partial(jax.jit, static_argnames=('shape',))\n"
+        "def g(x, shape=[1, 2]):\n"
+        "    return x\n"
+    )
+    assert _codes(lint_source(src)) == ["FF106"]
+
+
+def test_static_hashability_tuple_ok():
+    src = (
+        "import jax, functools\n"
+        "@functools.partial(jax.jit, static_argnames=('shape',))\n"
+        "def g(x, shape=(1, 2)):\n"
+        "    return x\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_static_hashability_argnums():
+    src = (
+        "import jax\n"
+        "def g(x, opts={}):\n"
+        "    return x\n"
+        "f = jax.jit(g, static_argnums=(1,))\n"
+    )
+    assert _codes(lint_source(src)) == ["FF106"]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+
+def test_suppression_same_line():
+    src = (
+        "import jax.numpy as jnp\n"
+        "x = jnp.asarray([1])  # ffcheck: disable=FF103 -- test fixture\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_suppression_by_slug_and_line_above():
+    src = (
+        "import jax.numpy as jnp\n"
+        "# ffcheck: disable=weak-dtype -- dtype pinned upstream\n"
+        "x = jnp.asarray([1])\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_suppression_file_level_and_all():
+    src = (
+        "# ffcheck: disable-file=FF103\n"
+        "import jax.numpy as jnp\n"
+        "x = jnp.asarray([1])\n"
+        "y = jnp.asarray([2])\n"
+    )
+    assert lint_source(src) == []
+    src_all = (
+        "import jax.numpy as jnp\n"
+        "x = jnp.asarray([1])  # ffcheck: disable=all\n"
+    )
+    assert lint_source(src_all) == []
+
+
+def test_suppression_wrong_rule_does_not_hide():
+    src = (
+        "import jax.numpy as jnp\n"
+        "x = jnp.asarray([1])  # ffcheck: disable=FF101\n"
+    )
+    assert _codes(lint_source(src)) == ["FF103"]
+
+
+def test_suppression_reason_parsing():
+    lines, file_rules = parse_suppressions(
+        "x = 1  # ffcheck: disable=FF101,host-sync -- because reasons\n"
+    )
+    assert lines[1] == {"FF101", "host-sync"}
+    assert file_rules == set()
+
+
+def test_with_suppressed_reports_everything():
+    src = (
+        "import jax.numpy as jnp\n"
+        "x = jnp.asarray([1])  # ffcheck: disable=FF103 -- hidden\n"
+    )
+    assert _codes(lint_source(src, with_suppressed=True)) == ["FF103"]
+
+
+# ---------------------------------------------------------------------------
+# meta: the analyzer must actually SEE the engine's traced surface
+
+
+def test_engine_nested_steps_are_traced():
+    """engine.py's nested `step` closures (jitted via self._jit under
+    one shared name) must be in the traced set — otherwise the
+    host-sync/control-flow rules silently stop covering the hot path."""
+    path = os.path.join(REPO, "flexflow_tpu", "serve", "engine.py")
+    ctx = FileContext(path, open(path).read())
+    traced_names = {fn.name for fn in ctx.traced}
+    assert "step" in traced_names, traced_names
+    assert "speculate" in traced_names, traced_names
+
+
+def test_model_serve_protocol_is_traced():
+    path = os.path.join(REPO, "flexflow_tpu", "models", "llama.py")
+    ctx = FileContext(path, open(path).read())
+    traced_names = {fn.name for fn in ctx.traced}
+    for name in ("serve_step", "serve_step_paged", "commit_kv_paged",
+                 "copy_page_kv", "forward"):
+        assert name in traced_names, (name, sorted(traced_names))
+    assert "serve_debug_activations" not in traced_names
+
+
+def test_syntax_error_reported_not_crashed(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(:\n")
+    findings = lint_paths([str(bad)])
+    assert [f.rule for f in findings] == ["FF000"]

@@ -1,0 +1,273 @@
+"""Retrace sentinel + donation sanitizer (flexflow_tpu/analysis).
+
+The headline test drives the PR-2 mixed-step pipelined scheduler over
+the paged KV cache through admission/eviction/preemption/COW churn at
+64 slots and asserts — via RetraceGuard at the engine's jit chokepoint
+— exactly ONE compile per step key and zero recompiles thereafter: the
+shape/dtype-drift perf-bug class (a weak dtype flipping, a table shape
+drifting) caught at test time instead of as a 100x TPU slowdown.
+
+The donation tests reproduce a synthetic use-after-donate — the PR-2
+page-corruption bug class — and assert it raises UseAfterDonateError
+loudly instead of silently reading donated memory.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.analysis import (
+    DonationSanitizer,
+    RetraceError,
+    RetraceGuard,
+    UseAfterDonateError,
+)
+from flexflow_tpu.analysis.retrace import abstract_signature
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import (
+    InferenceEngine,
+    RequestManager,
+    ServingConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def churn_engine(tiny, kv_layout, sanitizers):
+    """64 slots; paged adds a TIGHT pool (preemption under load) plus
+    prefix caching (splice/eviction/COW churn)."""
+    cfg, params = tiny
+    kw = {}
+    if kv_layout == "paged":
+        kw.update(
+            page_size=8,
+            max_cached_tokens=64 * 24,
+            prefix_caching=True,
+        )
+    sc = ServingConfig(
+        max_requests_per_batch=64,
+        max_sequence_length=48,
+        prefill_chunk=8,
+        max_tokens_per_step=4,
+        max_spec_tree_tokens=8,
+        cache_dtype=jnp.float32,
+        kv_layout=kv_layout,
+        sanitizers=sanitizers,
+        **kw,
+    )
+    return InferenceEngine(llama, cfg, params, sc)
+
+
+def churn_prompts(cfg, n=96):
+    """8 groups sharing a 12-token prefix (8+4: a prefix-cache match
+    ends mid-page, forcing COW on the shared tail page), unique tails
+    of varying length."""
+    prompts = []
+    for i in range(n):
+        g = i % 8
+        shared = [(g * 17 + j * 5 + 1) % cfg.vocab_size for j in range(12)]
+        tail = [
+            (i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(3 + i % 7)
+        ]
+        prompts.append(shared + tail)
+    return prompts
+
+
+def run_churn(rm, prompts):
+    rids = [rm.submit(p, max_new_tokens=6) for p in prompts]
+    while rm.step():
+        pass
+    rm.drain()
+    return [list(rm.requests[r].output_tokens) for r in rids]
+
+
+# ---------------------------------------------------------------------------
+# the churn invariant: one compile per step key, zero recompiles
+
+
+@pytest.mark.parametrize("kv_layout", ["paged", "dense"])
+def test_churn_one_compile_per_step_key(tiny, kv_layout):
+    cfg, _ = tiny
+    eng = churn_engine(tiny, kv_layout, sanitizers=("retrace", "donation"))
+    rm = RequestManager(eng)
+    prompts = churn_prompts(cfg, n=96 if kv_layout == "paged" else 80)
+    outs = run_churn(rm, prompts)
+    assert all(len(o) == 6 for o in outs)
+
+    # the workload actually churned (admission waves beyond 64 slots;
+    # paged additionally preempts, splices, COWs and evicts)
+    s = rm.stats
+    assert s.admitted >= len(prompts)
+    if kv_layout == "paged":
+        assert s.preemptions > 0, "pool never exhausted — churn too soft"
+        assert s.prefix_hits > 0 and s.prefix_cows > 0 and s.prefix_evictions > 0
+
+    guard = eng.retrace_guard
+    # exactly one compile per (C,)-keyed step program, zero thereafter
+    guard.assert_one_compile_per_key()
+    assert guard.retraces == 0
+    counts = guard.compile_counts()
+    C = eng.serving.mixed_chunk
+    assert counts.get(("mixed_fused", C, False)) == 1, counts
+    assert counts.get(("mixed_fused", 1, False)) == 1, counts
+    if kv_layout == "paged":
+        assert counts.get("copy_page") == 1, counts
+    # compile telemetry mirrored into the scheduler stats
+    assert s.compiles == guard.total_compiles
+    assert s.retraces == 0
+    # donated dispatches were poisoned throughout
+    assert eng.donation_sanitizer.n_poisoned > 0
+
+
+def test_sanitizers_do_not_change_outputs(tiny):
+    """Guard + sanitizer are observers: bitwise-identical generations
+    with and without them."""
+    cfg, _ = tiny
+    prompts = churn_prompts(cfg, n=40)
+    outs_on = run_churn(
+        RequestManager(
+            churn_engine(tiny, "paged", sanitizers=("retrace", "donation"))
+        ),
+        prompts,
+    )
+    outs_off = run_churn(
+        RequestManager(churn_engine(tiny, "paged", sanitizers=())),
+        prompts,
+    )
+    assert outs_on == outs_off
+
+
+# ---------------------------------------------------------------------------
+# RetraceGuard unit behavior
+
+
+def test_retrace_guard_raises_on_signature_drift():
+    guard = RetraceGuard(strict=True)
+    f = jax.jit(guard.instrument(lambda x: x * 2, key="step"))
+    f(jnp.zeros((4,), jnp.float32))
+    f(jnp.ones((4,), jnp.float32))  # same signature: cached, no trace
+    assert guard.compile_counts() == {"step": 1}
+    with pytest.raises(RetraceError, match="RECOMPILED"):
+        f(jnp.zeros((8,), jnp.float32))  # shape drift
+
+
+def test_retrace_guard_catches_weak_dtype_flip():
+    """THE engine.py:568 bug class: the same step key fed a strongly
+    typed np.int32 array one step and a weak Python scalar the next —
+    jax quietly recompiles; the guard does not."""
+    guard = RetraceGuard(strict=True)
+    f = jax.jit(guard.instrument(lambda x: x + 1, key="step"))
+    f(jnp.asarray(np.zeros((2,), np.int32), dtype=jnp.int32))
+    with pytest.raises(RetraceError, match="RECOMPILED"):
+        f(jnp.asarray(0))  # weak-typed scalar: new abstract signature
+    sigs = guard.compiles["step"]
+    assert sigs[0] != sigs[1]
+
+
+def test_retrace_guard_warn_mode_records_without_raising():
+    guard = RetraceGuard(strict=False)
+    f = jax.jit(guard.instrument(lambda x: x * 2, key="k"))
+    f(jnp.zeros((2,)))
+    f(jnp.zeros((3,)))
+    assert guard.retraces == 1
+    assert guard.compile_counts() == {"k": 2}
+    with pytest.raises(RetraceError):
+        guard.assert_one_compile_per_key()
+
+
+def test_retrace_guard_seal_forbids_new_keys():
+    guard = RetraceGuard(strict=True)
+    f = jax.jit(guard.instrument(lambda x: x, key="a"))
+    f(jnp.zeros((2,)))
+    guard.seal()
+    f(jnp.zeros((2,)))  # cached replay: fine
+    g = jax.jit(guard.instrument(lambda x: x, key="b"))
+    with pytest.raises(RetraceError, match="NEW step key"):
+        g(jnp.zeros((2,)))
+    guard.unseal()
+    g(jnp.zeros((2,)))
+
+
+def test_abstract_signature_distinguishes_weak_types():
+    strong = abstract_signature((jnp.asarray(1, dtype=jnp.int32),), {})
+    weak = abstract_signature((jnp.asarray(1),), {})
+    assert strong != weak
+
+
+def test_engine_retrace_guard_survives_reset(tiny):
+    eng = churn_engine(tiny, "dense", sanitizers=("retrace",))
+    rm = RequestManager(eng)
+    run_churn(rm, churn_prompts(tiny[0], n=4))
+    eng.retrace_guard.reset()
+    assert eng.retrace_guard.compile_counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# donation sanitizer
+
+
+def test_donation_sanitizer_synthetic_use_after_donate():
+    san = DonationSanitizer()
+    f = jax.jit(lambda c, x: {"k": c["k"] + x}, donate_argnums=(0,))
+    cache = {"k": jnp.ones((4,), jnp.float32)}
+    out = f(cache, 1.0)
+    san.poison(cache, context="synthetic step")
+    with pytest.raises(UseAfterDonateError, match="use-after-donate"):
+        _ = cache["k"].shape
+    with pytest.raises(UseAfterDonateError):
+        _ = cache["k"] + 1
+    with pytest.raises(UseAfterDonateError):
+        np.asarray(cache["k"])
+    # the NEW cache is untouched
+    assert float(out["k"][0]) == 2.0
+    assert san.n_poisoned == 1
+
+
+def test_donation_proxy_repr_is_safe():
+    san = DonationSanitizer()
+    cache = {"k": jnp.ones((2,))}
+    cache["k"].delete()
+    san.poison(cache, context="ctx")
+    assert "DeletedBufferProxy" in repr(cache["k"])
+    # poisoning again is idempotent
+    san.poison(cache, context="ctx2")
+
+
+def test_engine_use_after_donate_raises(tiny):
+    """The deliberately injected PR-2 bug: hold the cache pytree across
+    a donating dispatch, then read it."""
+    eng = churn_engine(tiny, "paged", sanitizers=("donation",))
+    rm = RequestManager(eng)
+    stale = eng.cache  # e.g. a debug probe holding the "current" cache
+    run_churn(rm, churn_prompts(tiny[0], n=4))
+    with pytest.raises(UseAfterDonateError, match="donated to engine step"):
+        _ = stale["k"].shape
+    # the engine's own (current) cache is healthy
+    assert eng.kv_cache_bytes() > 0
+
+
+def test_engine_without_sanitizer_keeps_plain_jit(tiny):
+    eng = churn_engine(tiny, "dense", sanitizers=())
+    assert eng.retrace_guard is None and eng.donation_sanitizer is None
+
+
+def test_sanitizers_string_form_and_validation(tiny):
+    cfg, params = tiny
+    sc = ServingConfig(
+        max_requests_per_batch=2, max_sequence_length=32,
+        prefill_chunk=8, max_spec_tree_tokens=8,
+        cache_dtype=jnp.float32, sanitizers="retrace-warn,donation",
+    )
+    eng = InferenceEngine(llama, cfg, params, sc)
+    assert eng.retrace_guard is not None and not eng.retrace_guard.strict
+    assert eng.donation_sanitizer is not None
+    with pytest.raises(ValueError, match="unknown sanitizer"):
+        InferenceEngine(
+            llama, cfg, params,
+            ServingConfig(sanitizers=("bogus",)),
+        )

@@ -71,6 +71,14 @@ def reference_tokens(tiny4):
     ids=["tp2", "pp2", "pp4", "tp2pp2", "dp2tp2pp2"],
 )
 def test_layout_token_equality(tiny4, reference_tokens, spec):
+    if spec.model > 1 and spec.pipe > 1 and jax.default_backend() == "cpu":
+        # TP inside the partial-manual pipeline shard_map makes the XLA
+        # SPMD partitioner visit the stage body's PartitionId, which
+        # XLA:CPU rejects (UNIMPLEMENTED: PartitionId instruction is not
+        # supported for SPMD partitioning). pp-only layouts (no auto-axis
+        # work inside the manual region) pass; TPU compiles all of them.
+        pytest.skip("XLA:CPU SPMD partitioner lacks PartitionId support "
+                    "for TP-inside-pipeline shard_map — TPU-only layout")
     assert _generate(tiny4, spec) == reference_tokens
 
 
