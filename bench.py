@@ -186,6 +186,11 @@ _PHASES = [
     # workload: hit rate + TTFT p50/p99 + tokens/sec/chip, caching on
     # vs off with output parity asserted
     ("serve_prefix", 900, 600, True, True),
+    # quantized paged KV (int8 pages, dequant fused into ragged paged
+    # attention) vs the fp pool at the SAME max_cached_tokens HBM
+    # budget: tokens/sec/chip + TTFT/TPOT p50/p99 + bytes/live-token +
+    # slots-before-preemption, output parity asserted
+    ("serve_paged_q", 900, 600, True, True),
     ("serve_int8", 600, 400, True, True),
     ("searched", 700, 400, False, True),
     ("serve_int4", 600, 400, True, True),
@@ -230,19 +235,62 @@ def orchestrate(which):
                      " — serve phases run kernels=xla")
 
     # Derived: the int8-vs-fp uplift on the identical workload (the
-    # reference's --8bit-quantization claim, file_loader.cc:651).
+    # reference's --8bit-quantization claim, file_loader.cc:651). The
+    # bare ratio was misleading off-TPU, so it now carries a
+    # platform-appropriate caveat: on the chip decode is
+    # HBM-bandwidth-bound and the ratio measures the halved weight
+    # read; XLA:CPU decode is compute-bound and pays the dequant as
+    # extra FLOPs, so the CPU number routinely reads ~1 or below and
+    # says nothing about the TPU claim.
     fp = _RESULTS.get("incr_decode_tokens_per_sec_per_chip")
     q8 = _RESULTS.get("incr_decode_tokens_per_sec_int8")
     if fp and q8 and fp["value"]:
         fp_plat = (fp.get("detail") or {}).get("platform")
         q8_plat = (q8.get("detail") or {}).get("platform")
         if fp_plat == q8_plat:
+            caveat = (
+                "bandwidth-bound decode on the chip: the ratio measures "
+                "the halved per-step weight-read bytes"
+                if fp_plat == "tpu" else
+                "XLA:CPU decode is compute-bound and pays int8 dequant "
+                "as extra FLOPs — treat as a correctness/parity smoke, "
+                "not the TPU bandwidth claim"
+            )
             emit(
                 "int8_speedup_vs_fp",
                 round(q8["value"] / fp["value"], 3),
                 "ratio",
                 platform=fp_plat,
+                caveat=caveat,
             )
+
+    # Derived: KV HBM bytes per live token, so BENCH_r*.json tracks
+    # memory alongside speed. Chip-measured records outrank CPU ones;
+    # the quantized pool's figure (its detail carries the fp
+    # comparison) outranks the fp pool's at equal platform.
+    cands = [
+        _RESULTS.get(n) for n in (
+            "paged_q_kv_hbm_bytes_per_live_token",
+            "paged_kv_hbm_bytes_per_live_token",
+        )
+    ]
+    cands = [c for c in cands if c]
+    if cands:
+        rec = next(
+            (c for c in cands
+             if (c.get("detail") or {}).get("platform") == "tpu"),
+            cands[0],
+        )
+        d = rec.get("detail") or {}
+        emit(
+            "kv_bytes_per_live_token",
+            rec["value"],
+            "bytes/token",
+            vs_baseline=rec.get("vs_baseline"),
+            source=rec["metric"],
+            kv_quant=d.get("kv_quant"),
+            platform=d.get("platform"),
+        )
 
     # Headline line LAST (the "one JSON line" the driver records):
     # SpecInfer if measured, else the best metric that did land — but a
@@ -253,6 +301,7 @@ def orchestrate(which):
         "incr_decode_tokens_per_sec_per_chip",
         "continuous_serve_tokens_per_sec_per_chip",
         "paged_serve_tokens_per_sec_per_chip",
+        "paged_q_serve_tokens_per_sec_per_chip",
         "specinfer_tokens_per_sec_7b_int4",
         "incr_decode_tokens_per_sec_int8",
         "unity_searched_train_mfu",
@@ -1150,6 +1199,251 @@ def serve_prefix_bench(on_tpu, kernels):
     return warm["tps"]
 
 
+def serve_paged_q_bench(on_tpu, kernels):
+    """Quantized paged KV cache (serve/kv_quant.py: int8 pages +
+    per-page-per-KV-head amax scales, dequant fused into the ragged
+    paged attention read — serve/kernels.py) vs the bf16 paged pool at
+    the SAME ``max_cached_tokens`` HBM budget: 64 request slots under
+    Poisson arrivals. The budget is priced in bf16 lines and set to
+    ~56% of the 64-slot worst case, so the bf16 pool saturates and
+    recompute-preempts under load the int8 pool — which the same
+    budget buys ~2x the physical pages for (asserted ≥ 1.9x) —
+    absorbs. Reports tokens/sec/chip, TTFT/TPOT p50/p99 for both
+    pools, measured KV-HBM-bytes-per-live-token at peak occupancy, and
+    the max concurrent slots each pool sustained (with its preemption
+    count).
+
+    Output parity: int8 KV is lossy — a near-tied greedy argmax can
+    flip, and one flip cascades through the rest of that request — so
+    exact token equality is not the contract. The run asserts
+    per-position agreement ≥ 0.75 across all requests (measured logit
+    error is ~0.3% of the logit range; the documented engine-level
+    tolerance is 2% of max|logit| — tests/test_kv_quant.py, README
+    "Quantized KV cache"). Bitwise run-to-run determinism of the int8
+    pool itself is a tier-1 test, not re-measured here.
+
+    Measurement caveat (CPU): XLA:CPU decode is compute-bound, not
+    KV-bandwidth-bound, so halving KV read bytes barely moves
+    tokens/sec there (the dequant even adds FLOPs) — off-TPU the
+    throughput ratio is a parity/scheduling smoke and the phase's real
+    signal is capacity: pages, bytes/live-token, preemptions. On TPU
+    the halved KV stream is the decode hot loop's bandwidth."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import InferenceEngine, RequestManager, ServingConfig
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_slots = 64
+    n_req = 128 if on_tpu else 96
+    n_new = 32 if on_tpu else 16
+    prompt_len = 64 if on_tpu else 24
+    page_size = 64 if on_tpu else 16
+    prefill_chunk = 32 if on_tpu else 24
+    # "int8-KV vs bf16-KV": the fp side stores bf16 pages on BOTH
+    # platforms (CPU model weights stay f32 — only the cache dtype is
+    # pinned) so the pages-per-budget ratio under test is the 2x one,
+    # not the trivial 4x a f32 baseline would show.
+    cache_dtype = jnp.bfloat16
+    # ~56% of the 64-slot worst case: 36 full-length slots of bf16
+    # pages, ~71 of int8 — the A/B's whole point is that only one side
+    # fits the offered concurrency.
+    budget = (n_slots // 2 + 4) * (prompt_len + n_new + page_size)
+    if not on_tpu and kernels == "pallas":
+        _log("serve_paged_q: forcing kernels=xla off-TPU (interpret-mode "
+             "pallas would dominate the measurement)")
+        kernels = "xla"
+
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_req)
+    ]
+
+    def make_rm(kv_quant):
+        sc = ServingConfig(
+            max_requests_per_batch=n_slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=prefill_chunk,
+            max_spec_tree_tokens=16,
+            cache_dtype=cache_dtype,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=page_size,
+            max_cached_tokens=budget,
+            kv_quant=kv_quant,
+            # retrace sentinel: quantized pools add scale operands to
+            # every step — a shape/dtype drift there would recompile
+            # mid-run and hide as throughput noise; it raises instead
+            sanitizers=("retrace",),
+        )
+        rm = RequestManager(InferenceEngine(llama, cfg, params, sc))
+        rm.generate(prompts[:n_slots], max_new_tokens=4)  # warm/compile
+        rm.stats = type(rm.stats)()
+        return rm
+
+    def percentiles(vals):
+        import numpy as np
+
+        if not vals:
+            return 0.0, 0.0
+        return (float(np.percentile(vals, 50)), float(np.percentile(vals, 99)))
+
+    def run(rm, arrival_s):
+        """Open-loop Poisson run (serve_continuous's driver) that also
+        tracks peak concurrency and snapshots allocated-KV-bytes per
+        live token at the occupancy peak."""
+        eng = rm.engine
+        rids = []
+        due = list(zip(arrival_s, prompts))
+        max_live = 0
+        peak_tokens, peak_bytes = 0, 0
+        t0 = time.perf_counter()
+        while due or any(
+            rm.requests[r].status.value not in ("completed", "error")
+            for r in rids
+        ):
+            now = time.perf_counter() - t0
+            while due and due[0][0] <= now:
+                _, p = due.pop(0)
+                rids.append(rm.submit(p, max_new_tokens=n_new))
+            stepped = rm.step()
+            live = [rm.requests[r] for r in rids if rm.requests[r].slot >= 0]
+            max_live = max(max_live, len(live))
+            live_tokens = sum(r.n_cached for r in live)
+            if live_tokens >= peak_tokens:
+                peak_tokens = live_tokens
+                peak_bytes = eng.kv_allocated_bytes()
+            if not stepped and due:
+                time.sleep(max(0.0, due[0][0] - (time.perf_counter() - t0)))
+        rm.drain()
+        wall = time.perf_counter() - t0
+        tokens = 0
+        ttft, tpot, outs = [], [], []
+        for r in rids:
+            req = rm.requests[r]
+            out = req.output_tokens
+            outs.append(list(out))
+            tokens += len(out)
+            ttft.append(req.profile.ttft_s * 1e3)
+            tpot.append(req.profile.tpot_s(len(out)) * 1e3)
+        return {
+            "tps": tokens / wall,
+            "ttft": percentiles(ttft),
+            "tpot": percentiles(tpot),
+            "outputs": outs,
+            "max_live": max_live,
+            "bytes_per_live_token": peak_bytes / max(1, peak_tokens),
+            "stats": rm.stats.snapshot(),
+        }
+
+    # --- int8 pool (also calibrates the offered load: arrivals span
+    # the whole run at the quantized engine's closed-loop capacity, so
+    # the bf16 side faces sustained churn it cannot fully seat) ---
+    rm_q = make_rm("int8")
+    pages_q = rm_q.engine.pager.num_pages
+    t0 = time.perf_counter()
+    rm_q.generate(prompts[:n_slots], max_new_tokens=n_new)
+    est_tps = (n_slots * n_new) / (time.perf_counter() - t0)
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    arrival_s = np.cumsum(
+        rng.exponential(scale=n_new / est_tps, size=n_req)
+    ).tolist()
+    rm_q.stats = type(rm_q.stats)()  # calibration warmed all shapes
+    q = run(rm_q, arrival_s)
+    del rm_q
+
+    # --- bf16 pool, same budget, same arrival schedule ---
+    rm_fp = make_rm(None)
+    pages_fp = rm_fp.engine.pager.num_pages
+    fp = run(rm_fp, arrival_s)
+    del rm_fp
+
+    # same budget must expose ~2x the pages (the acceptance bar; the
+    # shortfall from exactly 2x is the per-page f32 scale rows)
+    pages_ratio = pages_q / max(1, pages_fp)
+    assert pages_ratio >= 1.9, (
+        f"int8 pool exposes only {pages_ratio:.3f}x the bf16 pages "
+        f"({pages_q} vs {pages_fp}) at max_cached_tokens={budget}"
+    )
+    # greedy parity within the documented tolerance (see docstring)
+    flat_fp = [t for o in fp["outputs"] for t in o]
+    flat_q = [t for o in q["outputs"] for t in o]
+    agree = (
+        sum(a == b for a, b in zip(flat_q, flat_fp))
+        / max(1, min(len(flat_q), len(flat_fp)))
+    )
+    assert len(flat_q) == len(flat_fp) and agree >= 0.75, (
+        f"int8-KV greedy outputs diverged beyond tolerance: "
+        f"agreement={agree:.4f} ({len(flat_q)} vs {len(flat_fp)} tokens)"
+    )
+    assert q["stats"]["retraces"] == 0 and fp["stats"]["retraces"] == 0, (
+        f"steady-state recompiles in the measured serve run: "
+        f"int8={q['stats']['retraces']} bf16={fp['stats']['retraces']}"
+    )
+    if fp["stats"]["preemptions"] == 0:
+        _log("serve_paged_q: bf16 pool never preempted — offered load "
+             "did not saturate the fp pool; capacity delta is still "
+             "reported via pages/max_live")
+
+    emit(
+        "paged_q_kv_hbm_bytes_per_live_token",
+        round(q["bytes_per_live_token"], 1),
+        "bytes/token",
+        # <1: the quantized pool's peak-occupancy HBM cost per live
+        # token vs the bf16 pool's, same budget, same workload
+        vs_baseline=(
+            q["bytes_per_live_token"] / max(1e-9, fp["bytes_per_live_token"])
+        ),
+        kv_quant="int8",
+        fp_bytes_per_live_token=round(fp["bytes_per_live_token"], 1),
+        pool_pages_int8=pages_q,
+        pool_pages_bf16=pages_fp,
+        pool_pages_ratio=round(pages_ratio, 3),
+        page_size=page_size,
+        max_cached_tokens=budget,
+        platform=_platform(),
+    )
+    emit(
+        "paged_q_serve_tokens_per_sec_per_chip",
+        round(q["tps"], 2),
+        "tokens/sec/chip",
+        vs_baseline=q["tps"] / max(1e-9, fp["tps"]),
+        kernels=kernels,
+        kv_quant="int8",
+        n_requests=n_req,
+        n_slots=n_slots,
+        new_tokens_per_request=n_new,
+        prompt_len=prompt_len,
+        max_cached_tokens=budget,
+        pool_pages_ratio=round(pages_ratio, 3),
+        kv_hbm_bytes_per_live_token=round(q["bytes_per_live_token"], 1),
+        fp_kv_hbm_bytes_per_live_token=round(fp["bytes_per_live_token"], 1),
+        max_concurrent_slots_int8=q["max_live"],
+        max_concurrent_slots_bf16=fp["max_live"],
+        preemptions_int8=q["stats"]["preemptions"],
+        preemptions_bf16=fp["stats"]["preemptions"],
+        ttft_p50_ms=round(q["ttft"][0], 1),
+        ttft_p99_ms=round(q["ttft"][1], 1),
+        tpot_p50_ms=round(q["tpot"][0], 2),
+        tpot_p99_ms=round(q["tpot"][1], 2),
+        baseline_tokens_per_sec=round(fp["tps"], 2),
+        baseline_ttft_p50_ms=round(fp["ttft"][0], 1),
+        baseline_ttft_p99_ms=round(fp["ttft"][1], 1),
+        baseline_tpot_p50_ms=round(fp["tpot"][0], 2),
+        baseline_tpot_p99_ms=round(fp["tpot"][1], 2),
+        token_agreement=round(agree, 4),
+        jit_compiles_measured=q["stats"]["compiles"],
+        steady_state_recompiles=q["stats"]["retraces"],
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return q["tps"]
+
+
 def serve_quantized_bench(on_tpu, kernels, bits):
     """Weight-only int8/int4 serving (reference --8bit/4bit-quantization,
     file_loader.cc:651,710 + decompress kernels): decode is
@@ -1302,6 +1596,8 @@ def child_main(phase, platform, kernels):
         serve_continuous_bench(on_tpu, kernels)
     elif phase == "serve_prefix":
         serve_prefix_bench(on_tpu, kernels)
+    elif phase == "serve_paged_q":
+        serve_paged_q_bench(on_tpu, kernels)
     elif phase == "serve_int8":
         serve_quantized_bench(on_tpu, kernels, bits=8)
     elif phase == "serve_int4":
@@ -1319,7 +1615,7 @@ def main():
         default="all",
         choices=["all", "train", "searched", "parity", "serve",
                  "serve_paged", "serve_continuous", "serve_prefix",
-                 "serve_int8", "serve_int4", "serve_7b"],
+                 "serve_paged_q", "serve_int8", "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)

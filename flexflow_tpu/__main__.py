@@ -97,6 +97,7 @@ def cmd_serve(args):
         kv_layout=args.kv_layout,
         page_size=args.page_size,
         max_cached_tokens=args.max_cached_tokens,
+        kv_quant=args.kv_quant,
         prefix_caching=args.prefix_caching,
         cache_policy=args.cache_policy,
     )
@@ -197,6 +198,12 @@ def main(argv=None):
                    help="paged KV pool budget in tokens (default: worst "
                         "case slots*max_len; smaller oversubscribes with "
                         "recompute preemption)")
+    s.add_argument("--kv-quant", choices=["int8", "int4"], default=None,
+                   help="quantized paged KV pages (requires "
+                        "--kv-layout paged): int8 codes + per-page "
+                        "amax scales, dequantized inside attention; "
+                        "the --max-cached-tokens HBM budget then buys "
+                        "~2x the pages (int4 is a reserved layout)")
     s.add_argument("--prefix-caching", action="store_true",
                    help="automatic prefix caching (paged layout only): "
                         "reuse cached KV pages for shared prompt "

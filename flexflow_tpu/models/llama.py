@@ -689,6 +689,7 @@ def serve_debug_activations(
     kernels: str = "xla",
     page_table: Optional[jnp.ndarray] = None,
     cache_len: Optional[int] = None,
+    kv_quant: Optional[str] = None,
 ):
     """Per-layer hidden-state capture for ``inference_debugging``
     (reference's per-op tensor dump mode, serve/__init__.py:48 —
@@ -697,7 +698,8 @@ def serve_debug_activations(
     layer's output survives as its own array; cache writes are computed
     and DISCARDED (the caller's donating step does the real commit).
     Deliberately slow — a triage tool, not a serving path. With
-    ``page_table`` the paged layout is read/written through the table."""
+    ``page_table`` the paged layout is read/written through the table
+    (``kv_quant``: the quantized pool, dequantized per layer)."""
     if cache_positions is None:
         cache_positions = positions
     x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
@@ -707,12 +709,20 @@ def serve_debug_activations(
         ps = cache["k"].shape[2]
         mask = _paged_mask(mask, positions, page_table, ps, cache_len)
         phys, off = _page_lookup(page_table, cache_positions, ps)
+        qmax = None
+        if kv_quant is not None:
+            from ..serve.kv_quant import resolve_spec
+
+            qmax = resolve_spec(kv_quant).qmax
         for l in range(cfg.num_hidden_layers):
             p_l = jax.tree.map(lambda a: a[l], params["layers"])
-            x, _, _ = serve_block_paged(
+            x, *_ = serve_block_paged(
                 cfg, p_l, x, cos, sin, mask,
                 cache["k"][l], cache["v"][l], phys, off, page_table,
                 kernels,
+                cache["k_scale"][l] if qmax is not None else None,
+                cache["v_scale"][l] if qmax is not None else None,
+                qmax,
             )
             acts.append(x)
         return acts
@@ -745,35 +755,58 @@ def serve_debug_activations(
 
 
 def init_paged_kv_cache(
-    cfg: LLaMAConfig, num_pages: int, page_size: int, dtype=None
+    cfg: LLaMAConfig, num_pages: int, page_size: int, dtype=None,
+    kv_quant: Optional[str] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Paged pool: (L, num_pages+1, page_size, KV, dk). Pool row
     ``num_pages`` is the shared scratch page — unallocated page-table
     entries point there, so padding writes and gathers through
     unallocated entries never touch live pages (the paged analog of the
-    dense layout's per-slot scratch row)."""
+    dense layout's per-slot scratch row).
+
+    With ``kv_quant`` (serve/kv_quant.py) the pools store int8 codes
+    and the cache gains ``k_scale``/``v_scale``: (L, num_pages+1, KV)
+    f32 per-page-per-KV-head amax scales, zero-initialised (a zero
+    scale marks a page with no committed lines)."""
     L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
     dt = dtype or cfg.dtype
+    spec = None
+    if kv_quant is not None:
+        from ..serve.kv_quant import resolve_spec
+
+        spec = resolve_spec(kv_quant)
+        dt = spec.dtype
     shape = (L, num_pages + 1, page_size, KV, dk)
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if spec is not None:
+        sshape = (L, num_pages + 1, KV)
+        cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return cache
 
 
 def paged_kv_cache_pspecs(
-    cfg: Optional[LLaMAConfig] = None, *, pipeline: bool = False
+    cfg: Optional[LLaMAConfig] = None, *, pipeline: bool = False,
+    kv_quant: Optional[str] = None,
 ) -> Dict[str, P]:
     """Pages shard over DP on the pool dim, KV heads over TP on the
     model axis (same head axis the attention shards on) — tensor-
     parallel serving keeps working; MQA (KV=1) replicates as in the
-    dense layout."""
+    dense layout. Quantized pools shard their per-page scale rows the
+    same way (pages on data, KV heads on model)."""
     kv_axis = (
         None if (cfg is not None and cfg.num_key_value_heads == 1)
         else MODEL_AXIS
     )
     pp = PIPE_AXIS if pipeline else None
-    return {
+    specs = {
         "k": P(pp, DATA_AXIS, None, kv_axis, None),
         "v": P(pp, DATA_AXIS, None, kv_axis, None),
     }
+    if kv_quant is not None:
+        specs["k_scale"] = P(pp, DATA_AXIS, kv_axis)
+        specs["v_scale"] = P(pp, DATA_AXIS, kv_axis)
+    return specs
 
 
 def _page_lookup(page_table: jnp.ndarray, cache_positions: jnp.ndarray,
@@ -787,10 +820,17 @@ def _page_lookup(page_table: jnp.ndarray, cache_positions: jnp.ndarray,
 
 def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
                       k_pool, v_pool, phys, off, page_table,
-                      kernels: str = "xla"):
+                      kernels: str = "xla",
+                      k_scale=None, v_scale=None, qmax=None):
     """One block on a paged serving step: scatter new K/V at the
     table-resolved (physical page, offset), attend over the virtual
-    cache read through the page table."""
+    cache read through the page table. With ``qmax`` (quantized pool,
+    serve/kv_quant.py) the KV commit quantizes in the step itself —
+    per-page amax scales, rescale-on-growth — and attention dequantizes
+    at read time (in-kernel on the Pallas path), so full-precision K/V
+    never round-trip HBM. Returns
+    ``(x, k_pool, v_pool, k_scale, v_scale)`` (scales None when the
+    pool is full-precision)."""
     R, C, D = x.shape
     H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     h = _rms(x, p["attn_norm"], cfg.rms_norm_eps)
@@ -799,21 +839,34 @@ def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
     v = _mm(h, p["wv"]).reshape(R, C, KV, dk)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
-    v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
+    if qmax is not None:
+        from ..serve.kv_quant import quant_line_write
+
+        k_pool, k_scale = quant_line_write(k_pool, k_scale, phys, off, k, qmax)
+        v_pool, v_scale = quant_line_write(v_pool, v_scale, phys, off, v, qmax)
+    else:
+        k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
     from ..serve import kernels as _pk
 
     if kernels == "pallas":
-        attn = _pk.ragged_paged_attention(q, k_pool, v_pool, page_table, mask)
+        attn = _pk.ragged_paged_attention(
+            q, k_pool, v_pool, page_table, mask,
+            k_scale=k_scale, v_scale=v_scale,
+        )
         attn = attn.reshape(R, C, H * dk)
     else:
-        k_virt = _pk.gather_pages(k_pool, page_table)
-        v_virt = _pk.gather_pages(v_pool, page_table)
+        if qmax is not None:
+            k_virt = _pk.dequant_pages(k_pool, k_scale, page_table, q.dtype)
+            v_virt = _pk.dequant_pages(v_pool, v_scale, page_table, q.dtype)
+        else:
+            k_virt = _pk.gather_pages(k_pool, page_table)
+            v_virt = _pk.gather_pages(v_pool, page_table)
         attn = serve_attention(cfg, q, k_virt, v_virt, mask)
     x = x + _mm(attn, p["wo"])
     h2 = _rms(x, p["ffn_norm"], cfg.rms_norm_eps)
     ffn = _mm(jax.nn.silu(_mm(h2, p["w1"])) * _mm(h2, p["w3"]), p["w2"])
-    return x + ffn, k_pool, v_pool
+    return x + ffn, k_pool, v_pool, k_scale, v_scale
 
 
 def _paged_mask(mask, positions, page_table, page_size, cache_len):
@@ -842,11 +895,14 @@ def serve_step_paged(
     cache_len: int,
     all_logits: bool = False,
     kernels: str = "xla",
+    kv_quant: Optional[str] = None,
     mesh=None,
 ):
     """Paged twin of :func:`serve_step` — same contract plus the
     per-slot page table; prefill chunks, single-token decode and
-    tree-verify all read/write K/V through the table."""
+    tree-verify all read/write K/V through the table. ``kv_quant``
+    selects the quantized pool layout (serve/kv_quant.py): the KV
+    commit quantizes in-step and attention dequantizes at read time."""
     if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
         raise NotImplementedError(
             "paged KV serving is not composed with pipeline parallelism "
@@ -860,17 +916,39 @@ def serve_step_paged(
     mask = _paged_mask(mask, positions, page_table, ps, cache_len)
     phys, off = _page_lookup(page_table, cache_positions, ps)
 
-    def scan_body(h, xs):
-        p_l, kc, vc = xs
-        h, kc, vc = serve_block_paged(
-            cfg, p_l, h, cos, sin, mask, kc, vc, phys, off, page_table,
-            kernels,
-        )
-        return h, (kc, vc)
+    if kv_quant is not None:
+        from ..serve.kv_quant import resolve_spec
 
-    x, (k_new, v_new) = lax.scan(
-        scan_body, x, (params["layers"], cache["k"], cache["v"])
-    )
+        qmax = resolve_spec(kv_quant).qmax
+
+        def scan_body_q(h, xs):
+            p_l, kc, vc, ks, vs = xs
+            h, kc, vc, ks, vs = serve_block_paged(
+                cfg, p_l, h, cos, sin, mask, kc, vc, phys, off,
+                page_table, kernels, ks, vs, qmax,
+            )
+            return h, (kc, vc, ks, vs)
+
+        x, (k_new, v_new, ks_new, vs_new) = lax.scan(
+            scan_body_q, x,
+            (params["layers"], cache["k"], cache["v"],
+             cache["k_scale"], cache["v_scale"]),
+        )
+        new_cache = {"k": k_new, "v": v_new,
+                     "k_scale": ks_new, "v_scale": vs_new}
+    else:
+        def scan_body(h, xs):
+            p_l, kc, vc = xs
+            h, kc, vc, _, _ = serve_block_paged(
+                cfg, p_l, h, cos, sin, mask, kc, vc, phys, off,
+                page_table, kernels,
+            )
+            return h, (kc, vc)
+
+        x, (k_new, v_new) = lax.scan(
+            scan_body, x, (params["layers"], cache["k"], cache["v"])
+        )
+        new_cache = {"k": k_new, "v": v_new}
     x = _rms(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     if not all_logits:
@@ -878,7 +956,7 @@ def serve_step_paged(
         logits = jnp.matmul(x, head, preferred_element_type=jnp.float32)[:, 0]
     else:
         logits = jnp.matmul(x, head, preferred_element_type=jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, new_cache
 
 
 def copy_page_kv(
@@ -889,9 +967,13 @@ def copy_page_kv(
     """Copy one physical page's K/V lines (all layers) to another page —
     the device half of prefix-cache copy-on-write (serve/
     prefix_cache.py): a request appending into a shared cached tail page
-    writes into a private copy, never the cached original."""
+    writes into a private copy, never the cached original. Dtype-
+    agnostic by construction: every cache buffer — bf16 or int8 pools
+    AND the quantized layout's (L, P+1, KV) scale rows — copies through
+    the same pool-row gather/scatter, so COW moves codes and their
+    scales together byte-for-byte."""
     return {
-        name: buf.at[:, dst].set(buf[:, src])  # (L, P+1, ps, KV, dk)
+        name: buf.at[:, dst].set(buf[:, src])  # (L, P+1, ps|KV, ...)
         for name, buf in cache.items()
     }
 
@@ -901,14 +983,33 @@ def commit_kv_paged(
     page_table: jnp.ndarray,  # (R, NP) int32
     src: jnp.ndarray,         # (R, K) int32 cache lines (tree node lines)
     dst: jnp.ndarray,         # (R, K) int32 destination lines
+    *,
+    kv_quant: Optional[str] = None,
 ) -> Dict[str, jnp.ndarray]:
     """:func:`commit_kv` through the page table: accepted speculative
     lines move between table-resolved (page, offset) pairs. Functional
     gather-then-scatter, so overlapping ranges stay safe; scratch→
-    scratch no-ops are harmless duplicates (identical values)."""
+    scratch no-ops are harmless duplicates (identical values).
+
+    On a quantized pool the codes cannot move verbatim (source and
+    destination pages carry different scales): the lines dequantize at
+    their source page's scale and re-commit through the standard
+    quantized write (serve/kv_quant.quant_commit_lines), updating the
+    destination pages' amax scales exactly as a fresh write would."""
     ps = cache["k"].shape[2]
     s_phys, s_off = _page_lookup(page_table, src, ps)
     d_phys, d_off = _page_lookup(page_table, dst, ps)
+    if kv_quant is not None:
+        from ..serve.kv_quant import quant_commit_lines, resolve_spec
+
+        qmax = resolve_spec(kv_quant).qmax
+        out = dict(cache)
+        for name in ("k", "v"):
+            out[name], out[name + "_scale"] = quant_commit_lines(
+                cache[name], cache[name + "_scale"],
+                s_phys, s_off, d_phys, d_off, qmax,
+            )
+        return out
     out = {}
     for name, buf in cache.items():  # (L, P+1, ps, KV, dk)
         rows = buf[:, s_phys, s_off]  # (L, R, K, KV, dk)

@@ -846,23 +846,39 @@ def reorder_slots(
 
 
 def init_paged_kv_cache(
-    cfg: DecoderConfig, num_pages: int, page_size: int, dtype=None
+    cfg: DecoderConfig, num_pages: int, page_size: int, dtype=None,
+    kv_quant: Optional[str] = None,
 ):
     """Pool (L, num_pages+1, page_size, KV, dk); pool row ``num_pages``
     is the shared scratch page. ALiBi/sliding-window configs also page
-    the per-line position buffer."""
+    the per-line position buffer. With ``kv_quant`` the pools store
+    int8 codes plus per-page-per-KV-head f32 ``k_scale``/``v_scale``
+    rows (serve/kv_quant.py; the position buffer stays int32 — it is
+    exact metadata, not tensor payload)."""
     L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
     dt = dtype or cfg.dtype
+    spec = None
+    if kv_quant is not None:
+        from ..serve.kv_quant import resolve_spec
+
+        spec = resolve_spec(kv_quant)
+        dt = spec.dtype
     shape = (L, num_pages + 1, page_size, KV, dk)
     cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if spec is not None:
+        sshape = (L, num_pages + 1, KV)
+        cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
     if needs_pos_cache(cfg):
         cache["pos"] = jnp.zeros((num_pages + 1, page_size), jnp.int32)
     return cache
 
 
-def paged_kv_cache_pspecs(cfg: DecoderConfig = None, *, pipeline: bool = False):
+def paged_kv_cache_pspecs(cfg: DecoderConfig = None, *, pipeline: bool = False,
+                          kv_quant: Optional[str] = None):
     """Pages shard over DP, KV heads over TP (MQA replicates, as in the
-    dense layout)."""
+    dense layout); quantized scale rows shard like their pools (pages
+    on data, KV heads on model)."""
     kv_axis = (
         None if (cfg is not None and cfg.num_key_value_heads == 1)
         else MODEL_AXIS
@@ -872,6 +888,9 @@ def paged_kv_cache_pspecs(cfg: DecoderConfig = None, *, pipeline: bool = False):
         "k": P(pp, DATA_AXIS, None, kv_axis, None),
         "v": P(pp, DATA_AXIS, None, kv_axis, None),
     }
+    if kv_quant is not None:
+        specs["k_scale"] = P(pp, DATA_AXIS, kv_axis)
+        specs["v_scale"] = P(pp, DATA_AXIS, kv_axis)
     if cfg is not None and needs_pos_cache(cfg):
         specs["pos"] = P(DATA_AXIS, None)
     return specs
@@ -884,11 +903,16 @@ def _page_lookup(page_table, cache_positions, page_size):
 
 
 def serve_block_paged(cfg, p, x, rope, bias, mask, k_pool, v_pool,
-                      phys, off, page_table, kernels: str = "xla"):
+                      phys, off, page_table, kernels: str = "xla",
+                      k_scale=None, v_scale=None, qmax=None):
     """Paged twin of :func:`serve_block`: scatter new K/V at the
     table-resolved (page, offset); attend over the virtual cache read
     through the table (``jnp.take`` gather, or the fused ragged paged
-    kernel when ``kernels='pallas'`` and no additive bias is in play)."""
+    kernel when ``kernels='pallas'`` and no additive bias is in play).
+    With ``qmax`` the pool is quantized (serve/kv_quant.py): the commit
+    quantizes in-step and reads dequantize at the page scales (fused
+    in-kernel on the Pallas path). Returns
+    ``(x, k_pool, v_pool, k_scale, v_scale)``."""
     from ..serve import kernels as _pk
 
     R, C, D = x.shape
@@ -897,14 +921,27 @@ def serve_block_paged(cfg, p, x, rope, bias, mask, k_pool, v_pool,
     if rope is not None:
         cos, sin = rope
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
-    v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
+    if qmax is not None:
+        from ..serve.kv_quant import quant_line_write
+
+        k_pool, k_scale = quant_line_write(k_pool, k_scale, phys, off, k, qmax)
+        v_pool, v_scale = quant_line_write(v_pool, v_scale, phys, off, v, qmax)
+    else:
+        k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
     if kernels == "pallas" and bias is None:
-        attn = _pk.ragged_paged_attention(q, k_pool, v_pool, page_table, mask)
+        attn = _pk.ragged_paged_attention(
+            q, k_pool, v_pool, page_table, mask,
+            k_scale=k_scale, v_scale=v_scale,
+        )
         attn = attn.reshape(R, C, -1)
     else:
-        k_virt = _pk.gather_pages(k_pool, page_table)
-        v_virt = _pk.gather_pages(v_pool, page_table)
+        if qmax is not None:
+            k_virt = _pk.dequant_pages(k_pool, k_scale, page_table, q.dtype)
+            v_virt = _pk.dequant_pages(v_pool, v_scale, page_table, q.dtype)
+        else:
+            k_virt = _pk.gather_pages(k_pool, page_table)
+            v_virt = _pk.gather_pages(v_pool, page_table)
         attn = _serve_attend(cfg, q, k_virt, v_virt, bias, mask)
     attn = _mm(attn, p["wo"])
     if cfg.out_bias:
@@ -914,10 +951,10 @@ def serve_block_paged(cfg, p, x, rope, bias, mask, k_pool, v_pool,
             h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
         else:
             h2 = h
-        return x + attn + _ffn(cfg, p, h2), k_pool, v_pool
+        return x + attn + _ffn(cfg, p, h2), k_pool, v_pool, k_scale, v_scale
     x = x + attn
     h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
-    return x + _ffn(cfg, p, h2), k_pool, v_pool
+    return x + _ffn(cfg, p, h2), k_pool, v_pool, k_scale, v_scale
 
 
 def _paged_serve_context(cfg, cache, positions, cache_positions, mask,
@@ -965,10 +1002,12 @@ def serve_step_paged(
     cache_len: int,
     all_logits: bool = False,
     kernels: str = "xla",
+    kv_quant: Optional[str] = None,
     mesh=None,
 ):
     """Paged twin of :func:`serve_step` — same contract plus the page
-    table (see models/llama.py serve_step_paged)."""
+    table (see models/llama.py serve_step_paged; ``kv_quant`` selects
+    the quantized pool layout)."""
     if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
         raise NotImplementedError(
             "paged KV serving is not composed with pipeline parallelism "
@@ -982,24 +1021,45 @@ def serve_step_paged(
         cfg, cache, positions, cache_positions, mask, page_table, cache_len
     )
 
-    def scan_body(h, xs):
-        p_l, kc, vc = xs
-        h, kc, vc = serve_block_paged(
-            cfg, p_l, h, rope, bias, mask, kc, vc, phys, off, page_table,
-            kernels,
-        )
-        return h, (kc, vc)
+    if kv_quant is not None:
+        from ..serve.kv_quant import resolve_spec
 
-    x, (k_new, v_new) = lax.scan(
-        scan_body, x, (params["layers"], cache["k"], cache["v"])
-    )
+        qmax = resolve_spec(kv_quant).qmax
+
+        def scan_body_q(h, xs):
+            p_l, kc, vc, ks, vs = xs
+            h, kc, vc, ks, vs = serve_block_paged(
+                cfg, p_l, h, rope, bias, mask, kc, vc, phys, off,
+                page_table, kernels, ks, vs, qmax,
+            )
+            return h, (kc, vc, ks, vs)
+
+        x, (k_new, v_new, ks_new, vs_new) = lax.scan(
+            scan_body_q, x,
+            (params["layers"], cache["k"], cache["v"],
+             cache["k_scale"], cache["v_scale"]),
+        )
+        new_cache = {"k": k_new, "v": v_new,
+                     "k_scale": ks_new, "v_scale": vs_new}
+    else:
+        def scan_body(h, xs):
+            p_l, kc, vc = xs
+            h, kc, vc, _, _ = serve_block_paged(
+                cfg, p_l, h, rope, bias, mask, kc, vc, phys, off,
+                page_table, kernels,
+            )
+            return h, (kc, vc)
+
+        x, (k_new, v_new) = lax.scan(
+            scan_body, x, (params["layers"], cache["k"], cache["v"])
+        )
+        new_cache = {"k": k_new, "v": v_new}
     x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
     if not all_logits:
         x = jnp.take_along_axis(x, logits_idx[:, None, None], axis=1)
         logits = _lm_logits(cfg, params, x)[:, 0]
     else:
         logits = _lm_logits(cfg, params, x)
-    new_cache = {"k": k_new, "v": v_new}
     if needs_pos_cache(cfg):
         new_cache["pos"] = pos_pool
     return logits, new_cache
@@ -1008,23 +1068,43 @@ def serve_step_paged(
 def copy_page_kv(cache, src, dst):
     """Copy one physical page's lines to another page (prefix-cache
     copy-on-write; see models.llama.copy_page_kv) — the position pool
-    pages like K/V but without the layer dim."""
+    pages like K/V but without the layer dim. Dtype-agnostic: quantized
+    pools' int8 codes and their (L, P+1, KV) scale rows copy through
+    the same pool-row scatter, so a COW'd page dequantizes identically
+    to its original."""
     out = {}
     for name, buf in cache.items():
         if name == "pos":  # (P+1, ps)
             out[name] = buf.at[dst].set(buf[src])
-        else:              # (L, P+1, ps, KV, dk)
+        else:              # (L, P+1, ps|KV, ...)
             out[name] = buf.at[:, dst].set(buf[:, src])
     return out
 
 
-def commit_kv_paged(cache, page_table, src, dst):
+def commit_kv_paged(cache, page_table, src, dst, *, kv_quant=None):
     """:func:`commit_kv` through the page table (see
     models.llama.commit_kv_paged); the position pool pages like K/V but
-    without the layer dim."""
+    without the layer dim. Quantized pools dequant-then-requant the
+    moved lines so destination page scales stay exact (the position
+    buffer still moves verbatim — it is exact int32 metadata)."""
     ps = cache["k"].shape[2]
     s_phys, s_off = _page_lookup(page_table, src, ps)
     d_phys, d_off = _page_lookup(page_table, dst, ps)
+    if kv_quant is not None:
+        from ..serve.kv_quant import quant_commit_lines, resolve_spec
+
+        qmax = resolve_spec(kv_quant).qmax
+        out = dict(cache)
+        for name in ("k", "v"):
+            out[name], out[name + "_scale"] = quant_commit_lines(
+                cache[name], cache[name + "_scale"],
+                s_phys, s_off, d_phys, d_off, qmax,
+            )
+        if "pos" in cache:
+            out["pos"] = cache["pos"].at[d_phys, d_off].set(
+                cache["pos"][s_phys, s_off]
+            )
+        return out
     out = {}
     for name, buf in cache.items():
         if name == "pos":  # (P+1, ps)
@@ -1060,6 +1140,7 @@ def serve_debug_activations(
     kernels: str = "xla",
     page_table: Optional[jnp.ndarray] = None,
     cache_len: Optional[int] = None,
+    kv_quant: Optional[str] = None,
 ):
     """Per-layer hidden-state capture for ``inference_debugging`` on the
     generic decoder — previously the hook only existed for LLaMA, making
@@ -1080,11 +1161,20 @@ def serve_debug_activations(
             cfg, cache, positions, cache_positions, mask, page_table,
             cache_len,
         )
+        qmax = None
+        if kv_quant is not None:
+            from ..serve.kv_quant import resolve_spec
+
+            qmax = resolve_spec(kv_quant).qmax
         for l in range(cfg.num_hidden_layers):
             p_l = jax.tree.map(lambda a: a[l], params["layers"])
-            x, _, _ = serve_block_paged(
+            x, *_ = serve_block_paged(
                 cfg, p_l, x, rope, bias, mask,
                 cache["k"][l], cache["v"][l], phys, off, page_table,
+                "xla",
+                cache["k_scale"][l] if qmax is not None else None,
+                cache["v_scale"][l] if qmax is not None else None,
+                qmax,
             )
             acts.append(x)
         return acts

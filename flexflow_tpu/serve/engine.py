@@ -81,6 +81,16 @@ class ServingConfig:
     # allocated lazily). Set it below the worst case to oversubscribe:
     # the RequestManager preempts (recompute-on-readmit) on exhaustion.
     max_cached_tokens: Optional[int] = None
+    # Quantized paged KV pages (serve/kv_quant.py; paged layout only).
+    # "int8": pages store int8 codes + per-page-per-KV-head f32 amax
+    # scales; serve_step's KV write quantizes in the step and attention
+    # dequantizes at read time (fused into the Pallas ragged paged
+    # kernel), so full-precision K/V never round-trip HBM. The
+    # max_cached_tokens budget keeps meaning "this much KV HBM": the
+    # same budget buys ~2x the pages (kv_quant.quantized_pool_pages).
+    # "int4" is a designed-for layout that raises NotImplementedError.
+    # None (default) = full-precision cache_dtype pages.
+    kv_quant: Optional[str] = None
     # Automatic prefix caching (serve/prefix_cache.py, paged layout
     # only — a no-op passthrough on dense): finished requests' prompt
     # pages stay live in a radix tree; a new request whose prompt shares
@@ -211,6 +221,18 @@ class InferenceEngine:
                 f"unknown kv_layout {self.serving.kv_layout!r} "
                 "(expected 'dense' or 'paged')"
             )
+        # Quantized KV pages (serve/kv_quant.py): validated up front so
+        # a bad value fails at engine construction, not mid-serve.
+        self.kv_quant_spec = None
+        if self.serving.kv_quant is not None:
+            if not self.paged:
+                raise ValueError(
+                    "kv_quant requires kv_layout='paged' — the dense "
+                    "layout has no per-page scale granularity"
+                )
+            from .kv_quant import resolve_spec
+
+            self.kv_quant_spec = resolve_spec(self.serving.kv_quant)
         if self.serving.cache_policy not in ("complete", "prefill"):
             raise ValueError(
                 f"unknown cache_policy {self.serving.cache_policy!r} "
@@ -252,6 +274,21 @@ class InferenceEngine:
             from .paging import PageAllocator
 
             num_pages = sc.num_pages
+            if self.kv_quant_spec is not None and sc.max_cached_tokens is not None:
+                # bytes-per-page accounting (serve/kv_quant.py): the
+                # max_cached_tokens budget is an HBM budget priced at
+                # cache_dtype — int8 pages cost ~half the bytes, so the
+                # same budget exposes ~2x the pages to the allocator
+                from .kv_quant import quantized_pool_pages
+
+                num_pages = quantized_pool_pages(
+                    num_pages,
+                    sc.page_size,
+                    self.cfg.num_key_value_heads,
+                    self.cfg.head_dim,
+                    jnp.dtype(sc.cache_dtype).itemsize,
+                    self.kv_quant_spec,
+                )
             data = self.mesh.shape.get(DATA_AXIS, 1)
             if data > 1:
                 # pool rows (num_pages + scratch) shard over data —
@@ -268,8 +305,11 @@ class InferenceEngine:
                 num_pages,
                 sc.page_size,
                 sc.cache_dtype,
+                kv_quant=sc.kv_quant,
             )
-            pspec_fn = self.model.paged_kv_cache_pspecs
+            pspec_fn = functools.partial(
+                self.model.paged_kv_cache_pspecs, kv_quant=sc.kv_quant
+            )
         else:
             init = functools.partial(
                 self.model.init_kv_cache,
@@ -311,10 +351,16 @@ class InferenceEngine:
         return sum(int(leaf.nbytes) for leaf in jax.tree.leaves(self.cache))
 
     def kv_bytes_per_line(self) -> float:
-        """K+V bytes one cached token line costs across all layers."""
+        """K+V bytes one cached token line costs across all layers —
+        quantized pools amortize their per-page f32 scale rows into the
+        per-line figure, so the metric stays an honest HBM cost."""
         k, v = self.cache["k"], self.cache["v"]
         lines = k.shape[1] * k.shape[2]  # slots×(len+1) or pages×page_size
-        return (int(k.nbytes) + int(v.nbytes)) / lines
+        total = int(k.nbytes) + int(v.nbytes)
+        for name in ("k_scale", "v_scale"):
+            if name in self.cache:
+                total += int(self.cache[name].nbytes)
+        return total / lines
 
     def kv_allocated_bytes(self) -> int:
         """Bytes of KV HBM backing ALLOCATED pages (paged layout): the
@@ -373,6 +419,8 @@ class InferenceEngine:
             kw["mesh"] = self.mesh
         if self.paged:
             kw["cache_len"] = self.serving.cache_len
+            if self.serving.kv_quant is not None:
+                kw["kv_quant"] = self.serving.kv_quant
             return functools.partial(self.model.serve_step_paged, **kw)
         return functools.partial(self.model.serve_step, **kw)
 
@@ -645,6 +693,8 @@ class InferenceEngine:
         if self.paged:
             kw["page_table"] = self.page_table_device()
             kw["cache_len"] = self.serving.cache_len
+            if self.serving.kv_quant is not None:
+                kw["kv_quant"] = self.serving.kv_quant
         acts = fn(
             self.params, self.cache, jnp.asarray(bc.tokens, dtype=jnp.int32),
             jnp.asarray(bc.positions, dtype=jnp.int32),
@@ -750,8 +800,16 @@ class InferenceEngine:
         """Move accepted speculative cache lines to committed positions
         (src/dst (R, K); unused entries scratch→scratch)."""
         if self._commit is None:
-            fn = (self.model.commit_kv_paged if self.paged
-                  else self.model.commit_kv)
+            if self.paged:
+                fn = self.model.commit_kv_paged
+                if self.serving.kv_quant is not None:
+                    # quantized pools dequant/requant moved lines at the
+                    # page scales (models/*.commit_kv_paged)
+                    fn = functools.partial(
+                        fn, kv_quant=self.serving.kv_quant
+                    )
+            else:
+                fn = self.model.commit_kv
             self._commit = self._jit(fn, key="commit", donate_argnums=(0,))
         donated = self.cache
         with _set_mesh(self.mesh):

@@ -330,6 +330,26 @@ def gather_pages(pool: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
     return flat.reshape((R, NP * ps) + pool.shape[2:])
 
 
+def dequant_pages(
+    pool: jnp.ndarray,        # (P+1, ps, KV, dk) int8 codes
+    scale: jnp.ndarray,       # (P+1, KV) f32 per-page-per-head scales
+    page_table: jnp.ndarray,  # (R, NP) int32
+    dtype,
+) -> jnp.ndarray:
+    """Quantized twin of :func:`gather_pages`: gather the int8 virtual
+    cache through the table and dequantize each line at its page's
+    per-KV-head scale (serve/kv_quant.py layout). Returns the
+    (R, NP*ps, KV, dk) full-precision virtual cache in ``dtype``."""
+    R, NP = page_table.shape
+    ps, KV = pool.shape[1], pool.shape[2]
+    codes = gather_pages(pool, page_table)        # (R, S, KV, dk) int8
+    s = jnp.take(scale, page_table.reshape(-1), axis=0)  # (R*NP, KV)
+    s = jnp.broadcast_to(
+        s.reshape(R, NP, 1, KV), (R, NP, ps, KV)
+    ).reshape(R, NP * ps, KV)
+    return (codes.astype(jnp.float32) * s[..., None]).astype(dtype)
+
+
 def ragged_paged_attention_xla(
     q: jnp.ndarray,           # (R, C, H, dk)
     k_pool: jnp.ndarray,      # (P+1, ps, KV, dk)
@@ -338,17 +358,25 @@ def ragged_paged_attention_xla(
     mask: jnp.ndarray,        # (R, C, NP*ps) bool
     *,
     scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # (P+1, KV) f32 (quantized pool)
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Shape-identical XLA fallback: gather the virtual cache through
     the page table, then the standard grouped-query masked softmax —
     bit-for-bit the dense ``serve_attention`` math on the gathered
-    lines. Returns (R, C, H, dk)."""
+    lines. With ``k_scale``/``v_scale`` the pools hold int8 codes
+    (serve/kv_quant.py) and the gathered lines are dequantized at their
+    page scales first. Returns (R, C, H, dk)."""
     R, C, H, dk = q.shape
     KV = k_pool.shape[2]
     G = H // KV
     scale = scale if scale is not None else 1.0 / math.sqrt(dk)
-    k_virt = gather_pages(k_pool, page_table)  # (R, S, KV, dk)
-    v_virt = gather_pages(v_pool, page_table)
+    if k_scale is not None:
+        k_virt = dequant_pages(k_pool, k_scale, page_table, q.dtype)
+        v_virt = dequant_pages(v_pool, v_scale, page_table, q.dtype)
+    else:
+        k_virt = gather_pages(k_pool, page_table)  # (R, S, KV, dk)
+        v_virt = gather_pages(v_pool, page_table)
     qg = q.reshape(R, C, KV, G, dk)
     scores = jnp.einsum(
         "rckgd,rskd->rkgcs", qg, k_virt, preferred_element_type=jnp.float32
@@ -418,6 +446,76 @@ def _ragged_paged_kernel(
         out_ref[0] = (o_scr[:] / l[..., None]).astype(out_ref.dtype)
 
 
+def _ragged_paged_quant_kernel(
+    pt_ref,       # scalar-prefetch: (R, NP) int32 page table
+    q_ref,        # (1, C, KV, G, dk)
+    k_ref,        # (1, ps, KV, dk) int8 — physical page via index map
+    v_ref,        # (1, ps, KV, dk) int8
+    ks_ref,       # (1, KV) f32 — the page's K scales (same index map)
+    vs_ref,       # (1, KV) f32
+    mask_ref,     # (1, C, ps)
+    out_ref,      # (1, C, KV, G, dk)
+    o_scr,        # VMEM (C, KV, G, dk) f32
+    m_scr,        # VMEM (C, KV, G) f32
+    l_scr,        # VMEM (C, KV, G) f32
+    *,
+    scale: float,
+):
+    """Quantized twin of :func:`_ragged_paged_kernel`: the page DMA
+    moves int8 codes (half the bf16 bytes — the whole point), and the
+    per-page-per-head dequant scales fold into the batched dots'
+    OUTPUTS (scores ×= k_scale[kv], pv ×= v_scale[kv]) rather than
+    materialising a dequantized (ps, KV, dk) block — scales are
+    constant within a page, so scaling the O(C·G·ps) scores and
+    O(C·G·dk) pv is exact and strictly cheaper than scaling the
+    O(ps·dk) operands."""
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _():
+        o_scr[:] = jnp.zeros_like(o_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    mask = mask_ref[0]  # (C, ps)
+
+    @pl.when(jnp.any(mask))
+    def _():
+        q = q_ref[0].astype(jnp.float32)            # (C, KV, G, dk)
+        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # (KV, ps, dk)
+        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+        ks = ks_ref[0]                              # (KV,)
+        vs = vs_ref[0]
+        C, KV, G = q.shape[0], q.shape[1], q.shape[2]
+        qkv = q.transpose(1, 0, 2, 3).reshape(KV, C * G, q.shape[-1])
+        scores = jax.lax.dot_general(
+            qkv, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * (ks[:, None, None] * scale)             # dequant K via scores
+        scores = scores.reshape(KV, C, G, -1).transpose(1, 0, 2, 3)
+        scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+        m_new = jnp.maximum(m_scr[:], scores.max(axis=-1))
+        prob = jnp.exp(scores - m_new[..., None])
+        prob = jnp.where(mask[:, None, None, :], prob, 0.0)
+        corr = jnp.exp(m_scr[:] - m_new)
+        l_scr[:] = l_scr[:] * corr + prob.sum(axis=-1)
+        pk = prob.transpose(1, 0, 2, 3).reshape(KV, C * G, -1)
+        pv = jax.lax.dot_general(
+            pk, v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * vs[:, None, None]                       # dequant V via pv
+        pv = pv.reshape(KV, C, G, -1).transpose(1, 0, 2, 3)
+        o_scr[:] = o_scr[:] * corr[..., None] + pv
+        m_scr[:] = m_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-20)
+        out_ref[0] = (o_scr[:] / l[..., None]).astype(out_ref.dtype)
+
+
 def ragged_paged_attention(
     q: jnp.ndarray,           # (R, C, H, dk)
     k_pool: jnp.ndarray,      # (P+1, ps, KV, dk)
@@ -426,14 +524,19 @@ def ragged_paged_attention(
     mask: jnp.ndarray,        # (R, C, NP*ps) bool
     *,
     scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # (P+1, KV) f32 (quantized pool)
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Fused ragged paged attention: grid (request, logical page); the
     K/V BlockSpec index maps read the scalar-prefetched page table so
     each step DMAs exactly the physical page that logical position maps
     to — gathering through the table without materialising the
     (R, S) virtual cache. One kernel covers decode (C=1), chunked
-    prefill and tree verify (the explicit-mask modes). Returns
-    (R, C, H, dk)."""
+    prefill and tree verify (the explicit-mask modes). With
+    ``k_scale``/``v_scale`` the pools hold int8 codes and the same
+    index maps additionally DMA each page's per-KV-head scales; dequant
+    happens in VMEM (:func:`_ragged_paged_quant_kernel`) so the
+    full-precision cache never exists in HBM. Returns (R, C, H, dk)."""
     R, C, H, dk = q.shape
     _, ps, KV, _ = k_pool.shape
     NP = page_table.shape[1]
@@ -442,22 +545,37 @@ def ragged_paged_attention(
     qg = q.reshape(R, C, KV, G, dk)
     grid = (R, NP)
 
+    in_specs = [
+        pl.BlockSpec((1, C, KV, G, dk),
+                     lambda r, p, pt: (r, 0, 0, 0, 0)),
+        # the paged gather: block row = page_table[r, p]
+        pl.BlockSpec((1, ps, KV, dk),
+                     lambda r, p, pt: (pt[r, p], 0, 0, 0)),
+        pl.BlockSpec((1, ps, KV, dk),
+                     lambda r, p, pt: (pt[r, p], 0, 0, 0)),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if k_scale is not None:
+        kernel = functools.partial(_ragged_paged_quant_kernel, scale=scale)
+        in_specs += [
+            pl.BlockSpec((1, KV), lambda r, p, pt: (pt[r, p], 0)),
+            pl.BlockSpec((1, KV), lambda r, p, pt: (pt[r, p], 0)),
+        ]
+        operands += [
+            k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)
+        ]
+    else:
+        kernel = functools.partial(_ragged_paged_kernel, scale=scale)
+    in_specs.append(pl.BlockSpec((1, C, ps), lambda r, p, pt: (r, 0, p)))
+    operands.append(mask)
+
     out = pl.pallas_call(
-        functools.partial(_ragged_paged_kernel, scale=scale),
+        kernel,
         out_shape=jax.ShapeDtypeStruct((R, C, KV, G, dk), q.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, C, KV, G, dk),
-                             lambda r, p, pt: (r, 0, 0, 0, 0)),
-                # the paged gather: block row = page_table[r, p]
-                pl.BlockSpec((1, ps, KV, dk),
-                             lambda r, p, pt: (pt[r, p], 0, 0, 0)),
-                pl.BlockSpec((1, ps, KV, dk),
-                             lambda r, p, pt: (pt[r, p], 0, 0, 0)),
-                pl.BlockSpec((1, C, ps), lambda r, p, pt: (r, 0, p)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, C, KV, G, dk), lambda r, p, pt: (r, 0, 0, 0, 0)
             ),
@@ -468,5 +586,5 @@ def ragged_paged_attention(
             ],
         ),
         interpret=_interpret(),
-    )(page_table.astype(jnp.int32), qg, k_pool, v_pool, mask)
+    )(page_table.astype(jnp.int32), *operands)
     return out.reshape(R, C, H, dk)

@@ -1,0 +1,232 @@
+"""Quantized paged KV cache — write-side math + layout registry.
+
+FlexFlow Serve ships int4/int8 quantization as a first-class serving
+feature (SURVEY.md, ``--4bit/8bit-quantization``); this repo already
+quantizes *weights* (flexflow_tpu/quantization.py). KV-cache
+quantization is the other half of the byte budget: at high concurrency
+the paged pool (serve/paging.py) is what gates both pool capacity and
+decode read bandwidth, so storing pages as int8 doubles the pages a
+fixed HBM budget holds and halves the KV bytes the decode hot loop
+streams (the EQuARX observation — arxiv 2506.17615 — applied to cache
+reads instead of collectives).
+
+Layout
+------
+A quantized page pool stores, per cache tensor (K and V):
+
+* ``(L, num_pages+1, page_size, KV, dk)`` **int8** codes in place of
+  the bf16/f32 pool, and
+* ``(L, num_pages+1, KV)`` **float32** scales — one symmetric amax
+  scale per page per KV head (``k_scale``/``v_scale`` cache keys).
+
+Dequantization happens *inside* attention (serve/kernels.py: the fused
+Pallas ragged-paged kernel multiplies per-page scales into the
+QK^T scores and the PV product; the XLA fallback dequantizes the
+gathered virtual cache) — full-precision K/V never round-trip HBM.
+
+Write-side contract (:func:`quant_line_write`)
+----------------------------------------------
+``serve_step``'s KV commit quantizes in the jitted step itself:
+
+1. **amax scaling at commit time.** Each page's scale is the running
+   amax (per KV head) of every line committed to it, divided by qmax.
+2. **Rescale on growth.** When a new line's amax exceeds the page's
+   scale, the page's existing codes are requantized to the new scale
+   (``round(q * s_old / s_new)``) so one scale stays exact for the
+   whole page. When the scale is unchanged the ratio is exactly 1.0
+   and the rewrite is a bitwise identity.
+3. **History independence.** A write at in-page offset 0 is by
+   construction the first line a slot commits to that physical page
+   (cache lines fill pages front to back; spliced prefix-cache pages
+   are never written, and a COW'd tail page continues at offset > 0),
+   so it RESETS the page's scale instead of inheriting a stale amax
+   from the page's previous occupant. Quantized page content is
+   therefore a pure function of the tokens written, never of
+   allocation history — which is what keeps run-to-run generation
+   bitwise deterministic and preemption/recompute parity exact.
+
+int4 is a designed-for layout (``SPECS["int4"]``: two codes per byte
+packed along dk, qmax 7) whose in-kernel unpack is not implemented yet
+— :func:`resolve_spec` raises ``NotImplementedError`` for it so the
+reservation can't be silently half-used.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class KVQuantSpec:
+    """One quantized-KV storage layout (see module docstring)."""
+
+    name: str
+    bits: int
+    qmax: float       # symmetric clip: codes live in [-qmax, qmax]
+    dtype: Any        # storage dtype of the page pool
+    pack: int = 1     # codes per storage element (int4 packs 2 along dk)
+
+    @property
+    def itemsize(self) -> int:
+        return jnp.dtype(self.dtype).itemsize
+
+
+SPECS = {
+    "int8": KVQuantSpec("int8", 8, 127.0, jnp.int8, 1),
+    # Reserved layout: nibbles packed along dk (low nibble = even dk
+    # rows, biased like quantization.py's int4 weights). The page/scale
+    # shapes and byte accounting below already handle pack=2; the
+    # kernel-side unpack is what's missing.
+    "int4": KVQuantSpec("int4", 4, 7.0, jnp.uint8, 2),
+}
+
+
+def resolve_spec(kv_quant: Optional[str]) -> Optional[KVQuantSpec]:
+    """Validate a ``ServingConfig.kv_quant`` value. None passes
+    through; unknown names are a ValueError; designed-but-unimplemented
+    layouts (int4) raise NotImplementedError rather than producing a
+    pool no kernel can read."""
+    if kv_quant is None:
+        return None
+    spec = SPECS.get(kv_quant)
+    if spec is None:
+        raise ValueError(
+            f"unknown kv_quant {kv_quant!r} (expected one of "
+            f"{sorted(SPECS)} or None)"
+        )
+    if spec.pack != 1:
+        raise NotImplementedError(
+            "kv_quant='int4' is a designed-for layout (packed nibbles "
+            "along dk, qmax 7) whose in-kernel unpack is not implemented "
+            "yet — use kv_quant='int8'"
+        )
+    return spec
+
+
+def quant_line_write(
+    kq: jnp.ndarray,     # (P+1, ps, KV, dk) quantized page pool (one layer)
+    scale: jnp.ndarray,  # (P+1, KV) f32 per-page-per-head scales
+    phys: jnp.ndarray,   # (R, C) int32 physical page per new line
+    off: jnp.ndarray,    # (R, C) int32 in-page offset per new line
+    vals: jnp.ndarray,   # (R, C, KV, dk) full-precision lines to commit
+    qmax: float,
+):
+    """Commit full-precision K/V lines into a quantized page pool
+    (the quantized twin of ``pool.at[phys, off].set(...)``) — running
+    per-page amax scales, rescale-on-growth, offset-0 scale reset; see
+    the module docstring for the contract. Returns ``(kq, scale)``.
+
+    Duplicate page indices are safe throughout: the scale update is a
+    commutative scatter-max, and every rescale scatter writes values
+    that depend only on the page, so colliding writes are identical.
+    Shared (refcounted > 1) pages are never the target of a line write
+    — the prefix cache COWs the tail page before any slot appends — so
+    rescaling page content in place cannot perturb another reader.
+    """
+    P1, ps, KV, dk = kq.shape
+    R, C = phys.shape
+    vf = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(vf), axis=-1)  # (R, C, KV)
+
+    # offset-0 writes mark the page's first use by its current owner:
+    # drop the previous occupant's stale amax (history independence)
+    first = jnp.zeros((P1,), jnp.int32).at[phys.reshape(-1)].max(
+        (off.reshape(-1) == 0).astype(jnp.int32)
+    )
+    old = jnp.where(first[:, None] > 0, 0.0, scale)     # (P1, KV)
+    new = old.at[phys].max(amax / qmax)                 # (P1, KV)
+
+    # Rescale existing codes of every touched page to the grown scale
+    # (identity when the scale did not move). Below the crossover the
+    # per-line page gather is cheaper; past it (wide prefill chunks
+    # touching few distinct pages many times) the full-pool elementwise
+    # form does strictly less work than R*C duplicate page gathers.
+    if R * C < P1:
+        pages = phys.reshape(-1)                        # (R*C,)
+        ratio = jnp.where(
+            new[pages] > 0.0,
+            old[pages] / jnp.maximum(new[pages], 1e-30),
+            0.0,
+        )                                               # (R*C, KV)
+        content = kq[pages].astype(jnp.float32)         # (R*C, ps, KV, dk)
+        requant = jnp.round(content * ratio[:, None, :, None])
+        kq = kq.at[pages].set(requant.astype(kq.dtype))
+    else:
+        ratio = jnp.where(
+            new > 0.0, old / jnp.maximum(new, 1e-30), 0.0
+        )                                               # (P1, KV)
+        requant = jnp.round(kq.astype(jnp.float32) * ratio[:, None, :, None])
+        kq = requant.astype(kq.dtype)
+
+    # quantize the new lines at their page's (final) scale and scatter
+    s_line = new[phys]                                  # (R, C, KV)
+    q = jnp.round(vf / jnp.maximum(s_line[..., None], 1e-30))
+    q = jnp.clip(q, -qmax, qmax).astype(kq.dtype)
+    kq = kq.at[phys, off].set(q)
+    return kq, new
+
+
+def quant_commit_lines(
+    buf: jnp.ndarray,     # (L, P+1, ps, KV, dk) quantized pool
+    scale: jnp.ndarray,   # (L, P+1, KV) f32
+    s_phys: jnp.ndarray,  # (R, K) source physical pages
+    s_off: jnp.ndarray,   # (R, K) source in-page offsets
+    d_phys: jnp.ndarray,  # (R, K) destination physical pages
+    d_off: jnp.ndarray,   # (R, K) destination in-page offsets
+    qmax: float,
+):
+    """Move quantized lines between table-resolved positions (the
+    SpecInfer KV commit, models/*.commit_kv_paged): dequantize the
+    source lines at their page scales, then re-commit them through
+    :func:`quant_line_write` so destination page scales stay exact
+    (codes cannot move between pages verbatim — the pages' scales
+    differ). Vectorized over the layer dim. Returns ``(buf, scale)``."""
+    rows = buf[:, s_phys, s_off].astype(jnp.float32)    # (L, R, K, KV, dk)
+    rows = rows * scale[:, s_phys][..., None]           # dequant at src scale
+    return jax.vmap(
+        lambda b, s, r: quant_line_write(b, s, d_phys, d_off, r, qmax)
+    )(buf, scale, rows)
+
+
+def page_bytes(
+    page_size: int,
+    kv_heads: int,
+    head_dim: int,
+    itemsize: int,
+    *,
+    scale_heads: int = 0,
+) -> int:
+    """K+V bytes one physical page costs per layer: two pools of
+    ``page_size × kv_heads × head_dim`` elements plus (quantized
+    layouts) two f32 scale rows of ``scale_heads`` entries."""
+    return 2 * (page_size * kv_heads * head_dim * itemsize
+                + 4 * scale_heads)
+
+
+def quantized_pool_pages(
+    fp_pages: int,
+    page_size: int,
+    kv_heads: int,
+    head_dim: int,
+    fp_itemsize: int,
+    spec: KVQuantSpec,
+) -> int:
+    """Bytes-per-page accounting: the number of QUANTIZED pages the HBM
+    budget of ``fp_pages`` full-precision pages buys. This is how
+    ``ServingConfig.max_cached_tokens`` keeps meaning "this much KV
+    HBM" with ``kv_quant`` on — the same budget simply holds ~2x the
+    pages (int8 vs bf16; the per-page f32 scales cost
+    ``8·KV / (2·KV·dk·itemsize)`` of a page, well under 1% at real
+    head dims, which is why the ratio lands at ≥1.9x rather than
+    exactly 2x)."""
+    budget = fp_pages * page_bytes(page_size, kv_heads, head_dim,
+                                   fp_itemsize)
+    # pack>1 stores several codes per element along dk
+    qpage = page_bytes(
+        page_size, kv_heads, -(-head_dim // spec.pack), spec.itemsize,
+        scale_heads=kv_heads,
+    )
+    return max(fp_pages, budget // qpage)

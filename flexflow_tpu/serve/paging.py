@@ -10,6 +10,20 @@ tokens rounded up to the page size — not to the worst-case sequence
 length, which is what lets serving run the reference's 64 request slots
 on one chip (VERDICT.md round 5, missing #3).
 
+HBM accounting: one page costs ``2 · page_size · KV · dk ·
+itemsize(cache_dtype)`` bytes per layer (K and V), and
+``ServingConfig.max_cached_tokens`` prices the pool in those units —
+it is an HBM budget expressed as full-precision tokens. With
+``ServingConfig.kv_quant`` (serve/kv_quant.py) pages store int8 codes
+plus two per-page f32 scale rows (``8·KV`` bytes — under 1% of a page
+at real head dims), so the SAME budget buys ~2x the physical pages
+(``kv_quant.quantized_pool_pages`` converts; the engine sizes this
+allocator with the converted count). The allocator itself is
+dtype-blind — it hands out page INDICES; every invariant below holds
+identically over bf16, f32 and quantized pools (asserted by the
+randomized property test in tests/test_paged_kv.py, which runs the
+same sweep over a quantized engine's pool).
+
 Pages are **reference counted** so the automatic prefix cache
 (serve/prefix_cache.py) can keep a finished request's prompt pages
 alive and splice them into later requests' tables: a physical page may

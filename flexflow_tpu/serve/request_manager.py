@@ -379,7 +379,15 @@ class RequestManager:
                 f"capacity ({sc.cache_len} lines)"
             )
         if self._paged:
-            if sc.max_cached_tokens is not None and need > sc.max_cached_tokens:
+            # with kv_quant the max_cached_tokens budget is an HBM
+            # budget that buys ~2x the pages — the allocator's actual
+            # capacity (checked below) is the authoritative bound, and
+            # the raw token figure would wrongly reject servable prompts
+            if (
+                sc.max_cached_tokens is not None
+                and sc.kv_quant is None
+                and need > sc.max_cached_tokens
+            ):
                 return (
                     f"prompt ({len(req.tokens)} tokens) can never fit the "
                     f"configured KV budget (max_cached_tokens="

@@ -34,6 +34,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # sites) plus the param/config helpers every family ships. THIS list is
 # the source of truth — extend it when the engine starts calling a new
 # model hook, and the test fails on any family that lags.
+#
+# Hooks can also grow NEW KEYWORD ARGUMENTS without growing the list:
+# the quantized paged KV cache (PR 5, serve/kv_quant.py) extended
+# init_paged_kv_cache / paged_kv_cache_pspecs / serve_step_paged /
+# commit_kv_paged / serve_debug_activations with ``kv_quant=...``
+# rather than adding symbols — family modules re-export transformer.py's
+# functions BY REFERENCE, so kwargs ride along automatically and only
+# genuinely new attribute names need an entry here. The meta-check in
+# tests/test_family_reexports.py cross-checks every ``.model.<name>``
+# access across the whole serve package (engine.py is merely where they
+# all live today) against this list.
 SERVE_API = (
     # dense serving
     "init_kv_cache",
@@ -41,7 +52,8 @@ SERVE_API = (
     "serve_step",
     "commit_kv",
     "reorder_slots",
-    # paged serving (PR 1) + prefix-cache COW (PR 3)
+    # paged serving (PR 1) + prefix-cache COW (PR 3); the quantized
+    # pool (PR 5) reuses these same entry points via kv_quant kwargs
     "init_paged_kv_cache",
     "paged_kv_cache_pspecs",
     "serve_step_paged",

@@ -134,17 +134,33 @@ class TestPageAllocator:
 
 
 class TestAllocatorProperty:
-    def test_randomized_interleavings_keep_invariants(self):
+    @pytest.mark.parametrize("pool", ["fp", "int8"])
+    def test_randomized_interleavings_keep_invariants(self, tiny, pool):
         """Random admit/grow/share(attach)/COW/insert/release
         interleavings across 64 slots: after EVERY step the pool must
         hold no leak, no double-free, and refcount-zero-iff-free
         (check_no_leaks audits all three against the slot tables plus
-        the prefix tree's external refs)."""
+        the prefix tree's external refs). The ``int8`` variant runs the
+        SAME sweep over a quantized engine's allocator — the pool the
+        bytes-per-page accounting sized (serve/kv_quant.py) — because
+        the invariants are dtype-independent: the allocator hands out
+        page indices, never bytes."""
         from flexflow_tpu.serve.prefix_cache import PrefixCache
 
         rng = np.random.default_rng(1234)
         slots, ps, pps = 64, 4, 6
-        pa = PageAllocator(160, pps, slots, ps)
+        if pool == "fp":
+            pa = PageAllocator(160, pps, slots, ps)
+        else:
+            # page_size=4, cache_len+1 = 24 -> pages_per_slot = 6; the
+            # 164-token f32 budget converts to ~160 int8 pages
+            eng = make_engine(
+                tiny, "paged", slots=slots, page_size=ps, max_seq=19,
+                spec_slack=4, kv_quant="int8", max_cached_tokens=164,
+            )
+            pa = eng.pager
+            assert pa.pages_per_slot == pps
+            assert pa.num_pages >= 150  # the budget bought ~3.9x pages
         cache = PrefixCache(pa, copy_page=None)  # bookkeeping-only COW
         pa.reclaim_cb = cache.reclaim
         max_lines = pps * ps
@@ -403,13 +419,50 @@ class TestRaggedKernel:
                 np.asarray(got), np.asarray(want), atol=1e-2, rtol=1e-2
             )
 
-    def test_paged_pallas_serving_matches_xla(self, tiny):
+    def test_quantized_pallas_matches_xla_fallback(self):
+        """Quantized-path kernel parity: the dequant-fused Pallas
+        kernel (per-page scales DMA'd through the same table index
+        maps, dequant folded into the score/pv products) must match the
+        dequantize-then-attend XLA fallback over random int8 pools."""
+        from flexflow_tpu.serve import kernels as K
+
+        rng = np.random.default_rng(7)
+        for C in (1, 4):
+            R, H, KV, dk, P1, ps, NP = 3, 8, 4, 16, 9, 16, 4
+            q = jnp.asarray(rng.normal(size=(R, C, H, dk)), jnp.float32)
+            kp = jnp.asarray(
+                rng.integers(-127, 128, size=(P1, ps, KV, dk)), jnp.int8
+            )
+            vp = jnp.asarray(
+                rng.integers(-127, 128, size=(P1, ps, KV, dk)), jnp.int8
+            )
+            ks = jnp.asarray(rng.random(size=(P1, KV)) * 0.02, jnp.float32)
+            vs = jnp.asarray(rng.random(size=(P1, KV)) * 0.02, jnp.float32)
+            pt = jnp.asarray(rng.integers(0, P1, size=(R, NP)), jnp.int32)
+            mask = jnp.asarray(rng.random(size=(R, C, NP * ps)) < 0.4)
+            mask = mask.at[:, :, 0].set(True)
+            got = K.ragged_paged_attention(
+                q, kp, vp, pt, mask, k_scale=ks, v_scale=vs
+            )
+            want = K.ragged_paged_attention_xla(
+                q, kp, vp, pt, mask, k_scale=ks, v_scale=vs
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-2, rtol=1e-2
+            )
+
+    @pytest.mark.parametrize("kv_quant", [None, "int8"])
+    def test_paged_pallas_serving_matches_xla(self, tiny, kv_quant):
         """End-to-end: kernels='pallas' on a paged engine decodes the
-        same tokens as the XLA gather path."""
+        same tokens as the XLA gather path (quantized pool included —
+        the fused kernel dequantizes in VMEM, the fallback in HBM, and
+        both must pick the same greedy tokens)."""
         prompts = [[3, 17, 91, 42, 7], [9, 8, 7, 6, 5]]
         outs = {}
         for kern in ("xla", "pallas"):
-            rm = RequestManager(make_engine(tiny, "paged", kernels=kern))
+            rm = RequestManager(
+                make_engine(tiny, "paged", kernels=kern, kv_quant=kv_quant)
+            )
             outs[kern] = [
                 o.output_tokens
                 for o in rm.generate(prompts, max_new_tokens=8)

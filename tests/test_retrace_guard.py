@@ -40,15 +40,22 @@ def tiny():
 
 def churn_engine(tiny, kv_layout, sanitizers):
     """64 slots; paged adds a TIGHT pool (preemption under load) plus
-    prefix caching (splice/eviction/COW churn)."""
+    prefix caching (splice/eviction/COW churn). ``paged-q`` is the
+    int8-KV variant: the f32 budget is cut to a quarter so the ~3.9x
+    page multiplier of the quantized accounting lands the pool at the
+    same page count — same churn, quantized pages."""
     cfg, params = tiny
     kw = {}
-    if kv_layout == "paged":
+    if kv_layout in ("paged", "paged-q"):
         kw.update(
             page_size=8,
-            max_cached_tokens=64 * 24,
+            max_cached_tokens=(
+                64 * 24 if kv_layout == "paged" else 64 * 6
+            ),
             prefix_caching=True,
         )
+        if kv_layout == "paged-q":
+            kw["kv_quant"] = "int8"
     sc = ServingConfig(
         max_requests_per_batch=64,
         max_sequence_length=48,
@@ -56,7 +63,7 @@ def churn_engine(tiny, kv_layout, sanitizers):
         max_tokens_per_step=4,
         max_spec_tree_tokens=8,
         cache_dtype=jnp.float32,
-        kv_layout=kv_layout,
+        kv_layout="paged" if kv_layout == "paged-q" else kv_layout,
         sanitizers=sanitizers,
         **kw,
     )
@@ -90,12 +97,12 @@ def run_churn(rm, prompts):
 # the churn invariant: one compile per step key, zero recompiles
 
 
-@pytest.mark.parametrize("kv_layout", ["paged", "dense"])
+@pytest.mark.parametrize("kv_layout", ["paged", "paged-q", "dense"])
 def test_churn_one_compile_per_step_key(tiny, kv_layout):
     cfg, _ = tiny
     eng = churn_engine(tiny, kv_layout, sanitizers=("retrace", "donation"))
     rm = RequestManager(eng)
-    prompts = churn_prompts(cfg, n=96 if kv_layout == "paged" else 80)
+    prompts = churn_prompts(cfg, n=96 if kv_layout != "dense" else 80)
     outs = run_churn(rm, prompts)
     assert all(len(o) == 6 for o in outs)
 
@@ -103,7 +110,7 @@ def test_churn_one_compile_per_step_key(tiny, kv_layout):
     # paged additionally preempts, splices, COWs and evicts)
     s = rm.stats
     assert s.admitted >= len(prompts)
-    if kv_layout == "paged":
+    if kv_layout != "dense":
         assert s.preemptions > 0, "pool never exhausted — churn too soft"
         assert s.prefix_hits > 0 and s.prefix_cows > 0 and s.prefix_evictions > 0
 
@@ -115,8 +122,15 @@ def test_churn_one_compile_per_step_key(tiny, kv_layout):
     C = eng.serving.mixed_chunk
     assert counts.get(("mixed_fused", C, False)) == 1, counts
     assert counts.get(("mixed_fused", 1, False)) == 1, counts
-    if kv_layout == "paged":
+    if kv_layout != "dense":
         assert counts.get("copy_page") == 1, counts
+        # quantizing the pool adds NO step programs: the quant write and
+        # in-kernel dequant live inside the same jitted steps, so the
+        # step-key set is identical with kv_quant on and off
+        assert set(counts) == {
+            ("mixed_fused", C, False), ("mixed_fused", 1, False),
+            "copy_page",
+        }, counts
     # compile telemetry mirrored into the scheduler stats
     assert s.compiles == guard.total_compiles
     assert s.retraces == 0
@@ -124,19 +138,21 @@ def test_churn_one_compile_per_step_key(tiny, kv_layout):
     assert eng.donation_sanitizer.n_poisoned > 0
 
 
-def test_sanitizers_do_not_change_outputs(tiny):
+@pytest.mark.parametrize("kv_layout", ["paged", "paged-q"])
+def test_sanitizers_do_not_change_outputs(tiny, kv_layout):
     """Guard + sanitizer are observers: bitwise-identical generations
-    with and without them."""
+    with and without them (quantized pool included — the sanitizers
+    must not perturb the in-step quantization either)."""
     cfg, _ = tiny
     prompts = churn_prompts(cfg, n=40)
     outs_on = run_churn(
         RequestManager(
-            churn_engine(tiny, "paged", sanitizers=("retrace", "donation"))
+            churn_engine(tiny, kv_layout, sanitizers=("retrace", "donation"))
         ),
         prompts,
     )
     outs_off = run_churn(
-        RequestManager(churn_engine(tiny, "paged", sanitizers=())),
+        RequestManager(churn_engine(tiny, kv_layout, sanitizers=())),
         prompts,
     )
     assert outs_on == outs_off
