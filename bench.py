@@ -191,6 +191,10 @@ _PHASES = [
     # budget: tokens/sec/chip + TTFT/TPOT p50/p99 + bytes/live-token +
     # slots-before-preemption, output parity asserted
     ("serve_paged_q", 900, 600, True, True),
+    # megakernel decode step: per-fusion ablation (rope_kv_write /
+    # sampling / both) on small-batch sync decode — decode_step_ms
+    # p50/p99 + dispatched programs per step, bitwise parity asserted
+    ("serve_fused", 600, 400, True, True),
     ("serve_int8", 600, 400, True, True),
     ("searched", 700, 400, False, True),
     ("serve_int4", 600, 400, True, True),
@@ -289,6 +293,24 @@ def orchestrate(which):
             vs_baseline=rec.get("vs_baseline"),
             source=rec["metric"],
             kv_quant=d.get("kv_quant"),
+            platform=d.get("platform"),
+        )
+
+    # Derived: decode-step latency, so BENCH_r*.json tracks step time
+    # across rounds. The serve_fused phase measures it fused AND
+    # unfused — the summary carries the fused p50 (the shipped
+    # configuration) with the unfused baseline in detail.
+    rec = _RESULTS.get("fused_decode_step_ms_p50")
+    if rec:
+        d = rec.get("detail") or {}
+        emit(
+            "decode_step_ms_p50",
+            rec["value"],
+            "ms",
+            vs_baseline=rec.get("vs_baseline"),
+            source=rec["metric"],
+            unfused_decode_step_ms_p50=d.get("base_decode_step_ms_p50"),
+            decode_step_ms_p99=d.get("both_decode_step_ms_p99"),
             platform=d.get("platform"),
         )
 
@@ -1444,6 +1466,177 @@ def serve_paged_q_bench(on_tpu, kernels):
     return q["tps"]
 
 
+def serve_fused_bench(on_tpu, kernels):
+    """Megakernel decode step (serve/kernels.py fused prologue +
+    serve/sampling.py fused epilogue, ``ServingConfig.fused_decode``):
+    small-batch greedy decode on the blocking sync scheduler — the
+    regime where per-step dispatch overhead and HBM round-trips
+    dominate — ablating each fusion independently:
+
+      base          fused_decode=()                  step + host decode head
+      rope_kv_write in-kernel RoPE + KV page write   (Pallas path only)
+      sampling      on-device mode-specialized head  ONE program per step
+      both          the full megakernel step
+
+    Reports decode_step_ms p50/p99, tokens/sec and DISPATCHED PROGRAMS
+    per decode step (engine.dispatch_count) for every ablation, asserts
+    BITWISE output parity of each fusion vs the unfused baseline,
+    zero steady-state recompiles, and that the fused step issues
+    strictly fewer programs per decode step than the unfused baseline.
+
+    Measurement caveat (CPU): kernels is forced to "xla" off-TPU
+    (interpret-mode Pallas would dominate), where "rope_kv_write" is by
+    design a no-op — its row measures parity at ~1.0x, and only the
+    chip measures the prologue's HBM/dispatch win. The "sampling"
+    epilogue is an XLA-level fusion, so its halved per-step dispatch
+    count (2 -> 1) and skipped (R, V) sorts are real on every backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import InferenceEngine, RequestManager, ServingConfig
+    from flexflow_tpu.serve.request_manager import RequestStatus
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_slots = 8          # small-batch decode: the latency-bound regime
+    n_new = 64 if on_tpu else 24
+    prompt_len = 32 if on_tpu else 12
+    page_size = 16
+    if not on_tpu and kernels == "pallas":
+        _log("serve_fused: forcing kernels=xla off-TPU (interpret-mode "
+             "pallas would dominate the measurement)")
+        kernels = "xla"
+
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_slots)
+    ]
+
+    def make_rm(fused):
+        sc = ServingConfig(
+            max_requests_per_batch=n_slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=prompt_len,
+            max_spec_tree_tokens=8,
+            cache_dtype=cfg.dtype,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=page_size,
+            # ample pool: preemption/reclaim dispatches would pollute
+            # the per-step dispatch count under measurement
+            max_cached_tokens=n_slots * (prompt_len + n_new + page_size),
+            fused_decode=fused,
+            sanitizers=("retrace",),
+        )
+        return RequestManager(InferenceEngine(llama, cfg, params, sc))
+
+    def run(fused):
+        rm = make_rm(fused)
+        # the blocking sync scheduler: one host round-trip per step —
+        # exactly the per-step dispatch overhead the megakernel attacks
+        # (the pipelined path hides it behind dispatch-ahead instead)
+        rm.supports_fast_decode = False
+        rm.generate(prompts, max_new_tokens=2)   # warm every step key
+        rm.stats = type(rm.stats)()
+        eng = rm.engine
+        rids = [rm.submit(p, max_new_tokens=n_new) for p in prompts]
+        step_ms, decode_dispatches, n_decode = [], 0, 0
+        t0 = time.perf_counter()
+        while True:
+            decode_only = (
+                rm._active(RequestStatus.DECODING)
+                and not rm._active(RequestStatus.PREFILLING)
+            )
+            d0 = eng.dispatch_count
+            ts = time.perf_counter()
+            if not rm.step():
+                break
+            if decode_only:
+                step_ms.append((time.perf_counter() - ts) * 1e3)
+                decode_dispatches += eng.dispatch_count - d0
+                n_decode += 1
+        rm.drain()
+        wall = time.perf_counter() - t0
+        outs = [list(rm.requests[r].output_tokens) for r in rids]
+        tokens = sum(len(o) for o in outs)
+        stats = rm.stats.snapshot()
+        return {
+            "fused": fused,
+            "outputs": outs,
+            "tps": tokens / wall,
+            "p50_ms": float(np.percentile(step_ms, 50)),
+            "p99_ms": float(np.percentile(step_ms, 99)),
+            "dispatches_per_step": decode_dispatches / max(1, n_decode),
+            "decode_steps": n_decode,
+            "retraces": stats["retraces"],
+        }
+
+    ablations = {
+        "base": (),
+        "rope_kv_write": ("rope_kv_write",),
+        "sampling": ("sampling",),
+        "both": ("rope_kv_write", "sampling"),
+    }
+    res = {name: run(fused) for name, fused in ablations.items()}
+
+    base = res["base"]
+    for name, r in res.items():
+        assert r["outputs"] == base["outputs"], (
+            f"fused_decode={r['fused']} generations diverged from the "
+            "unfused step — every fusion must be bitwise-identical"
+        )
+        assert r["retraces"] == 0, (
+            f"fused_decode={r['fused']}: {r['retraces']} steady-state "
+            "recompiles in the measured run"
+        )
+    assert res["both"]["dispatches_per_step"] < base["dispatches_per_step"], (
+        "fused step must issue strictly fewer programs per decode step: "
+        f"both={res['both']['dispatches_per_step']:.2f} vs "
+        f"base={base['dispatches_per_step']:.2f}"
+    )
+
+    detail = {}
+    for name, r in res.items():
+        detail[f"{name}_decode_step_ms_p50"] = round(r["p50_ms"], 3)
+        detail[f"{name}_decode_step_ms_p99"] = round(r["p99_ms"], 3)
+        detail[f"{name}_tokens_per_sec"] = round(r["tps"], 2)
+        detail[f"{name}_dispatches_per_step"] = round(
+            r["dispatches_per_step"], 2
+        )
+    emit(
+        "fused_decode_dispatches_per_step",
+        round(res["both"]["dispatches_per_step"], 2),
+        "programs/step",
+        # <1: the fused step's per-decode-step program count vs unfused
+        vs_baseline=(
+            res["both"]["dispatches_per_step"]
+            / max(1e-9, base["dispatches_per_step"])
+        ),
+        baseline_dispatches_per_step=round(base["dispatches_per_step"], 2),
+        kernels=kernels,
+        platform=_platform(),
+    )
+    emit(
+        "fused_decode_step_ms_p50",
+        round(res["both"]["p50_ms"], 3),
+        "ms",
+        # <1: fused decode-step latency vs the unfused baseline
+        vs_baseline=res["both"]["p50_ms"] / max(1e-9, base["p50_ms"]),
+        kernels=kernels,
+        n_slots=n_slots,
+        new_tokens_per_request=n_new,
+        prompt_len=prompt_len,
+        decode_steps_measured=res["both"]["decode_steps"],
+        output_parity="bitwise",
+        steady_state_recompiles=0,
+        **detail,
+        platform=_platform(),
+    )
+    return res["both"]["p50_ms"]
+
+
 def serve_quantized_bench(on_tpu, kernels, bits):
     """Weight-only int8/int4 serving (reference --8bit/4bit-quantization,
     file_loader.cc:651,710 + decompress kernels): decode is
@@ -1598,6 +1791,8 @@ def child_main(phase, platform, kernels):
         serve_prefix_bench(on_tpu, kernels)
     elif phase == "serve_paged_q":
         serve_paged_q_bench(on_tpu, kernels)
+    elif phase == "serve_fused":
+        serve_fused_bench(on_tpu, kernels)
     elif phase == "serve_int8":
         serve_quantized_bench(on_tpu, kernels, bits=8)
     elif phase == "serve_int4":
@@ -1615,7 +1810,8 @@ def main():
         default="all",
         choices=["all", "train", "searched", "parity", "serve",
                  "serve_paged", "serve_continuous", "serve_prefix",
-                 "serve_paged_q", "serve_int8", "serve_int4", "serve_7b"],
+                 "serve_paged_q", "serve_fused", "serve_int8",
+                 "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)

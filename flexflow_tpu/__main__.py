@@ -100,6 +100,9 @@ def cmd_serve(args):
         kv_quant=args.kv_quant,
         prefix_caching=args.prefix_caching,
         cache_policy=args.cache_policy,
+        fused_decode=tuple(
+            s for s in (args.fused_decode or "").split(",") if s
+        ),
     )
     ssms = []
     spec = None
@@ -213,6 +216,14 @@ def main(argv=None):
                    help="when prompt blocks enter the prefix cache: at "
                         "request completion incl. generated tokens "
                         "(complete) or as soon as prefill ends (prefill)")
+    s.add_argument("--fused-decode", default=None,
+                   help="megakernel decode-step fusions, comma-separated "
+                        "(rope_kv_write,sampling): fold RoPE + the KV "
+                        "page write into the ragged paged Pallas kernel "
+                        "(requires --kv-layout paged; active with "
+                        "--pallas) and/or the greedy/top-k sampling "
+                        "epilogue into the step program; each fusion is "
+                        "bitwise-identical to the unfused step")
     # reference -output-file (request_manager.cc:417-440): append each
     # finished request's latency/steps/token-ids
     s.add_argument("--output-file", "-output-file", default=None)

@@ -13,6 +13,7 @@ import numpy as np
 from . import transformer
 from .transformer import (  # noqa: F401  (engine serving protocol)
     DecoderConfig,
+    FUSED_DECODE,
     commit_kv,
     commit_kv_paged,
     copy_page_kv,

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from . import transformer
 from .transformer import (  # noqa: F401  (engine serving protocol)
     DecoderConfig,
+    FUSED_DECODE,
     commit_kv,
     commit_kv_paged,
     copy_page_kv,

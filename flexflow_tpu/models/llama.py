@@ -753,6 +753,14 @@ def serve_debug_activations(
 # dense layout); ``kernels="pallas"`` routes through the fused ragged
 # paged kernel (serve/kernels.py) which DMAs pages directly.
 
+#: decode-step fusions this family's serving step supports
+#: (ServingConfig.fused_decode; the engine validates requests against
+#: this). "rope_kv_write": serve_step_paged folds RoPE + the KV page
+#: write into the ragged paged Pallas kernel (the megakernel decode
+#: step). The "sampling" epilogue fusion is model-agnostic — it lives
+#: in the engine's step program — so it is not listed here.
+FUSED_DECODE = ("rope_kv_write",)
+
 
 def init_paged_kv_cache(
     cfg: LLaMAConfig, num_pages: int, page_size: int, dtype=None,
@@ -821,7 +829,8 @@ def _page_lookup(page_table: jnp.ndarray, cache_positions: jnp.ndarray,
 def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
                       k_pool, v_pool, phys, off, page_table,
                       kernels: str = "xla",
-                      k_scale=None, v_scale=None, qmax=None):
+                      k_scale=None, v_scale=None, qmax=None,
+                      *, fused_rope: bool = False, logical=None):
     """One block on a paged serving step: scatter new K/V at the
     table-resolved (physical page, offset), attend over the virtual
     cache read through the page table. With ``qmax`` (quantized pool,
@@ -830,13 +839,37 @@ def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
     at read time (in-kernel on the Pallas path), so full-precision K/V
     never round-trip HBM. Returns
     ``(x, k_pool, v_pool, k_scale, v_scale)`` (scales None when the
-    pool is full-precision)."""
+    pool is full-precision).
+
+    ``fused_rope`` (the megakernel decode step,
+    ``ServingConfig.fused_decode``): on the Pallas path the RoPE on
+    Q/K and the (optionally quantizing) KV page write move INSIDE the
+    ragged paged kernel (serve/kernels.fused_rope_paged_attention) —
+    the fresh K/V lines never round-trip HBM between this block's
+    projection and its attention read. Bitwise-identical to the
+    unfused composition below; on kernels="xla" the flag is a no-op
+    because the unfused XLA step IS the CPU-parity fallback."""
     R, C, D = x.shape
     H, KV, dk = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     h = _rms(x, p["attn_norm"], cfg.rms_norm_eps)
     q = _mm(h, p["wq"]).reshape(R, C, H, dk)
     k = _mm(h, p["wk"]).reshape(R, C, KV, dk)
     v = _mm(h, p["wv"]).reshape(R, C, KV, dk)
+    from ..serve import kernels as _pk
+
+    if fused_rope and kernels == "pallas":
+        attn, k_pool, v_pool, k_scale, v_scale = (
+            _pk.fused_rope_paged_attention(
+                q, k, v, cos, sin, k_pool, v_pool, page_table,
+                logical, off, mask,
+                k_scale=k_scale, v_scale=v_scale, qmax=qmax,
+            )
+        )
+        attn = attn.reshape(R, C, H * dk)
+        x = x + _mm(attn, p["wo"])
+        h2 = _rms(x, p["ffn_norm"], cfg.rms_norm_eps)
+        ffn = _mm(jax.nn.silu(_mm(h2, p["w1"])) * _mm(h2, p["w3"]), p["w2"])
+        return x + ffn, k_pool, v_pool, k_scale, v_scale
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if qmax is not None:
@@ -847,8 +880,6 @@ def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
     else:
         k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
         v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
-    from ..serve import kernels as _pk
-
     if kernels == "pallas":
         attn = _pk.ragged_paged_attention(
             q, k_pool, v_pool, page_table, mask,
@@ -896,13 +927,17 @@ def serve_step_paged(
     all_logits: bool = False,
     kernels: str = "xla",
     kv_quant: Optional[str] = None,
+    fused_rope: bool = False,
     mesh=None,
 ):
     """Paged twin of :func:`serve_step` — same contract plus the
     per-slot page table; prefill chunks, single-token decode and
     tree-verify all read/write K/V through the table. ``kv_quant``
     selects the quantized pool layout (serve/kv_quant.py): the KV
-    commit quantizes in-step and attention dequantizes at read time."""
+    commit quantizes in-step and attention dequantizes at read time.
+    ``fused_rope`` (megakernel decode step) folds RoPE and the KV page
+    write into the Pallas kernel per block — a no-op on the XLA path,
+    which already is the fused variants' CPU-parity reference."""
     if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
         raise NotImplementedError(
             "paged KV serving is not composed with pipeline parallelism "
@@ -915,6 +950,7 @@ def serve_step_paged(
     cos, sin = rope_freqs(cfg, positions)
     mask = _paged_mask(mask, positions, page_table, ps, cache_len)
     phys, off = _page_lookup(page_table, cache_positions, ps)
+    logical = cache_positions // ps
 
     if kv_quant is not None:
         from ..serve.kv_quant import resolve_spec
@@ -926,6 +962,7 @@ def serve_step_paged(
             h, kc, vc, ks, vs = serve_block_paged(
                 cfg, p_l, h, cos, sin, mask, kc, vc, phys, off,
                 page_table, kernels, ks, vs, qmax,
+                fused_rope=fused_rope, logical=logical,
             )
             return h, (kc, vc, ks, vs)
 
@@ -942,6 +979,7 @@ def serve_step_paged(
             h, kc, vc, _, _ = serve_block_paged(
                 cfg, p_l, h, cos, sin, mask, kc, vc, phys, off,
                 page_table, kernels,
+                fused_rope=fused_rope, logical=logical,
             )
             return h, (kc, vc)
 

@@ -844,6 +844,16 @@ def reorder_slots(
 # resolve logical cache lines to physical pages. The extra per-line
 # position buffer (ALiBi/sliding-window families) pages the same way.
 
+#: decode-step fusions the generic decoder's serving step supports
+#: (ServingConfig.fused_decode; the engine validates requests against
+#: this). "rope_kv_write": serve_step_paged folds RoPE (or, for
+#: learned-position families, just the quantizing KV page write) into
+#: the ragged paged Pallas kernel; ALiBi batches keep the unfused
+#: path at run time because the additive bias already excludes the
+#: Pallas kernel. The "sampling" epilogue fusion is model-agnostic —
+#: it lives in the engine's step program — so it is not listed here.
+FUSED_DECODE = ("rope_kv_write",)
+
 
 def init_paged_kv_cache(
     cfg: DecoderConfig, num_pages: int, page_size: int, dtype=None,
@@ -904,7 +914,8 @@ def _page_lookup(page_table, cache_positions, page_size):
 
 def serve_block_paged(cfg, p, x, rope, bias, mask, k_pool, v_pool,
                       phys, off, page_table, kernels: str = "xla",
-                      k_scale=None, v_scale=None, qmax=None):
+                      k_scale=None, v_scale=None, qmax=None,
+                      *, fused_rope: bool = False, logical=None):
     """Paged twin of :func:`serve_block`: scatter new K/V at the
     table-resolved (page, offset); attend over the virtual cache read
     through the table (``jnp.take`` gather, or the fused ragged paged
@@ -912,12 +923,44 @@ def serve_block_paged(cfg, p, x, rope, bias, mask, k_pool, v_pool,
     With ``qmax`` the pool is quantized (serve/kv_quant.py): the commit
     quantizes in-step and reads dequantize at the page scales (fused
     in-kernel on the Pallas path). Returns
-    ``(x, k_pool, v_pool, k_scale, v_scale)``."""
+    ``(x, k_pool, v_pool, k_scale, v_scale)``.
+
+    ``fused_rope`` (megakernel decode step): on the Pallas path RoPE —
+    or, for non-RoPE position schemes, just the quantizing KV commit —
+    moves inside the ragged paged kernel
+    (serve/kernels.fused_rope_paged_attention). ALiBi batches keep the
+    unfused path (the additive bias already excludes the Pallas
+    kernel); on kernels="xla" the flag is a no-op — the unfused XLA
+    step is the CPU-parity fallback."""
     from ..serve import kernels as _pk
 
     R, C, D = x.shape
     h = _norm(cfg, x, p["attn_norm_scale"], p.get("attn_norm_bias"))
     q, k, v = _project_qkv(cfg, p, h)
+    if fused_rope and kernels == "pallas" and bias is None:
+        cos, sin = rope if rope is not None else (None, None)
+        attn, k_pool, v_pool, k_scale, v_scale = (
+            _pk.fused_rope_paged_attention(
+                q, k, v, cos, sin, k_pool, v_pool, page_table,
+                logical, off, mask,
+                k_scale=k_scale, v_scale=v_scale, qmax=qmax,
+            )
+        )
+        attn = attn.reshape(R, C, -1)
+        attn = _mm(attn, p["wo"])
+        if cfg.out_bias:
+            attn = attn + p["bo"]
+        if cfg.parallel_block:
+            if cfg.parallel_two_norms:
+                h2 = _norm(cfg, x, p["mlp_norm_scale"],
+                           p.get("mlp_norm_bias"))
+            else:
+                h2 = h
+            return (x + attn + _ffn(cfg, p, h2), k_pool, v_pool,
+                    k_scale, v_scale)
+        x = x + attn
+        h2 = _norm(cfg, x, p["mlp_norm_scale"], p.get("mlp_norm_bias"))
+        return x + _ffn(cfg, p, h2), k_pool, v_pool, k_scale, v_scale
     if rope is not None:
         cos, sin = rope
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
@@ -1003,11 +1046,13 @@ def serve_step_paged(
     all_logits: bool = False,
     kernels: str = "xla",
     kv_quant: Optional[str] = None,
+    fused_rope: bool = False,
     mesh=None,
 ):
     """Paged twin of :func:`serve_step` — same contract plus the page
     table (see models/llama.py serve_step_paged; ``kv_quant`` selects
-    the quantized pool layout)."""
+    the quantized pool layout, ``fused_rope`` the megakernel decode
+    step's in-kernel RoPE + KV-write prologue on the Pallas path)."""
     if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
         raise NotImplementedError(
             "paged KV serving is not composed with pipeline parallelism "
@@ -1020,6 +1065,7 @@ def serve_step_paged(
     phys, off, mask, bias, pos_pool = _paged_serve_context(
         cfg, cache, positions, cache_positions, mask, page_table, cache_len
     )
+    logical = cache_positions // cache["k"].shape[2]
 
     if kv_quant is not None:
         from ..serve.kv_quant import resolve_spec
@@ -1031,6 +1077,7 @@ def serve_step_paged(
             h, kc, vc, ks, vs = serve_block_paged(
                 cfg, p_l, h, rope, bias, mask, kc, vc, phys, off,
                 page_table, kernels, ks, vs, qmax,
+                fused_rope=fused_rope, logical=logical,
             )
             return h, (kc, vc, ks, vs)
 
@@ -1047,6 +1094,7 @@ def serve_step_paged(
             h, kc, vc, _, _ = serve_block_paged(
                 cfg, p_l, h, rope, bias, mask, kc, vc, phys, off,
                 page_table, kernels,
+                fused_rope=fused_rope, logical=logical,
             )
             return h, (kc, vc)
 

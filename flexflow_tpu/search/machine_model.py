@@ -281,26 +281,29 @@ def compute_time(chip: TPUChip, flops: float, bytes_moved: float) -> float:
     return max(t_flops, t_mem)
 
 
-def calibrate_chip(chip: TPUChip, *, iters: int = 5) -> TPUChip:
+def calibrate_chip(chip: TPUChip, *, iters: int = 5, n: int = 4096,
+                   stream_mb: int = 256) -> TPUChip:
     """Replace the preset ``mxu_efficiency``/``hbm_efficiency`` guesses
     with MEASURED achieved fractions on the current default device — the
     closing of the cost-model fidelity loop the reference gets from
     ``inner_measure_operator_cost`` re-measurement (model.cu:38,
     graph.cc:2108). Two microbenchmarks:
 
-    * MXU: a big square bf16 matmul (n=4096; ~137 GFLOP) — achieved
-      FLOP/s over ``bf16_flops``;
-    * HBM: an elementwise stream over ~256 MB (read + write) — achieved
-      bytes/s over ``hbm_bandwidth``.
+    * MXU: a big square bf16 matmul (``n``=4096 default; ~137 GFLOP) —
+      achieved FLOP/s over ``bf16_flops``;
+    * HBM: an elementwise stream over ``stream_mb`` (~256 MB default,
+      read + write) — achieved bytes/s over ``hbm_bandwidth``.
 
-    Results clamp to [0.05, 1.0]; on a CPU host this measures the CPU
-    (meaningless vs the TPU peaks) — callers gate on platform."""
+    The defaults saturate a real chip; smaller sizes are for smoke
+    tests that only need the measurement to RUN (a CPU host measures
+    the CPU — meaningless vs the TPU peaks — so callers gate on
+    platform and tests only assert the clamp).
+
+    Results clamp to [0.05, 8.0]."""
     import time
 
     import jax
     import jax.numpy as jnp
-
-    n = 4096
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (n, n), jnp.bfloat16)
     b = jax.random.normal(jax.random.fold_in(key, 1), (n, n), jnp.bfloat16)
@@ -313,7 +316,7 @@ def calibrate_chip(chip: TPUChip, *, iters: int = 5) -> TPUChip:
     t_mm = (time.perf_counter() - t0) / iters
     mxu = (2.0 * n**3 / t_mm) / chip.bf16_flops
 
-    m = 128 * 1024 * 1024 // 2  # bf16 elements ≈ 256 MB buffer
+    m = stream_mb * 1024 * 1024 // 2  # bf16 elements, stream_mb bytes
     x = jax.random.normal(jax.random.fold_in(key, 2), (m,), jnp.bfloat16)
     stream = jax.jit(lambda x: x * 1.0009765625 + 1.0)
     stream(x).block_until_ready()

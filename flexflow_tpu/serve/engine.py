@@ -107,6 +107,27 @@ class ServingConfig:
     # transcript); "prefill" — the prompt alone, as soon as its last
     # chunk is dispatched (concurrent same-prompt requests hit sooner).
     cache_policy: str = "complete"
+    # Megakernel decode step (ROADMAP item 2, MPK-style): which
+    # decode-step fusions to enable, each independently toggleable and
+    # bitwise-identical to its unfused counterpart
+    # (tests/test_fused_decode.py).
+    #   "rope_kv_write" — RoPE on Q/K and the (optionally
+    #     int8-quantizing) KV page write fold INSIDE the ragged paged
+    #     Pallas kernel (serve/kernels.fused_rope_paged_attention), so
+    #     fresh K/V never round-trip HBM between the step's projection
+    #     and its attention read. Paged layout only; model families
+    #     advertise support via their FUSED_DECODE tuple. With
+    #     kernels="xla" the flag is a no-op — the unfused XLA step IS
+    #     the CPU-parity fallback.
+    #   "sampling" — the greedy/top-k sampling epilogue fuses into the
+    #     step program with a mode-specialized head
+    #     (serve/sampling.choose_sample_mode): greedy-only decode
+    #     batches skip the (R, V) sorts entirely, and the sync path
+    #     drops from two dispatched programs per step (step + host-side
+    #     sample) to one (engine.run_sampled).
+    # Off by default; () compiles exactly the pre-fusion step programs
+    # under exactly the pre-fusion step keys.
+    fused_decode: Tuple[str, ...] = ()
     # Runtime hazard sanitizers (flexflow_tpu/analysis/): "retrace" — a
     # strict RetraceGuard on the engine's jit chokepoint that raises on
     # any step recompile after its first compile (the shape/dtype-drift
@@ -221,6 +242,40 @@ class InferenceEngine:
                 f"unknown kv_layout {self.serving.kv_layout!r} "
                 "(expected 'dense' or 'paged')"
             )
+        # Megakernel decode step: validate the fusion set up front so a
+        # bad toggle fails at engine construction, not mid-serve.
+        fused = self.serving.fused_decode
+        if isinstance(fused, str):
+            fused = tuple(s.strip() for s in fused.split(",") if s.strip())
+            self.serving = dataclasses.replace(self.serving,
+                                               fused_decode=fused)
+        for name in fused:
+            if name not in ("rope_kv_write", "sampling"):
+                raise ValueError(
+                    f"unknown fused_decode entry {name!r} (expected "
+                    "'rope_kv_write' and/or 'sampling')"
+                )
+        if "rope_kv_write" in fused:
+            if not self.paged:
+                raise ValueError(
+                    "fused_decode='rope_kv_write' requires "
+                    "kv_layout='paged' — the fused prologue commits K/V "
+                    "through the page table inside the ragged paged "
+                    "kernel"
+                )
+            if "rope_kv_write" not in getattr(model, "FUSED_DECODE", ()):
+                raise ValueError(
+                    "fused_decode='rope_kv_write' requested but "
+                    f"{getattr(model, '__name__', repr(model))} does not "
+                    "advertise it (model.FUSED_DECODE) — the family's "
+                    "serve_step_paged has no fused prologue"
+                )
+        # Dispatch telemetry (bench serve_fused): device programs this
+        # engine's serving loop issued — every jitted step dispatched
+        # here plus host-side decode heads the scheduler counts via
+        # count_dispatch. The fused-epilogue claim ("strictly fewer
+        # programs per step") is measured against this counter.
+        self.dispatch_count = 0
         # Quantized KV pages (serve/kv_quant.py): validated up front so
         # a bad value fails at engine construction, not mid-serve.
         self.kv_quant_spec = None
@@ -421,8 +476,15 @@ class InferenceEngine:
             kw["cache_len"] = self.serving.cache_len
             if self.serving.kv_quant is not None:
                 kw["kv_quant"] = self.serving.kv_quant
+            if "rope_kv_write" in self.serving.fused_decode:
+                kw["fused_rope"] = True
             return functools.partial(self.model.serve_step_paged, **kw)
         return functools.partial(self.model.serve_step, **kw)
+
+    def count_dispatch(self, kind: str = "step") -> None:
+        """Record one dispatched device program (see dispatch_count)."""
+        del kind  # per-kind breakdown not tracked; the total is the metric
+        self.dispatch_count += 1
 
     def _get_step(self, chunk: int, all_logits: bool, with_mask: bool):
         """One compiled program per static signature — the analog of the
@@ -446,7 +508,9 @@ class InferenceEngine:
             self._steps[key] = self._jit(step, key=key, donate_argnums=(1,))
         return self._steps[key]
 
-    def _get_mixed_step(self, chunk: int, with_logits: bool = False):
+    def _get_mixed_step(self, chunk: int, with_logits: bool = False,
+                        sample_mode: Optional[str] = None,
+                        topk_cap: int = 0):
         """Fused MIXED step — the continuous-batching workhorse: token
         select (device feedback vs host) for column 0 → serve_step over
         (R, chunk) ragged rows (decode rows use one column, prefill rows
@@ -460,13 +524,23 @@ class InferenceEngine:
         scheduler admit and prefill without ever draining the pipeline.
         ``with_logits`` additionally returns the pre-sampling logits
         (parity tests/debug only — the serving path skips the extra
-        output)."""
+        output).
+
+        ``sample_mode``/``topk_cap`` (the "sampling" decode fusion,
+        serve/sampling.py): a mode-specialized sampling head replaces
+        the full-sort reference head — greedy-only decode batches skip
+        the (R, V) sorts entirely. None keeps the pre-fusion program
+        AND its pre-fusion step key; a set mode tags the key, so each
+        head the workload actually needs compiles exactly once."""
         key_id = ("mixed_fused", chunk, with_logits)
+        if sample_mode is not None:
+            key_id = key_id + (sample_mode, topk_cap)
         if key_id not in self._steps:
             from .sampling import sample_tokens
 
             fn = self._serve_step_fn(all_logits=False)
             paged = self.paged
+            mode = sample_mode or "full"
 
             def step(params, cache, last_tokens, host_tokens, use_last,
                      positions, logits_idx, key, greedy, temperature,
@@ -483,7 +557,7 @@ class InferenceEngine:
                 toks = sample_tokens(
                     logits, key,
                     greedy=greedy, temperature=temperature, topp=topp,
-                    topk_arr=topk,
+                    topk_arr=topk, mode=mode, topk_cap=topk_cap,
                 )
                 if with_logits:
                     return toks, logits, cache
@@ -505,13 +579,22 @@ class InferenceEngine:
         if self.paged:
             kw["page_table"] = self.page_table_device()
         host_tokens = np.asarray(host_tokens)
+        mode, cap = None, 0
+        if "sampling" in self.serving.fused_decode:
+            from .sampling import choose_sample_mode
+
+            mode, cap = choose_sample_mode(
+                greedy, topp, topk, self.cfg.vocab_size
+            )
         # every jit-call argument converts with a PINNED dtype: the
         # abstract signature — and so the compile-cache key — must not
         # follow whatever host types the scheduler happened to produce
         # (weak-type/x64 retrace hazard, ffcheck FF103)
         donated = self.cache
+        self.count_dispatch("mixed")
         with _set_mesh(self.mesh):
-            step = self._get_mixed_step(host_tokens.shape[1], with_logits)
+            step = self._get_mixed_step(host_tokens.shape[1], with_logits,
+                                        mode, cap)
             out = step(
                 self.params,
                 self.cache,
@@ -551,6 +634,99 @@ class InferenceEngine:
             last_tokens, host_tokens, use_last, positions,
             np.zeros((R,), np.int32), key, greedy, temperature, topp, topk,
         )
+
+    def _get_step_sampled(self, chunk: int, with_mask: bool,
+                          sample_mode: str, topk_cap: int,
+                          with_logits: bool = False):
+        """The "sampling"-fused SYNC step (megakernel decode epilogue):
+        serve_step plus the mode-specialized decode head in ONE
+        compiled program, cache donated — where the unfused sync path
+        dispatches two programs per step (the step, then the host-side
+        ``sample_tokens``), this dispatches one and keeps the logits on
+        device. ``with_logits`` additionally returns them (parity
+        tests; the serving path skips the extra output)."""
+        key_id = ("step_sampled", chunk, with_mask, sample_mode, topk_cap,
+                  with_logits)
+        if key_id not in self._steps:
+            from .sampling import sample_tokens
+
+            fn = self._serve_step_fn(all_logits=False)
+            paged = self.paged
+
+            def step(params, cache, tokens, positions, logits_idx, mask,
+                     cpos, key, greedy, temperature, topp, topk,
+                     page_table=None):
+                args = (params, cache, tokens, positions, logits_idx,
+                        mask, cpos)
+                if paged:
+                    args = args + (page_table,)
+                logits, cache = fn(*args)
+                toks = sample_tokens(
+                    logits, key,
+                    greedy=greedy, temperature=temperature, topp=topp,
+                    topk_arr=topk, mode=sample_mode, topk_cap=topk_cap,
+                )
+                if with_logits:
+                    return toks, logits, cache
+                return toks, cache
+
+            self._steps[key_id] = self._jit(
+                step, key=key_id, donate_argnums=(1,)
+            )
+        return self._steps[key_id]
+
+    def run_sampled(self, bc: BatchConfig, key, greedy, temperature, topp,
+                    topk, with_logits: bool = False):
+        """Dispatch one step WITH the fused sampling epilogue (the
+        ``fused_decode=("sampling",)`` sync path): one program computes
+        the step's logits at each row's ``logits_idx`` AND samples
+        them, so the (R, V) logits never reach the host. Returns the
+        sampled tokens as a device array (R,) — plus the logits when
+        ``with_logits``."""
+        from .sampling import choose_sample_mode
+
+        if self.serving.inference_debugging:
+            with _set_mesh(self.mesh):
+                self._dump_debug(bc)
+        mode, cap = choose_sample_mode(
+            greedy, topp, topk, self.cfg.vocab_size
+        )
+        args = (
+            jnp.asarray(bc.tokens, dtype=jnp.int32),
+            jnp.asarray(bc.positions, dtype=jnp.int32),
+            jnp.asarray(bc.logits_idx, dtype=jnp.int32),
+            jnp.asarray(bc.mask, dtype=jnp.bool_)
+            if bc.mask is not None else None,
+            jnp.asarray(bc.cache_positions, dtype=jnp.int32)
+            if bc.cache_positions is not None
+            else None,
+            key,
+            jnp.asarray(greedy, dtype=jnp.bool_),
+            jnp.asarray(temperature, dtype=jnp.float32),
+            jnp.asarray(topp, dtype=jnp.float32),
+            jnp.asarray(topk, dtype=jnp.int32),
+        )
+        kw = {}
+        if self.paged:
+            kw["page_table"] = self.page_table_device()
+        donated = self.cache
+        self.count_dispatch("step_sampled")
+        with _set_mesh(self.mesh):
+            step = self._get_step_sampled(
+                bc.chunk, bc.mask is not None, mode, cap, with_logits
+            )
+            out = step(self.params, self.cache, *args, **kw)
+        if with_logits:
+            toks, logits, self.cache = out
+            self._poison_donated(
+                donated, ("step_sampled", bc.chunk, bc.mask is not None)
+            )
+            return toks, logits
+        toks, self.cache = out
+        self._poison_donated(
+            donated, ("step_sampled", bc.chunk, bc.mask is not None)
+        )
+        return toks
 
     def _get_speculate(self, W: int, D: int):
         """Whole-tree SSM speculation as ONE compiled program: a scan
@@ -643,6 +819,7 @@ class InferenceEngine:
         if self.paged:
             kw["page_table"] = self.page_table_device()
         donated = self.cache
+        self.count_dispatch("speculate")
         with _set_mesh(self.mesh):
             step = self._get_speculate(W, D)
             toks, parents, logps, self.cache = step(
@@ -739,6 +916,7 @@ class InferenceEngine:
             # bc.page_table is carried as host-side metadata
             args = args + (self.page_table_device(),)
         donated = self.cache
+        self.count_dispatch("step")
         with _set_mesh(self.mesh):
             step = self._get_step(bc.chunk, all_logits, bc.mask is not None)
             logits, self.cache = step(self.params, self.cache, *args)
@@ -759,6 +937,7 @@ class InferenceEngine:
                 donate_argnums=(0,),
             )
         donated = self.cache
+        self.count_dispatch("copy_page")
         with _set_mesh(self.mesh):
             self.cache = self._steps["copy_page"](
                 self.cache,
@@ -784,6 +963,7 @@ class InferenceEngine:
                     donate_argnums=(0,),
                 )
         donated = self.cache
+        self.count_dispatch("reorder")
         with _set_mesh(self.mesh):
             if self.paged:
                 self.cache = self._steps["reorder"](
@@ -812,6 +992,7 @@ class InferenceEngine:
                 fn = self.model.commit_kv
             self._commit = self._jit(fn, key="commit", donate_argnums=(0,))
         donated = self.cache
+        self.count_dispatch("commit")
         with _set_mesh(self.mesh):
             if self.paged:
                 self.cache = self._commit(

@@ -31,6 +31,45 @@ for. :func:`ragged_paged_attention_xla` is the shape-identical
 ``jnp.take``-based fallback (via :func:`gather_pages`) used on CPU and
 as the correctness reference.
 
+:func:`fused_rope_paged_attention` — the **megakernel decode step**
+prologue (MPK, "Mega-Kernelizing Tensor Programs", PAPERS.md): RoPE on
+Q/K and the (optionally int8-quantizing) KV page write move INSIDE the
+ragged paged grid, so a decode step's fresh K/V lines are rotated,
+quantized and committed in VMEM and read back by attention in the same
+kernel — they never round-trip HBM between the step's QKV projection
+and the attention read, and the separate rope/scatter XLA ops (and
+their dispatch latency) disappear from the step program.
+
+Kernel-variant matrix — every Pallas variant of the ragged paged
+kernel is emitted by ONE parameterized builder
+(:func:`_build_ragged_paged_kernel`), so the quant and fused axes
+compose instead of multiplying hand-written kernel bodies:
+
+====================  =======================  =========================
+variant               Pallas entry point       XLA fallback (CPU parity)
+====================  =======================  =========================
+plain                 ragged_paged_attention   ragged_paged_attention_xla
+int8 pages            ragged_paged_attention   ragged_paged_attention_xla
+                      (k_scale/v_scale)        (k_scale/v_scale)
+fused RoPE+KV-write   fused_rope_paged_        the unfused serving step
+                      attention                itself: rope + scatter +
+                                               gather is ALREADY the
+                                               reference math, so
+                                               ``fused_decode`` with
+                                               kernels="xla" is a no-op
+fused + int8          fused_rope_paged_        same, via quant_line_write
+                      attention (qmax)
+====================  =======================  =========================
+
+Every fused variant is bitwise-identical to its unfused counterpart on
+the same backend: the builder reuses one attention body (same op
+order, same online-softmax accumulation over the same (request, page)
+grid), the in-kernel RoPE mirrors ``apply_rope`` op-for-op, and the
+in-kernel quantized commit mirrors ``kv_quant.quant_line_write``
+page-locally (running amax, rescale-on-growth, offset-0 reset). The
+only unspecified bytes are the shared scratch page's, which both paths
+write with padding garbage and neither ever reads.
+
 On non-TPU backends the Pallas kernels fall back to ``interpret=True``
 so tests run on the CPU mesh.
 """
@@ -387,42 +426,59 @@ def ragged_paged_attention_xla(
     return out.reshape(R, C, H, dk)
 
 
-def _ragged_paged_kernel(
-    pt_ref,       # scalar-prefetch: (R, NP) int32 page table
-    q_ref,        # (1, C, KV, G, dk)
-    k_ref,        # (1, ps, KV, dk) — physical page picked by index map
-    v_ref,        # (1, ps, KV, dk)
-    mask_ref,     # (1, C, ps)
-    out_ref,      # (1, C, KV, G, dk)
-    o_scr,        # VMEM (C, KV, G, dk) f32
-    m_scr,        # VMEM (C, KV, G) f32
-    l_scr,        # VMEM (C, KV, G) f32
+def _rope_rotate(x, cos, sin):
+    """Rotate-half RoPE on the trailing head dim, op-for-op the XLA
+    ``apply_rope`` (models/llama.py, models/transformer.py) so the
+    in-kernel prologue stays bitwise-identical to the unfused path.
+    ``cos``/``sin`` arrive pre-broadcast against ``x``; partial rotary
+    widths (``cos.shape[-1] < head_dim``, Phi-style) pass the tail of
+    each head through untouched."""
+    rot = cos.shape[-1]
+    xr = x[..., :rot]
+    half = rot // 2
+    x1, x2 = xr[..., :half], xr[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    out = xr * cos + rotated * sin
+    if x.shape[-1] > rot:
+        out = jnp.concatenate([out, x[..., rot:].astype(out.dtype)], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _build_ragged_paged_kernel(
     *,
+    quant: bool,
+    fused: bool,
+    C: int,
     scale: float,
+    qmax: float = 0.0,
+    has_rope: bool = True,
 ):
-    p = pl.program_id(1)
+    """ONE builder for every Pallas variant of the ragged paged kernel
+    (see the module-docstring matrix): ``quant`` folds the per-page
+    int8 dequant scales into the batched dots' OUTPUTS (scores ×=
+    k_scale[kv], pv ×= v_scale[kv] — scales are constant within a
+    page, so scaling the O(C·G·ps) scores and O(C·G·dk) pv is exact
+    and strictly cheaper than scaling the O(ps·dk) operands);
+    ``fused`` adds the megakernel prologue (in-kernel RoPE + KV page
+    write through aliased pool outputs). The quant and fused axes
+    compose, so the four kernel variants share one attention body
+    instead of four hand-maintained copies."""
 
-    @pl.when(p == 0)
-    def _():
-        o_scr[:] = jnp.zeros_like(o_scr)
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-
-    mask = mask_ref[0]  # (C, ps) — already bounded: S_virt = NP*ps exactly
-
-    @pl.when(jnp.any(mask))
-    def _():
-        q = q_ref[0].astype(jnp.float32)            # (C, KV, G, dk)
-        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # (KV, ps, dk)
-        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
-        C, KV, G = q.shape[0], q.shape[1], q.shape[2]
-        # (KV, C*G, dk) grouped layout: one batched dot per KV head
+    def _attend(q, k, v, ks, vs, mask, o_scr, m_scr, l_scr):
+        # q (C, KV, G, dk) f32; k/v (KV, ps, dk) f32; ks/vs (KV,) f32
+        # (quant only); one batched dot per KV head over the grouped
+        # (KV, C*G, dk) query layout
+        KV, G = q.shape[1], q.shape[2]
         qkv = q.transpose(1, 0, 2, 3).reshape(KV, C * G, q.shape[-1])
         scores = jax.lax.dot_general(
             qkv, k,
             dimension_numbers=(((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale                                   # (KV, C*G, ps)
+        )                                           # (KV, C*G, ps)
+        if quant:
+            scores = scores * (ks[:, None, None] * scale)  # dequant K
+        else:
+            scores = scores * scale
         scores = scores.reshape(KV, C, G, -1).transpose(1, 0, 2, 3)
         scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
         m_new = jnp.maximum(m_scr[:], scores.max(axis=-1))
@@ -436,84 +492,174 @@ def _ragged_paged_kernel(
             dimension_numbers=(((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )  # (KV, C*G, dk)
+        if quant:
+            pv = pv * vs[:, None, None]             # dequant V
         pv = pv.reshape(KV, C, G, -1).transpose(1, 0, 2, 3)
         o_scr[:] = o_scr[:] * corr[..., None] + pv
         m_scr[:] = m_new
 
-    @pl.when(p == pl.num_programs(1) - 1)
-    def _():
-        l = jnp.maximum(l_scr[:], 1e-20)
-        out_ref[0] = (o_scr[:] / l[..., None]).astype(out_ref.dtype)
-
-
-def _ragged_paged_quant_kernel(
-    pt_ref,       # scalar-prefetch: (R, NP) int32 page table
-    q_ref,        # (1, C, KV, G, dk)
-    k_ref,        # (1, ps, KV, dk) int8 — physical page via index map
-    v_ref,        # (1, ps, KV, dk) int8
-    ks_ref,       # (1, KV) f32 — the page's K scales (same index map)
-    vs_ref,       # (1, KV) f32
-    mask_ref,     # (1, C, ps)
-    out_ref,      # (1, C, KV, G, dk)
-    o_scr,        # VMEM (C, KV, G, dk) f32
-    m_scr,        # VMEM (C, KV, G) f32
-    l_scr,        # VMEM (C, KV, G) f32
-    *,
-    scale: float,
-):
-    """Quantized twin of :func:`_ragged_paged_kernel`: the page DMA
-    moves int8 codes (half the bf16 bytes — the whole point), and the
-    per-page-per-head dequant scales fold into the batched dots'
-    OUTPUTS (scores ×= k_scale[kv], pv ×= v_scale[kv]) rather than
-    materialising a dequantized (ps, KV, dk) block — scales are
-    constant within a page, so scaling the O(C·G·ps) scores and
-    O(C·G·dk) pv is exact and strictly cheaper than scaling the
-    O(ps·dk) operands."""
-    p = pl.program_id(1)
-
-    @pl.when(p == 0)
-    def _():
+    def _init(o_scr, m_scr, l_scr):
         o_scr[:] = jnp.zeros_like(o_scr)
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    mask = mask_ref[0]  # (C, ps)
+    def _finalize(p, out_ref, o_scr, l_scr):
+        @pl.when(p == pl.num_programs(1) - 1)
+        def _():
+            l = jnp.maximum(l_scr[:], 1e-20)
+            out_ref[0] = (o_scr[:] / l[..., None]).astype(out_ref.dtype)
 
-    @pl.when(jnp.any(mask))
-    def _():
-        q = q_ref[0].astype(jnp.float32)            # (C, KV, G, dk)
-        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # (KV, ps, dk)
-        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
-        ks = ks_ref[0]                              # (KV,)
-        vs = vs_ref[0]
-        C, KV, G = q.shape[0], q.shape[1], q.shape[2]
-        qkv = q.transpose(1, 0, 2, 3).reshape(KV, C * G, q.shape[-1])
-        scores = jax.lax.dot_general(
-            qkv, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * (ks[:, None, None] * scale)             # dequant K via scores
-        scores = scores.reshape(KV, C, G, -1).transpose(1, 0, 2, 3)
-        scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
-        m_new = jnp.maximum(m_scr[:], scores.max(axis=-1))
-        prob = jnp.exp(scores - m_new[..., None])
-        prob = jnp.where(mask[:, None, None, :], prob, 0.0)
-        corr = jnp.exp(m_scr[:] - m_new)
-        l_scr[:] = l_scr[:] * corr + prob.sum(axis=-1)
-        pk = prob.transpose(1, 0, 2, 3).reshape(KV, C * G, -1)
-        pv = jax.lax.dot_general(
-            pk, v,
-            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * vs[:, None, None]                       # dequant V via pv
-        pv = pv.reshape(KV, C, G, -1).transpose(1, 0, 2, 3)
-        o_scr[:] = o_scr[:] * corr[..., None] + pv
-        m_scr[:] = m_new
+    def _quant_commit(pool_out, scale_in, lines, belongs, offs):
+        """In-kernel ``kv_quant.quant_line_write`` restricted to the
+        current page block: running per-page amax, rescale-on-growth,
+        offset-0 scale reset — op-for-op the XLA write-side contract,
+        page-locally (pages are slot-private or the never-read scratch
+        page, so the global scatter degenerates to this). ``pool_out``
+        already holds the copied-through page codes; on exit it holds
+        the requantized codes plus the new lines. Returns the page's
+        final (KV,) scale — also the dequant scale attention uses,
+        exactly as the unfused path reads the post-write scale row."""
+        vf = lines.astype(jnp.float32)                 # (C, KV, dk)
+        amax = jnp.max(jnp.abs(vf), axis=-1)           # (C, KV)
+        page_amax = jnp.where(belongs[:, None], amax, 0.0).max(axis=0)
+        first = belongs[0] & (offs[0] == 0)
+        for c in range(1, C):
+            first = first | (belongs[c] & (offs[c] == 0))
+        old = jnp.where(first, 0.0, scale_in)          # (KV,)
+        new = jnp.maximum(old, page_amax / qmax)
+        ratio = jnp.where(new > 0.0, old / jnp.maximum(new, 1e-30), 0.0)
+        codes = pool_out[0].astype(jnp.float32)        # (ps, KV, dk)
+        pool_out[0] = jnp.round(
+            codes * ratio[None, :, None]
+        ).astype(pool_out.dtype)
+        q = jnp.round(vf / jnp.maximum(new, 1e-30)[None, :, None])
+        q = jnp.clip(q, -qmax, qmax).astype(pool_out.dtype)
+        for c in range(C):
+            @pl.when(belongs[c])
+            def _(c=c):
+                pool_out[0, offs[c]] = q[c]
+        return new
 
-    @pl.when(p == pl.num_programs(1) - 1)
-    def _():
-        l = jnp.maximum(l_scr[:], 1e-20)
-        out_ref[0] = (o_scr[:] / l[..., None]).astype(out_ref.dtype)
+    def plain_kernel(*refs):
+        # (pt, q, k, v, [ks, vs], mask) -> out; o/m/l scratch
+        i = 1  # refs[0] is the scalar-prefetched page table
+        q_ref = refs[i]; i += 1         # (1, C, KV, G, dk)
+        k_ref = refs[i]; i += 1         # (1, ps, KV, dk) via index map
+        v_ref = refs[i]; i += 1
+        if quant:
+            ks_ref = refs[i]; i += 1    # (1, KV) f32 page scales
+            vs_ref = refs[i]; i += 1
+        mask_ref = refs[i]; i += 1      # (1, C, ps)
+        out_ref = refs[i]; i += 1       # (1, C, KV, G, dk)
+        o_scr, m_scr, l_scr = refs[i:i + 3]
+
+        p = pl.program_id(1)
+
+        @pl.when(p == 0)
+        def _():
+            _init(o_scr, m_scr, l_scr)
+
+        mask = mask_ref[0]  # (C, ps) — already bounded: S_virt = NP*ps
+
+        @pl.when(jnp.any(mask))
+        def _():
+            q = q_ref[0].astype(jnp.float32)
+            k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+            v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+            ks = ks_ref[0] if quant else None
+            vs = vs_ref[0] if quant else None
+            _attend(q, k, v, ks, vs, mask, o_scr, m_scr, l_scr)
+
+        _finalize(p, out_ref, o_scr, l_scr)
+
+    def fused_kernel(*refs):
+        # (pt, logical, off, q_raw, k_new, v_new, [cos, sin],
+        #  k_page, v_page, [ks, vs], mask)
+        #   -> (out, k_page', v_page', [ks', vs']); pool outputs alias
+        #      the pools, so unvisited pages keep their bytes
+        pt_ref, lg_ref, off_ref = refs[0], refs[1], refs[2]
+        i = 3
+        q_ref = refs[i]; i += 1         # (1, C, KV, G, dk) pre-RoPE
+        kn_ref = refs[i]; i += 1        # (1, C, KV, dk) pre-RoPE
+        vn_ref = refs[i]; i += 1        # (1, C, KV, dk)
+        if has_rope:
+            cos_ref = refs[i]; i += 1   # (1, C, rot) f32
+            sin_ref = refs[i]; i += 1
+        k_ref = refs[i]; i += 1         # (1, ps, KV, dk) page block
+        v_ref = refs[i]; i += 1
+        if quant:
+            ks_ref = refs[i]; i += 1    # (1, KV) f32
+            vs_ref = refs[i]; i += 1
+        mask_ref = refs[i]; i += 1      # (1, C, ps)
+        out_ref = refs[i]; i += 1       # (1, C, KV, G, dk)
+        k_out = refs[i]; i += 1         # (1, ps, KV, dk) aliased pool
+        v_out = refs[i]; i += 1
+        if quant:
+            ks_out = refs[i]; i += 1    # (1, KV) aliased scale row
+            vs_out = refs[i]; i += 1
+        o_scr, m_scr, l_scr = refs[i:i + 3]
+        q_scr = refs[i + 3]             # (C, KV, G, dk) roped q, q dtype
+        k_scr = refs[i + 4]             # (C, KV, dk) roped k, k dtype
+
+        r = pl.program_id(0)
+        p = pl.program_id(1)
+
+        @pl.when(p == 0)
+        def _():
+            _init(o_scr, m_scr, l_scr)
+            # RoPE once per row, reused across every page step; stored
+            # at the model dtype so the double f32→dtype→f32 cast of
+            # the unfused path (XLA rope, then kernel load) is mirrored
+            if has_rope:
+                cos = cos_ref[0]        # (C, rot) f32
+                sin = sin_ref[0]
+                q_scr[:] = _rope_rotate(
+                    q_ref[0], cos[:, None, None, :], sin[:, None, None, :]
+                )
+                k_scr[:] = _rope_rotate(
+                    kn_ref[0], cos[:, None, :], sin[:, None, :]
+                )
+            else:
+                q_scr[:] = q_ref[0]
+                k_scr[:] = kn_ref[0]
+
+        # ---- prologue: commit this row's fresh K/V lines landing in
+        # this grid step's page. Every visited page is written back as
+        # a full block (copy-through + line writes): untouched pages
+        # round-trip identical bytes, the token's page carries the new
+        # lines, and aliasing keeps unvisited pages' bytes in place.
+        k_out[0] = k_ref[0]
+        v_out[0] = v_ref[0]
+        belongs = [lg_ref[r, c] == p for c in range(C)]
+        offs = [off_ref[r, c] for c in range(C)]
+        if quant:
+            bvec = jnp.stack(belongs)
+            ks_new = _quant_commit(k_out, ks_ref[0], k_scr[:], bvec, offs)
+            vs_new = _quant_commit(v_out, vs_ref[0], vn_ref[0], bvec, offs)
+            ks_out[0] = ks_new
+            vs_out[0] = vs_new
+        else:
+            ks_new = vs_new = None
+            for c in range(C):
+                @pl.when(belongs[c])
+                def _(c=c):
+                    k_out[0, offs[c]] = k_scr[c].astype(k_out.dtype)
+                    v_out[0, offs[c]] = vn_ref[0, c].astype(v_out.dtype)
+
+        mask = mask_ref[0]  # (C, ps)
+
+        @pl.when(jnp.any(mask))
+        def _():
+            q = q_scr[:].astype(jnp.float32)
+            # attention reads the page through the freshly written
+            # block — the fresh K/V never left VMEM
+            k = k_out[0].astype(jnp.float32).transpose(1, 0, 2)
+            v = v_out[0].astype(jnp.float32).transpose(1, 0, 2)
+            _attend(q, k, v, ks_new, vs_new, mask, o_scr, m_scr, l_scr)
+
+        _finalize(p, out_ref, o_scr, l_scr)
+
+    return fused_kernel if fused else plain_kernel
 
 
 def ragged_paged_attention(
@@ -555,8 +701,10 @@ def ragged_paged_attention(
                      lambda r, p, pt: (pt[r, p], 0, 0, 0)),
     ]
     operands = [qg, k_pool, v_pool]
+    kernel = _build_ragged_paged_kernel(
+        quant=k_scale is not None, fused=False, C=C, scale=scale
+    )
     if k_scale is not None:
-        kernel = functools.partial(_ragged_paged_quant_kernel, scale=scale)
         in_specs += [
             pl.BlockSpec((1, KV), lambda r, p, pt: (pt[r, p], 0)),
             pl.BlockSpec((1, KV), lambda r, p, pt: (pt[r, p], 0)),
@@ -564,8 +712,6 @@ def ragged_paged_attention(
         operands += [
             k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)
         ]
-    else:
-        kernel = functools.partial(_ragged_paged_kernel, scale=scale)
     in_specs.append(pl.BlockSpec((1, C, ps), lambda r, p, pt: (r, 0, p)))
     operands.append(mask)
 
@@ -588,3 +734,149 @@ def ragged_paged_attention(
         interpret=_interpret(),
     )(page_table.astype(jnp.int32), *operands)
     return out.reshape(R, C, H, dk)
+
+
+def fused_rope_paged_attention(
+    q: jnp.ndarray,           # (R, C, H, dk) — PRE-RoPE query projection
+    k_new: jnp.ndarray,       # (R, C, KV, dk) — PRE-RoPE key projection
+    v_new: jnp.ndarray,       # (R, C, KV, dk) — value projection
+    cos: Optional[jnp.ndarray],   # (R, C, rot) f32, or None (no-RoPE family)
+    sin: Optional[jnp.ndarray],
+    k_pool: jnp.ndarray,      # (P+1, ps, KV, dk) — model dtype or int8 codes
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,  # (R, NP) int32
+    logical: jnp.ndarray,     # (R, C) int32 logical page of each new line
+    off: jnp.ndarray,         # (R, C) int32 in-page offset of each new line
+    mask: jnp.ndarray,        # (R, C, NP*ps) bool
+    *,
+    scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # (P+1, KV) f32 (quantized pool)
+    v_scale: Optional[jnp.ndarray] = None,
+    qmax: Optional[float] = None,
+):
+    """Megakernel decode-step prologue fused into ragged paged
+    attention: one ``pallas_call`` applies RoPE to Q/K, commits the
+    fresh K/V lines into their table-resolved pages (quantizing at the
+    page scales when ``qmax`` is set — the in-kernel twin of
+    ``kv_quant.quant_line_write``) and runs the ragged paged attention
+    pass, all in VMEM. The pools (and, quantized, their scale rows)
+    are ALIASED outputs: unvisited pages keep their bytes, visited
+    pages round-trip (identity copy-through), the written page carries
+    the new lines. Returns ``(out, k_pool, v_pool, k_scale, v_scale)``
+    — scales None on a full-precision pool.
+
+    Bitwise contract: identical outputs and identical (non-scratch)
+    pool bytes vs the unfused composition ``apply_rope → pool scatter
+    (or quant_line_write) → ragged_paged_attention`` — same op order,
+    same grid, same accumulation (tests/test_fused_decode.py). The
+    XLA serving fallback needs no fused twin at all: the unfused step
+    IS the reference math, so ``fused_decode`` with kernels="xla"
+    routes through it unchanged.
+
+    Intended for decode / small mixed chunks: the per-line commit
+    unrolls over C, and every page in a row's table is written back
+    (identity for untouched pages) — decode (C=1) is the case whose
+    dispatch and HBM round-trips this removes."""
+    R, C, H, dk = q.shape
+    _, ps, KV, _ = k_pool.shape
+    NP = page_table.shape[1]
+    G = H // KV
+    scale = scale if scale is not None else 1.0 / math.sqrt(dk)
+    quant = qmax is not None
+    has_rope = cos is not None
+    qg = q.reshape(R, C, KV, G, dk)
+    grid = (R, NP)
+
+    kernel = _build_ragged_paged_kernel(
+        quant=quant, fused=True, C=C, scale=scale,
+        qmax=float(qmax) if quant else 0.0, has_rope=has_rope,
+    )
+
+    in_specs = [
+        pl.BlockSpec((1, C, KV, G, dk),
+                     lambda r, p, pt, lg, of: (r, 0, 0, 0, 0)),
+        pl.BlockSpec((1, C, KV, dk),
+                     lambda r, p, pt, lg, of: (r, 0, 0, 0)),
+        pl.BlockSpec((1, C, KV, dk),
+                     lambda r, p, pt, lg, of: (r, 0, 0, 0)),
+    ]
+    operands = [qg, k_new, v_new]
+    if has_rope:
+        rot = cos.shape[-1]
+        in_specs += [
+            pl.BlockSpec((1, C, rot), lambda r, p, pt, lg, of: (r, 0, 0)),
+            pl.BlockSpec((1, C, rot), lambda r, p, pt, lg, of: (r, 0, 0)),
+        ]
+        operands += [cos, sin]
+    # operand index of k_pool in the flattened pallas_call argument
+    # list (scalar-prefetch args included) — the aliasing keys
+    idx0 = 6 + (2 if has_rope else 0)
+    in_specs += [
+        pl.BlockSpec((1, ps, KV, dk),
+                     lambda r, p, pt, lg, of: (pt[r, p], 0, 0, 0)),
+        pl.BlockSpec((1, ps, KV, dk),
+                     lambda r, p, pt, lg, of: (pt[r, p], 0, 0, 0)),
+    ]
+    operands += [k_pool, v_pool]
+    aliases = {idx0: 1, idx0 + 1: 2}
+    out_shapes = [
+        jax.ShapeDtypeStruct((R, C, KV, G, dk), q.dtype),
+        jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+        jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, C, KV, G, dk),
+                     lambda r, p, pt, lg, of: (r, 0, 0, 0, 0)),
+        pl.BlockSpec((1, ps, KV, dk),
+                     lambda r, p, pt, lg, of: (pt[r, p], 0, 0, 0)),
+        pl.BlockSpec((1, ps, KV, dk),
+                     lambda r, p, pt, lg, of: (pt[r, p], 0, 0, 0)),
+    ]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, KV), lambda r, p, pt, lg, of: (pt[r, p], 0)),
+            pl.BlockSpec((1, KV), lambda r, p, pt, lg, of: (pt[r, p], 0)),
+        ]
+        operands += [
+            k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)
+        ]
+        aliases[idx0 + 2] = 3
+        aliases[idx0 + 3] = 4
+        out_shapes += [
+            jax.ShapeDtypeStruct(k_scale.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v_scale.shape, jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec((1, KV), lambda r, p, pt, lg, of: (pt[r, p], 0)),
+            pl.BlockSpec((1, KV), lambda r, p, pt, lg, of: (pt[r, p], 0)),
+        ]
+    in_specs.append(
+        pl.BlockSpec((1, C, ps), lambda r, p, pt, lg, of: (r, 0, p))
+    )
+    operands.append(mask)
+
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[
+                pltpu.VMEM((C, KV, G, dk), jnp.float32),
+                pltpu.VMEM((C, KV, G), jnp.float32),
+                pltpu.VMEM((C, KV, G), jnp.float32),
+                pltpu.VMEM((C, KV, G, dk), q.dtype),     # roped q
+                pltpu.VMEM((C, KV, dk), k_new.dtype),    # roped k
+            ],
+        ),
+        input_output_aliases=aliases,
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), logical.astype(jnp.int32),
+      off.astype(jnp.int32), *operands)
+    if quant:
+        out, k_pool, v_pool, ks, vs = outs
+        return out.reshape(R, C, H, dk), k_pool, v_pool, ks, vs
+    out, k_pool, v_pool = outs
+    return out.reshape(R, C, H, dk), k_pool, v_pool, None, None

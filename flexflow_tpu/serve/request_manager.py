@@ -43,7 +43,7 @@ from .batch_config import (
     StreamEvent,
 )
 from .engine import InferenceEngine
-from .sampling import sample_tokens
+from .sampling import choose_sample_mode, sample_tokens
 
 
 class RequestStatus(enum.Enum):
@@ -100,6 +100,11 @@ class RequestManager:
     # engines (SpecInfer: the SSM pool pages independently, so a splice
     # into the LLM table has no SSM counterpart) opt out.
     supports_prefix_cache = True
+    # The "sampling" decode fusion's sync path (engine.run_sampled)
+    # bypasses the _run_batch hook; managers that override _run_batch
+    # to keep a second engine in sync (SpecInfer) opt out and keep the
+    # two-dispatch step + host sample.
+    supports_fused_sampling = True
 
     def __init__(
         self,
@@ -600,9 +605,15 @@ class RequestManager:
 
     def _sample(self, logits) -> np.ndarray:
         """Sample one token per slot from (R, V) logits using each slot's
-        GenerationConfig (mixed greedy/sampling in one program)."""
+        GenerationConfig (mixed greedy/sampling in one program). The
+        head is mode-specialized host-side (serve/sampling.py): a
+        greedy-only batch — the common decode case — skips the (R, V)
+        sorts entirely, bitwise-identically."""
         greedy, temp, topp, topk = self._decode_head_params(
             [self.requests[r] for r in self.slots if r is not None]
+        )
+        mode, cap = choose_sample_mode(
+            greedy, topp, topk, self.engine.cfg.vocab_size
         )
         self._key, sub = jax.random.split(self._key)
         toks = sample_tokens(
@@ -612,7 +623,12 @@ class RequestManager:
             temperature=jnp.asarray(temp, dtype=jnp.float32),
             topp=jnp.asarray(topp, dtype=jnp.float32),
             topk_arr=jnp.asarray(topk, dtype=jnp.int32),
+            mode=mode,
+            topk_cap=cap,
         )
+        # the host-side decode head is its own dispatched program — the
+        # figure the fused sampling epilogue's one-program step beats
+        self.engine.count_dispatch("host_sample")
         return np.asarray(jax.device_get(toks))
 
     def _append_token(self, req: Request, token: int):
@@ -940,8 +956,24 @@ class RequestManager:
             return bool(self.pending)
         prefilling = self._active(RequestStatus.PREFILLING)
         decoding = self._active(RequestStatus.DECODING)
-        logits = self._run_batch(bc)
-        sampled = self._sample(logits)
+        if (
+            "sampling" in self.engine.serving.fused_decode
+            and self.supports_fused_sampling
+        ):
+            # fused sampling epilogue: ONE dispatched program per sync
+            # step (step + on-device decode head) instead of two — the
+            # (R, V) logits never reach the host. Same single key split
+            # per step as the unfused path, so generations are bitwise
+            # identical.
+            greedy, temp, topp, topk = self._decode_head_params(
+                [self.requests[r] for r in self.slots if r is not None]
+            )
+            self._key, sub = jax.random.split(self._key)
+            toks = self.engine.run_sampled(bc, sub, greedy, temp, topp, topk)
+            sampled = np.asarray(jax.device_get(toks))
+        else:
+            logits = self._run_batch(bc)
+            sampled = self._sample(logits)
         for req in decoding:
             req.n_cached += 1
             req.n_sched = req.n_cached

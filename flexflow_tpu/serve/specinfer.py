@@ -177,6 +177,10 @@ class SpecInferManager(RequestManager):
     # page independently, so a spliced LLM prefix would leave the SSM
     # cache cold and desync verification — opt out.
     supports_prefix_cache = False
+    # run_sampled bypasses the _run_batch hook that keeps the SSM cache
+    # in step with the LLM's — the fused sampling sync path would
+    # desync verification, so spec managers keep step + host sample.
+    supports_fused_sampling = False
 
     def __init__(
         self,

@@ -60,6 +60,11 @@ SERVE_API = (
     "commit_kv_paged",
     "reorder_slots_paged",
     "copy_page_kv",
+    # megakernel decode step (PR 6): the per-family capability tuple
+    # the engine validates ServingConfig.fused_decode against — the
+    # fused variants themselves ride on serve_step_paged's
+    # ``fused_rope=...`` kwarg (carried by reference, like kv_quant)
+    "FUSED_DECODE",
     # triage + params
     "serve_debug_activations",
     "forward",

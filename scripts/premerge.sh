@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# premerge.sh — the one-command pre-merge gate.
+#
+# Runs, in order of increasing cost and on CPU (JAX_PLATFORMS=cpu, so
+# it works on any dev box):
+#   1. ffcheck            — static JAX/TPU hazard lint (zero findings)
+#   2. family re-exports  — every model family exposes the serve API
+#   3. fused parity       — the fast megakernel decode-step suite:
+#                           fused-vs-unfused bitwise parity + the
+#                           retrace-guard churn tests (zero steady-state
+#                           recompiles with both fusions on)
+#
+# Exits non-zero at the first failing gate. Full tier-1 (ROADMAP.md
+# "Tier-1 verify") is the merge bar; this is the fast inner loop.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== premerge 1/3: ffcheck (static hazard lint)" >&2
+python scripts/ffcheck.py
+
+echo "== premerge 2/3: family serve-API re-exports" >&2
+python scripts/check_family_reexports.py
+
+echo "== premerge 3/3: fused decode parity + retrace guard" >&2
+# unfiltered: runs the interpret-mode Pallas e2e tests that tier-1
+# slow-marks for time-budget reasons
+python -m pytest tests/test_fused_decode.py tests/test_retrace_guard.py \
+    -q -p no:cacheprovider
+
+echo "premerge: all gates passed" >&2

@@ -38,12 +38,13 @@ def tiny():
     return cfg, params
 
 
-def churn_engine(tiny, kv_layout, sanitizers):
+def churn_engine(tiny, kv_layout, sanitizers, fused=()):
     """64 slots; paged adds a TIGHT pool (preemption under load) plus
     prefix caching (splice/eviction/COW churn). ``paged-q`` is the
     int8-KV variant: the f32 budget is cut to a quarter so the ~3.9x
     page multiplier of the quantized accounting lands the pool at the
-    same page count — same churn, quantized pages."""
+    same page count — same churn, quantized pages. ``fused`` switches
+    on megakernel decode-step fusions (ServingConfig.fused_decode)."""
     cfg, params = tiny
     kw = {}
     if kv_layout in ("paged", "paged-q"):
@@ -65,6 +66,7 @@ def churn_engine(tiny, kv_layout, sanitizers):
         cache_dtype=jnp.float32,
         kv_layout="paged" if kv_layout == "paged-q" else kv_layout,
         sanitizers=sanitizers,
+        fused_decode=fused,
         **kw,
     )
     return InferenceEngine(llama, cfg, params, sc)
@@ -85,8 +87,23 @@ def churn_prompts(cfg, n=96):
     return prompts
 
 
-def run_churn(rm, prompts):
-    rids = [rm.submit(p, max_new_tokens=6) for p in prompts]
+def run_churn(rm, prompts, mixed_sampling=False):
+    """``mixed_sampling`` gives every 4th request a per-row top-k head
+    (the rest stay greedy) so batches oscillate between decode-head
+    modes — exactly the churn the mode-tagged fused-sampling step keys
+    must absorb without a single retrace."""
+    from flexflow_tpu.serve import GenerationConfig
+
+    gens = [
+        # topp=2.0 keeps nucleus filtering off so mixed batches land on
+        # the bucketed top-k head, not the full-sort fallback
+        GenerationConfig(do_sample=True, topk=5, temperature=0.9, topp=2.0)
+        if mixed_sampling and i % 4 == 3 else GenerationConfig()
+        for i in range(len(prompts))
+    ]
+    rids = [
+        rm.submit(p, g, max_new_tokens=6) for p, g in zip(prompts, gens)
+    ]
     while rm.step():
         pass
     rm.drain()
@@ -136,6 +153,65 @@ def test_churn_one_compile_per_step_key(tiny, kv_layout):
     assert s.retraces == 0
     # donated dispatches were poisoned throughout
     assert eng.donation_sanitizer.n_poisoned > 0
+
+
+def test_churn_fused_decode_zero_retraces(tiny):
+    """The megakernel decode step under the headline churn workload:
+    both fusions on (fused_decode=("rope_kv_write", "sampling")) over
+    the tight paged pool with prefix caching — preemption, splice/COW
+    and eviction all exercised, with every 4th request on a top-k
+    decode head so the mode-specialized sampling step keys churn too.
+    The bar is the same as unfused: one compile per step key (the
+    mode-tagged keys each count once), ZERO steady-state retraces, and
+    sanitizers-on == sanitizers-off generations bitwise."""
+    cfg, _ = tiny
+    fused = ("rope_kv_write", "sampling")
+    eng = churn_engine(
+        tiny, "paged", ("retrace", "donation"), fused=fused
+    )
+    rm = RequestManager(eng)
+    # > 64 prompts: a second admission wave (prefix hits) + pool
+    # pressure (preemptions) — the same churn bar the unfused headline
+    # test sets
+    prompts = churn_prompts(cfg, n=80)
+    outs = run_churn(rm, prompts, mixed_sampling=True)
+    assert all(len(o) == 6 for o in outs)
+
+    s = rm.stats
+    assert s.preemptions > 0, "pool never exhausted — churn too soft"
+    assert s.prefix_hits > 0 and s.prefix_evictions > 0
+
+    # with a top-k row resident in some slot at every step, every
+    # batch lands on the bucketed "topk" head (topk=5 → cap 8); a
+    # greedy-only TAIL on the same (already-sealed-by-churn) engine
+    # then compiles the "greedy" head keys exactly once each
+    tail = [rm.submit(p, max_new_tokens=6) for p in churn_prompts(cfg, n=8)]
+    while rm.step():
+        pass
+    rm.drain()
+    assert all(len(rm.requests[r].output_tokens) == 6 for r in tail)
+
+    guard = eng.retrace_guard
+    guard.assert_one_compile_per_key()
+    assert guard.retraces == 0
+    counts = guard.compile_counts()
+    # the fused engine's mixed-step keys are sampling-mode-tagged; the
+    # workload uses exactly two head modes (bucketed top-k batches,
+    # then the greedy-only tail), each compiled once per chunk width
+    C = eng.serving.mixed_chunk
+    modes = {k[3] for k in counts if k[0] == "mixed_fused"}
+    assert modes == {"greedy", "topk"}, counts
+    assert all(v == 1 for v in counts.values()), counts
+    assert counts.get(("mixed_fused", C, False, "topk", 8)) == 1, counts
+    assert counts.get(("mixed_fused", C, False, "greedy", 0)) == 1, counts
+    assert eng.donation_sanitizer.n_poisoned > 0
+
+    # sanitizers are pure observers on the fused path too
+    outs_off = run_churn(
+        RequestManager(churn_engine(tiny, "paged", (), fused=fused)),
+        prompts, mixed_sampling=True,
+    )
+    assert outs == outs_off
 
 
 @pytest.mark.parametrize("kv_layout", ["paged", "paged-q"])
