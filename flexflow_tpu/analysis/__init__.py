@@ -56,6 +56,13 @@ FF106     static-hashability    ``static_argnums``/``static_argnames`` whose
                                 parameter defaults/annotations are unhashable
                                 (list/dict/set): jit raises, or retraces per
                                 call.
+FF107     sync-transfer         ``jax.device_get``/blocking
+                                ``jax.device_put``/``block_until_ready`` in
+                                host-side serve code reachable from the
+                                scheduler's hot path: one stray sync stalls
+                                every decode step — hierarchical-KV spill
+                                traffic must stay async (copy_to_host_async
+                                + harvest at the flush sync point).
 ========  ====================  ==============================================
 
 Suppressions: ``# ffcheck: disable=FF101 -- reason`` on (or alone

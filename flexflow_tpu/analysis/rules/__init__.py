@@ -11,6 +11,7 @@ from . import (
     host_sync,
     missing_donation,
     static_hashability,
+    sync_transfer,
     tracer_control_flow,
     unordered_iteration,
     weak_dtype,
@@ -23,6 +24,7 @@ _RULE_MODULES = (
     unordered_iteration,
     missing_donation,
     static_hashability,
+    sync_transfer,
 )
 
 ALL_RULES = tuple(m.RULE for m in _RULE_MODULES)

@@ -1,0 +1,162 @@
+"""FF107 sync-transfer: blocking device↔host transfers on the serving
+hot path.
+
+The hierarchical KV cache spills cold prefix pages to host RAM and
+re-admits them on a hit (serve/prefix_cache.py). That tier is only free
+because every transfer is ASYNC: ``fetch_page`` starts a
+``copy_to_host_async`` and the handle is harvested at the scheduler's
+flush (already a sync point); ``upload_page`` relies on dispatch
+ordering. A stray ``jax.device_get`` (or blocking ``jax.device_put`` /
+``block_until_ready``) introduced anywhere the scheduler's dispatch
+path can reach would serialize the dispatch-ahead pipeline — every
+decode step would wait out a PCIe round-trip, the exact stall the
+spill tier is designed never to cause.
+
+Unlike FF101 (host syncs inside jit-TRACED code), this rule walks the
+HOST-side scheduler: functions in ``flexflow_tpu/serve/`` reachable —
+through the file-local call graph, ``self.``-method calls included —
+from the serving hot-path roots (``step``/dispatch/admission/page
+reservation/prefix-cache attach+reclaim and the engine's ``run*``
+dispatch methods). Paths that block BY DESIGN (the pipeline flush, the
+blocking sync scheduler, triage dumps) carry explicit suppressions
+with reasons — the point is that every blocking transfer on the hot
+path is a reviewed decision, not an accident.
+
+Suppress with ``# ffcheck: disable=FF107 -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from ..lint import FileContext, Finding, FuncDef, Rule
+
+# Host-side entry points of the serving hot path: the scheduler's step
+# loop and everything it runs per iteration, the admission/page-
+# reservation path (where spill/readmit live), and the engine's
+# dispatch methods. Reachability is computed from these by name over
+# the file-local call graph.
+HOT_ROOTS = {
+    "step",
+    "_step_pipelined",
+    "_dispatch_mixed",
+    "_dispatch_decode",
+    "_reserve_active_pages",
+    "_admit_pending",
+    "_reclaim_slots_for_admission",
+    "_trim_pipeline",
+    "attach",
+    "reclaim",
+    "run",
+    "run_mixed",
+    "run_decode",
+    "run_sampled",
+    "run_speculate",
+    "commit",
+    "reorder",
+    "copy_page",
+    "fetch_page",
+    "upload_page",
+}
+
+# Calls that force a synchronous transfer / device round-trip.
+# ``.copy_to_host_async()`` is the blessed idiom and is not listed.
+SYNC_PATHS = {
+    "jax.device_get",
+    "jax.device_put",
+    "jax.block_until_ready",
+}
+SYNC_METHODS = {"block_until_ready"}
+
+
+class SyncTransferRule(Rule):
+    code = "FF107"
+    slug = "sync-transfer"
+    doc = (
+        "synchronous device<->host transfer (jax.device_get / blocking "
+        "jax.device_put / block_until_ready) reachable from the serving "
+        "hot path — spill-tier traffic must stay async"
+    )
+
+    def _applies(self, ctx: FileContext) -> bool:
+        path = ctx.path.replace("\\", "/")
+        return "/serve/" in path or path.startswith("serve/")
+
+    def _reachable(self, ctx: FileContext) -> Set[ast.AST]:
+        """Functions reachable from HOT_ROOTS over the file-local call
+        graph. Both plain-name calls (``attach(...)``) and method calls
+        (``self._flush_one(...)``) resolve by the callee's simple name
+        — the safe over-approximation for a one-file class."""
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in ctx.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        reachable: Set[ast.AST] = {
+            fn for fn in ctx.functions if fn.name in HOT_ROOTS
+        }
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(reachable):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = None
+                    if isinstance(node.func, ast.Name):
+                        name = node.func.id
+                    elif isinstance(node.func, ast.Attribute):
+                        name = node.func.attr
+                    for callee in by_name.get(name, ()):
+                        if callee not in reachable:
+                            reachable.add(callee)
+                            changed = True
+        # nested defs inherit their enclosing function's reachability
+        for fn in ctx.functions:
+            if fn in reachable:
+                continue
+            anc = ctx.enclosing_function(fn)
+            while anc is not None:
+                if anc in reachable:
+                    reachable.add(fn)
+                    break
+                anc = ctx.enclosing_function(anc)
+        return reachable
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not self._applies(ctx):
+            return
+        reachable = self._reachable(ctx)
+        seen: Set[int] = set()
+        for fn in reachable:
+            for stmt in fn.body if isinstance(fn, FuncDef) else []:
+                for node in ast.walk(stmt):
+                    if (
+                        not isinstance(node, ast.Call)
+                        or id(node) in seen
+                    ):
+                        continue
+                    seen.add(id(node))
+                    path = ctx.resolve(node.func)
+                    if path in SYNC_PATHS:
+                        yield self.finding(
+                            ctx, node,
+                            f"{path} on the serving hot path blocks the "
+                            "dispatch pipeline on a device round-trip — "
+                            "use the async spill idiom "
+                            "(copy_to_host_async + harvest at flush), "
+                            "or suppress with a reason if this path "
+                            "blocks by design",
+                        )
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in SYNC_METHODS
+                        and not node.args
+                    ):
+                        yield self.finding(
+                            ctx, node,
+                            f".{node.func.attr}() on the serving hot "
+                            "path stalls until the device drains — the "
+                            "hot loop must never wait on a transfer",
+                        )
+
+
+RULE = SyncTransferRule()

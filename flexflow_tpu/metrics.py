@@ -94,6 +94,17 @@ class SchedulerStats:
     prefix_inserts: int = 0
     prefix_evictions: int = 0
     prefix_cows: int = 0
+    # Hierarchical KV cache host tier (serve/prefix_cache.py spill,
+    # ServingConfig.host_cache_bytes): pages spilled device→host
+    # instead of evicted, pages re-admitted host→device on a later
+    # match, prompt tokens whose prefill a host hit skipped (the
+    # recompute the tier saved — also mirrored per-request into
+    # ProfileInfo.host_hit_tokens), and the host tier's current byte
+    # occupancy (a gauge, not a counter).
+    spills: int = 0
+    readmits: int = 0
+    host_hit_tokens: int = 0
+    host_bytes: int = 0
     # Retrace sentinel (analysis/retrace.py, wired when the engine runs
     # with ServingConfig.sanitizers=("retrace",)): XLA compiles of step
     # programs observed at the engine's jit chokepoint, and how many of
@@ -143,6 +154,16 @@ class SchedulerStats:
         n = self.prefix_hits + self.prefix_misses
         return self.prefix_hits / n if n else 0.0
 
+    @property
+    def host_hit_rate(self) -> float:
+        """Fraction of prefix-cache hit tokens served from the HOST
+        tier (re-admitted spilled pages) rather than live HBM pages —
+        how much of the cache's value survived memory pressure thanks
+        to spilling instead of eviction."""
+        if not self.prefix_hit_tokens:
+            return 0.0
+        return self.host_hit_tokens / self.prefix_hit_tokens
+
     def snapshot(self) -> Dict[str, float]:
         return {
             "steps": self.steps,
@@ -165,6 +186,11 @@ class SchedulerStats:
             "prefix_inserts": self.prefix_inserts,
             "prefix_evictions": self.prefix_evictions,
             "prefix_cows": self.prefix_cows,
+            "spills": self.spills,
+            "readmits": self.readmits,
+            "host_hit_tokens": self.host_hit_tokens,
+            "host_hit_rate": round(self.host_hit_rate, 4),
+            "host_bytes": self.host_bytes,
             "compiles": self.compiles,
             "retraces": self.retraces,
         }
@@ -182,6 +208,8 @@ class SchedulerStats:
             f"pfx_hit={s['prefix_hits']}/{s['prefix_hits'] + s['prefix_misses']}"
             f" pfx_toks={s['prefix_hit_tokens']} "
             f"pfx_evict={s['prefix_evictions']} pfx_cow={s['prefix_cows']} "
+            f"spill={s['spills']} readmit={s['readmits']} "
+            f"host_toks={s['host_hit_tokens']} host_B={s['host_bytes']} "
             f"compiles={s['compiles']} retraces={s['retraces']}"
         )
 

@@ -18,6 +18,7 @@ from .transformer import (  # noqa: F401  (engine serving protocol)
     commit_kv_paged,
     copy_page_kv,
     forward,
+    gather_page_kv,
     init_kv_cache,
     init_paged_kv_cache,
     init_params,
@@ -27,6 +28,7 @@ from .transformer import (  # noqa: F401  (engine serving protocol)
     param_pspecs,
     reorder_slots,
     reorder_slots_paged,
+    scatter_page_kv,
     serve_debug_activations,
     serve_step,
     serve_step_paged,

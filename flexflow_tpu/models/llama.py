@@ -772,10 +772,12 @@ def init_paged_kv_cache(
     unallocated entries never touch live pages (the paged analog of the
     dense layout's per-slot scratch row).
 
-    With ``kv_quant`` (serve/kv_quant.py) the pools store int8 codes
-    and the cache gains ``k_scale``/``v_scale``: (L, num_pages+1, KV)
-    f32 per-page-per-KV-head amax scales, zero-initialised (a zero
-    scale marks a page with no committed lines)."""
+    With ``kv_quant`` (serve/kv_quant.py) the pools store quantized
+    codes — int8, or packed int4 nibbles (two codes per byte along dk,
+    so the trailing dim is ``head_dim // 2``) — and the cache gains
+    ``k_scale``/``v_scale``: (L, num_pages+1, KV) f32
+    per-page-per-KV-head amax scales, zero-initialised (a zero scale
+    marks a page with no committed lines)."""
     L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
     dt = dtype or cfg.dtype
     spec = None
@@ -784,6 +786,13 @@ def init_paged_kv_cache(
 
         spec = resolve_spec(kv_quant)
         dt = spec.dtype
+        if dk % spec.pack:
+            raise ValueError(
+                f"kv_quant={kv_quant!r} packs {spec.pack} codes per "
+                f"element along head_dim, which needs head_dim "
+                f"({dk}) divisible by {spec.pack}"
+            )
+        dk = dk // spec.pack
     shape = (L, num_pages + 1, page_size, KV, dk)
     cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
     if spec is not None:
@@ -1012,6 +1021,36 @@ def copy_page_kv(
     scales together byte-for-byte."""
     return {
         name: buf.at[:, dst].set(buf[:, src])  # (L, P+1, ps|KV, ...)
+        for name, buf in cache.items()
+    }
+
+
+def gather_page_kv(
+    cache: Dict[str, jnp.ndarray],
+    page: jnp.ndarray,  # () int32 physical page
+) -> Dict[str, jnp.ndarray]:
+    """Slice one physical page's content out of every cache buffer —
+    the device half of a hierarchical-KV SPILL (serve/prefix_cache.py
+    host tier): the engine starts an async device→host copy on the
+    returned pytree and the page returns to the free list. Covers K/V
+    pools AND the quantized layout's per-page scale rows, so a spilled
+    page re-admits byte-for-byte."""
+    return {name: buf[:, page] for name, buf in cache.items()}
+
+
+def scatter_page_kv(
+    cache: Dict[str, jnp.ndarray],
+    page: jnp.ndarray,  # () int32 physical page
+    values: Dict[str, jnp.ndarray],
+) -> Dict[str, jnp.ndarray]:
+    """Write a previously spilled page's content (the pytree
+    :func:`gather_page_kv` produced) into pool row ``page`` — the
+    device half of a host-tier RE-ADMIT. Exact inverse of the gather:
+    codes and scales land byte-for-byte, which is what keeps
+    spilled-then-readmitted generation bitwise identical to the
+    never-evicted warm path."""
+    return {
+        name: buf.at[:, page].set(values[name])
         for name, buf in cache.items()
     }
 

@@ -862,9 +862,11 @@ def init_paged_kv_cache(
     """Pool (L, num_pages+1, page_size, KV, dk); pool row ``num_pages``
     is the shared scratch page. ALiBi/sliding-window configs also page
     the per-line position buffer. With ``kv_quant`` the pools store
-    int8 codes plus per-page-per-KV-head f32 ``k_scale``/``v_scale``
-    rows (serve/kv_quant.py; the position buffer stays int32 — it is
-    exact metadata, not tensor payload)."""
+    quantized codes — int8, or packed int4 nibbles (two codes per byte
+    along dk, trailing dim ``head_dim // 2``) — plus per-page-per-KV-
+    head f32 ``k_scale``/``v_scale`` rows (serve/kv_quant.py; the
+    position buffer stays int32 — it is exact metadata, not tensor
+    payload)."""
     L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
     dt = dtype or cfg.dtype
     spec = None
@@ -873,6 +875,13 @@ def init_paged_kv_cache(
 
         spec = resolve_spec(kv_quant)
         dt = spec.dtype
+        if dk % spec.pack:
+            raise ValueError(
+                f"kv_quant={kv_quant!r} packs {spec.pack} codes per "
+                f"element along head_dim, which needs head_dim "
+                f"({dk}) divisible by {spec.pack}"
+            )
+        dk = dk // spec.pack
     shape = (L, num_pages + 1, page_size, KV, dk)
     cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
     if spec is not None:
@@ -1126,6 +1135,31 @@ def copy_page_kv(cache, src, dst):
             out[name] = buf.at[dst].set(buf[src])
         else:              # (L, P+1, ps|KV, ...)
             out[name] = buf.at[:, dst].set(buf[:, src])
+    return out
+
+
+def gather_page_kv(cache, page):
+    """Slice one physical page out of every cache buffer (hierarchical-
+    KV spill read; see models.llama.gather_page_kv) — the position pool
+    pages like K/V but without the layer dim."""
+    out = {}
+    for name, buf in cache.items():
+        if name == "pos":  # (P+1, ps)
+            out[name] = buf[page]
+        else:              # (L, P+1, ps|KV, ...)
+            out[name] = buf[:, page]
+    return out
+
+
+def scatter_page_kv(cache, page, values):
+    """Write a spilled page's content back into pool row ``page``
+    (hierarchical-KV re-admit; see models.llama.scatter_page_kv)."""
+    out = {}
+    for name, buf in cache.items():
+        if name == "pos":
+            out[name] = buf.at[page].set(values[name])
+        else:
+            out[name] = buf.at[:, page].set(values[name])
     return out
 
 

@@ -114,6 +114,11 @@ class ProfileInfo:
     # Prompt tokens served from the prefix cache at admission (prefill
     # started past them); 0 on a miss or with caching off.
     cached_prefix_len: int = 0
+    # Of those, tokens whose pages were re-admitted from the HOST spill
+    # tier (hierarchical KV cache, ServingConfig.host_cache_bytes) —
+    # a host hit instead of the prefill recompute plain eviction would
+    # have cost; 0 with the tier off.
+    host_hit_tokens: int = 0
     llm_decoding_steps: int = 0
     ssm_decoding_steps: int = 0
     speculated_tokens: int = 0

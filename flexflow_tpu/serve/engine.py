@@ -85,11 +85,14 @@ class ServingConfig:
     # "int8": pages store int8 codes + per-page-per-KV-head f32 amax
     # scales; serve_step's KV write quantizes in the step and attention
     # dequantizes at read time (fused into the Pallas ragged paged
-    # kernel), so full-precision K/V never round-trip HBM. The
+    # kernel), so full-precision K/V never round-trip HBM. "int4":
+    # packed nibbles — two codes per byte along head_dim, unpacked in
+    # VMEM by the same kernel (logit tolerance is wider than int8's;
+    # README "Hierarchical KV cache" documents both). The
     # max_cached_tokens budget keeps meaning "this much KV HBM": the
-    # same budget buys ~2x the pages (kv_quant.quantized_pool_pages).
-    # "int4" is a designed-for layout that raises NotImplementedError.
-    # None (default) = full-precision cache_dtype pages.
+    # same budget buys ~2x (int8) / ~4x (int4) the pages
+    # (kv_quant.quantized_pool_pages; ≥1.9x / ≥3.8x measured after
+    # scale rows). None (default) = full-precision cache_dtype pages.
     kv_quant: Optional[str] = None
     # Automatic prefix caching (serve/prefix_cache.py, paged layout
     # only — a no-op passthrough on dense): finished requests' prompt
@@ -101,6 +104,20 @@ class ServingConfig:
     # intentionally outlive their requests, which changes the pool
     # accounting benchmarks/tests of the cold allocator assert on.
     prefix_caching: bool = False
+    # Hierarchical KV cache — host-RAM spill tier for cold prefix
+    # pages (serve/prefix_cache.py; requires prefix_caching): instead
+    # of dropping an idle cached page under pool pressure, its content
+    # (codes + scales) is copied to pinned host memory with an ASYNC
+    # device→host DMA and the HBM page is freed; a later prompt that
+    # matches the spilled prefix re-admits the page with an async
+    # host→device copy before splice — a cache miss to HBM becomes a
+    # host hit instead of a full prefill recompute. The value bounds
+    # the host tier in bytes (its own LRU drops cold host pages past
+    # it); None (default) = off, cold pages are simply evicted.
+    # Spill→re-admit round-trips are byte-exact, so generation over a
+    # re-admitted prefix is BITWISE the never-evicted warm path's
+    # (tests/test_kv_hierarchy.py).
+    host_cache_bytes: Optional[int] = None
     # What gets published into the prefix tree: "complete" (default) —
     # the whole sequence, prompt + generated, at request completion (the
     # multi-turn case: the next turn's prompt extends this turn's
@@ -293,6 +310,16 @@ class InferenceEngine:
                 f"unknown cache_policy {self.serving.cache_policy!r} "
                 "(expected 'complete' or 'prefill')"
             )
+        # Hierarchical KV host tier: validated up front — the spill
+        # path only exists as the prefix cache's eviction alternative.
+        if self.serving.host_cache_bytes:
+            if not self.paged or not self.serving.prefix_caching:
+                raise ValueError(
+                    "host_cache_bytes requires kv_layout='paged' with "
+                    "prefix_caching=True — the host tier spills cold "
+                    "prefix-cache pages, so there is nothing to spill "
+                    "without the radix tree"
+                )
         self.pager = None  # PageAllocator when paged (host-side tables)
         if self.pipelined:
             pp = self.mesh.shape["pipe"]
@@ -889,6 +916,7 @@ class InferenceEngine:
         for l, h in enumerate(acts):
             np.save(
                 os.path.join(outdir, f"step{step:05d}_layer{l:03d}.npy"),
+                # ffcheck: disable=FF107 -- inference_debugging triage dump: deliberately slow, forced off the fast path by the RequestManager
                 np.asarray(jax.device_get(h)),
             )
         self._debug_step += 1
@@ -945,6 +973,76 @@ class InferenceEngine:
                 jnp.asarray(dst, jnp.int32),
             )
         self._poison_donated(donated, "copy_page")
+
+    def fetch_page(self, page: int):
+        """Device→host SPILL read of one physical page (hierarchical KV
+        cache, serve/prefix_cache.py host tier): one jitted program
+        slices the page's content out of every cache buffer —
+        K/V codes, quantized scale rows, the generic decoder's position
+        lines — and an ASYNC host copy starts on each slice. Returns
+        the slice pytree immediately; the caller converts to host
+        arrays later (PrefixCache.harvest, at the scheduler's existing
+        flush sync point), so a spill never stalls a decode step
+        (ffcheck FF107 is the lint guard for that contract). The slice
+        buffers are data-independent of the pool from the moment the
+        program is enqueued, so freeing and reusing the page cannot
+        corrupt the copy."""
+        if "fetch_page" not in self._steps:
+            self._steps["fetch_page"] = self._jit(
+                self.model.gather_page_kv, key="fetch_page"
+            )
+        self.count_dispatch("fetch_page")
+        with _set_mesh(self.mesh):
+            out = self._steps["fetch_page"](
+                self.cache, jnp.asarray(page, jnp.int32)
+            )
+        for leaf in jax.tree.leaves(out):
+            leaf.copy_to_host_async()
+        return out
+
+    def upload_page(self, page: int, values) -> None:
+        """Host→device RE-ADMIT of a previously spilled page: one
+        jitted program (cache donated) writes the spilled content back
+        into pool row ``page``. ``values`` is whatever
+        :meth:`fetch_page` returned — harvested numpy arrays, or the
+        original device slices if the spill was never harvested (the
+        transfer then stays device-side). ``jax.device_put`` semantics
+        are async: the upload overlaps the host loop and orders before
+        the prefill step that reads the page."""
+        if "upload_page" not in self._steps:
+            self._steps["upload_page"] = self._jit(
+                self.model.scatter_page_kv, key="upload_page",
+                donate_argnums=(0,),
+            )
+        dtypes = {k: v.dtype for k, v in self.cache.items()}
+        donated = self.cache
+        self.count_dispatch("upload_page")
+        with _set_mesh(self.mesh):
+            self.cache = self._steps["upload_page"](
+                self.cache,
+                jnp.asarray(page, jnp.int32),
+                {
+                    k: jnp.asarray(v, dtype=dtypes[k])
+                    for k, v in values.items()
+                },
+            )
+        self._poison_donated(donated, "upload_page")
+
+    def page_host_bytes(self) -> int:
+        """Host bytes one spilled page occupies (every cache buffer's
+        per-page slice) — prices the ``host_cache_bytes`` budget."""
+        shapes = jax.eval_shape(
+            self.model.gather_page_kv,
+            jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self.cache,
+            ),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
+        return sum(
+            int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(shapes)
+        )
 
     def reorder(self, src_slots: np.ndarray):
         """Slot permutation/gather of the whole cache (beam search

@@ -51,15 +51,26 @@ variant               Pallas entry point       XLA fallback (CPU parity)
 plain                 ragged_paged_attention   ragged_paged_attention_xla
 int8 pages            ragged_paged_attention   ragged_paged_attention_xla
                       (k_scale/v_scale)        (k_scale/v_scale)
+int4 pages            ragged_paged_attention   ragged_paged_attention_xla
+(packed nibbles)      (uint8 pool; nibble      (dequant_pages unpacks the
+                      unpack in VMEM)          gathered codes)
 fused RoPE+KV-write   fused_rope_paged_        the unfused serving step
                       attention                itself: rope + scatter +
                                                gather is ALREADY the
                                                reference math, so
                                                ``fused_decode`` with
                                                kernels="xla" is a no-op
-fused + int8          fused_rope_paged_        same, via quant_line_write
+fused + int8/int4     fused_rope_paged_        same, via quant_line_write
                       attention (qmax)
 ====================  =======================  =========================
+
+The quant axis carries a ``pack`` factor inferred from the pool shapes
+(``dk // pool.shape[-1]``): pack=2 pools (int4) DMA uint8 pages of
+half the int8 bytes and unpack two nibble codes per byte in VMEM
+(``kv_quant.unpack_nibbles`` arithmetic, mirrored op-for-op by
+:func:`_unpack_codes` below — integer masks/shifts, exact on every
+backend) before the same scale-folded dots; the fused write side packs
+through the in-kernel twin of ``kv_quant.pack_nibbles``.
 
 Every fused variant is bitwise-identical to its unfused counterpart on
 the same backend: the builder reuses one attention body (same op
@@ -369,24 +380,53 @@ def gather_pages(pool: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
     return flat.reshape((R, NP * ps) + pool.shape[2:])
 
 
+def _unpack_codes(block: jnp.ndarray, pack: int) -> jnp.ndarray:
+    """Stored code block → f32 code values: identity cast for pack=1
+    (int8), nibble unpack for pack=2 (uint8 int4 pages — op-for-op
+    ``kv_quant.unpack_nibbles``: low nibble = head-dim entries
+    [0, dk/2), high nibble = [dk/2, dk), bias +8; integer arithmetic,
+    so the Pallas and XLA paths decode identical values)."""
+    if pack == 1:
+        return block.astype(jnp.float32)
+    b = block.astype(jnp.int32)
+    lo = (b & 0xF) - 8
+    hi = ((b >> 4) & 0xF) - 8
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
+
+
+def _pack_codes(codes: jnp.ndarray, dtype, pack: int) -> jnp.ndarray:
+    """f32 code values → stored block (inverse of :func:`_unpack_codes`;
+    the in-kernel twin of ``kv_quant.pack_nibbles``)."""
+    if pack == 1:
+        return codes.astype(dtype)
+    dk = codes.shape[-1]
+    c = codes.astype(jnp.int32) + 8
+    lo, hi = c[..., : dk // 2], c[..., dk // 2 :]
+    return (lo | (hi << 4)).astype(dtype)
+
+
 def dequant_pages(
-    pool: jnp.ndarray,        # (P+1, ps, KV, dk) int8 codes
+    pool: jnp.ndarray,        # (P+1, ps, KV, dk/pack) int8/uint8 codes
     scale: jnp.ndarray,       # (P+1, KV) f32 per-page-per-head scales
     page_table: jnp.ndarray,  # (R, NP) int32
     dtype,
 ) -> jnp.ndarray:
-    """Quantized twin of :func:`gather_pages`: gather the int8 virtual
-    cache through the table and dequantize each line at its page's
-    per-KV-head scale (serve/kv_quant.py layout). Returns the
+    """Quantized twin of :func:`gather_pages`: gather the quantized
+    virtual cache through the table and dequantize each line at its
+    page's per-KV-head scale (serve/kv_quant.py layout; uint8 pools
+    unpack two nibble codes per byte first). Returns the
     (R, NP*ps, KV, dk) full-precision virtual cache in ``dtype``."""
+    from .kv_quant import pool_pack
+
     R, NP = page_table.shape
     ps, KV = pool.shape[1], pool.shape[2]
-    codes = gather_pages(pool, page_table)        # (R, S, KV, dk) int8
+    codes = gather_pages(pool, page_table)        # (R, S, KV, dk/pack)
+    codes = _unpack_codes(codes, pool_pack(pool))  # (R, S, KV, dk) f32
     s = jnp.take(scale, page_table.reshape(-1), axis=0)  # (R*NP, KV)
     s = jnp.broadcast_to(
         s.reshape(R, NP, 1, KV), (R, NP, ps, KV)
     ).reshape(R, NP * ps, KV)
-    return (codes.astype(jnp.float32) * s[..., None]).astype(dtype)
+    return (codes * s[..., None]).astype(dtype)
 
 
 def ragged_paged_attention_xla(
@@ -403,9 +443,10 @@ def ragged_paged_attention_xla(
     """Shape-identical XLA fallback: gather the virtual cache through
     the page table, then the standard grouped-query masked softmax —
     bit-for-bit the dense ``serve_attention`` math on the gathered
-    lines. With ``k_scale``/``v_scale`` the pools hold int8 codes
-    (serve/kv_quant.py) and the gathered lines are dequantized at their
-    page scales first. Returns (R, C, H, dk)."""
+    lines. With ``k_scale``/``v_scale`` the pools hold quantized codes
+    (serve/kv_quant.py; packed int4 nibbles unpack first) and the
+    gathered lines are dequantized at their page scales. Returns
+    (R, C, H, dk)."""
     R, C, H, dk = q.shape
     KV = k_pool.shape[2]
     G = H // KV
@@ -452,17 +493,21 @@ def _build_ragged_paged_kernel(
     scale: float,
     qmax: float = 0.0,
     has_rope: bool = True,
+    pack: int = 1,
 ):
     """ONE builder for every Pallas variant of the ragged paged kernel
     (see the module-docstring matrix): ``quant`` folds the per-page
-    int8 dequant scales into the batched dots' OUTPUTS (scores ×=
+    dequant scales into the batched dots' OUTPUTS (scores ×=
     k_scale[kv], pv ×= v_scale[kv] — scales are constant within a
     page, so scaling the O(C·G·ps) scores and O(C·G·dk) pv is exact
     and strictly cheaper than scaling the O(ps·dk) operands);
-    ``fused`` adds the megakernel prologue (in-kernel RoPE + KV page
-    write through aliased pool outputs). The quant and fused axes
-    compose, so the four kernel variants share one attention body
-    instead of four hand-maintained copies."""
+    ``pack=2`` (int4) additionally unpacks two nibble codes per DMA'd
+    uint8 byte in VMEM before the dots — the page DMA moves HALF the
+    int8 bytes; ``fused`` adds the megakernel prologue (in-kernel RoPE
+    + KV page write through aliased pool outputs, packing through the
+    same nibble layout). The quant, pack and fused axes compose, so
+    the kernel variants share one attention body instead of
+    hand-maintained copies."""
 
     def _attend(q, k, v, ks, vs, mask, o_scr, m_scr, l_scr):
         # q (C, KV, G, dk) f32; k/v (KV, ps, dk) f32; ks/vs (KV,) f32
@@ -516,9 +561,12 @@ def _build_ragged_paged_kernel(
         page-locally (pages are slot-private or the never-read scratch
         page, so the global scatter degenerates to this). ``pool_out``
         already holds the copied-through page codes; on exit it holds
-        the requantized codes plus the new lines. Returns the page's
-        final (KV,) scale — also the dequant scale attention uses,
-        exactly as the unfused path reads the post-write scale row."""
+        the requantized codes plus the new lines (packed layouts
+        unpack, requantize on code values, and repack — the same
+        arithmetic as the XLA twin, so pool bytes stay bitwise).
+        Returns the page's final (KV,) scale — also the dequant scale
+        attention uses, exactly as the unfused path reads the
+        post-write scale row."""
         vf = lines.astype(jnp.float32)                 # (C, KV, dk)
         amax = jnp.max(jnp.abs(vf), axis=-1)           # (C, KV)
         page_amax = jnp.where(belongs[:, None], amax, 0.0).max(axis=0)
@@ -528,12 +576,12 @@ def _build_ragged_paged_kernel(
         old = jnp.where(first, 0.0, scale_in)          # (KV,)
         new = jnp.maximum(old, page_amax / qmax)
         ratio = jnp.where(new > 0.0, old / jnp.maximum(new, 1e-30), 0.0)
-        codes = pool_out[0].astype(jnp.float32)        # (ps, KV, dk)
-        pool_out[0] = jnp.round(
-            codes * ratio[None, :, None]
-        ).astype(pool_out.dtype)
+        codes = _unpack_codes(pool_out[0], pack)       # (ps, KV, dk)
+        pool_out[0] = _pack_codes(
+            jnp.round(codes * ratio[None, :, None]), pool_out.dtype, pack
+        )
         q = jnp.round(vf / jnp.maximum(new, 1e-30)[None, :, None])
-        q = jnp.clip(q, -qmax, qmax).astype(pool_out.dtype)
+        q = _pack_codes(jnp.clip(q, -qmax, qmax), pool_out.dtype, pack)
         for c in range(C):
             @pl.when(belongs[c])
             def _(c=c):
@@ -564,8 +612,8 @@ def _build_ragged_paged_kernel(
         @pl.when(jnp.any(mask))
         def _():
             q = q_ref[0].astype(jnp.float32)
-            k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)
-            v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+            k = _unpack_codes(k_ref[0], pack).transpose(1, 0, 2)
+            v = _unpack_codes(v_ref[0], pack).transpose(1, 0, 2)
             ks = ks_ref[0] if quant else None
             vs = vs_ref[0] if quant else None
             _attend(q, k, v, ks, vs, mask, o_scr, m_scr, l_scr)
@@ -653,8 +701,8 @@ def _build_ragged_paged_kernel(
             q = q_scr[:].astype(jnp.float32)
             # attention reads the page through the freshly written
             # block — the fresh K/V never left VMEM
-            k = k_out[0].astype(jnp.float32).transpose(1, 0, 2)
-            v = v_out[0].astype(jnp.float32).transpose(1, 0, 2)
+            k = _unpack_codes(k_out[0], pack).transpose(1, 0, 2)
+            v = _unpack_codes(v_out[0], pack).transpose(1, 0, 2)
             _attend(q, k, v, ks_new, vs_new, mask, o_scr, m_scr, l_scr)
 
         _finalize(p, out_ref, o_scr, l_scr)
@@ -679,14 +727,16 @@ def ragged_paged_attention(
     to — gathering through the table without materialising the
     (R, S) virtual cache. One kernel covers decode (C=1), chunked
     prefill and tree verify (the explicit-mask modes). With
-    ``k_scale``/``v_scale`` the pools hold int8 codes and the same
-    index maps additionally DMA each page's per-KV-head scales; dequant
-    happens in VMEM (:func:`_ragged_paged_quant_kernel`) so the
+    ``k_scale``/``v_scale`` the pools hold quantized codes (int8, or
+    packed int4 nibbles when the pool's trailing dim is dk/2) and the
+    same index maps additionally DMA each page's per-KV-head scales;
+    dequant — and, packed, the nibble unpack — happens in VMEM so the
     full-precision cache never exists in HBM. Returns (R, C, H, dk)."""
     R, C, H, dk = q.shape
-    _, ps, KV, _ = k_pool.shape
+    _, ps, KV, dkp = k_pool.shape  # dkp = dk / pack (int4 packs 2)
     NP = page_table.shape[1]
     G = H // KV
+    pack = dk // dkp if k_scale is not None else 1
     scale = scale if scale is not None else 1.0 / math.sqrt(dk)
     qg = q.reshape(R, C, KV, G, dk)
     grid = (R, NP)
@@ -695,14 +745,14 @@ def ragged_paged_attention(
         pl.BlockSpec((1, C, KV, G, dk),
                      lambda r, p, pt: (r, 0, 0, 0, 0)),
         # the paged gather: block row = page_table[r, p]
-        pl.BlockSpec((1, ps, KV, dk),
+        pl.BlockSpec((1, ps, KV, dkp),
                      lambda r, p, pt: (pt[r, p], 0, 0, 0)),
-        pl.BlockSpec((1, ps, KV, dk),
+        pl.BlockSpec((1, ps, KV, dkp),
                      lambda r, p, pt: (pt[r, p], 0, 0, 0)),
     ]
     operands = [qg, k_pool, v_pool]
     kernel = _build_ragged_paged_kernel(
-        quant=k_scale is not None, fused=False, C=C, scale=scale
+        quant=k_scale is not None, fused=False, C=C, scale=scale, pack=pack
     )
     if k_scale is not None:
         in_specs += [
@@ -778,18 +828,19 @@ def fused_rope_paged_attention(
     (identity for untouched pages) — decode (C=1) is the case whose
     dispatch and HBM round-trips this removes."""
     R, C, H, dk = q.shape
-    _, ps, KV, _ = k_pool.shape
+    _, ps, KV, dkp = k_pool.shape  # dkp = dk / pack (int4 packs 2)
     NP = page_table.shape[1]
     G = H // KV
     scale = scale if scale is not None else 1.0 / math.sqrt(dk)
     quant = qmax is not None
+    pack = dk // dkp if quant else 1
     has_rope = cos is not None
     qg = q.reshape(R, C, KV, G, dk)
     grid = (R, NP)
 
     kernel = _build_ragged_paged_kernel(
         quant=quant, fused=True, C=C, scale=scale,
-        qmax=float(qmax) if quant else 0.0, has_rope=has_rope,
+        qmax=float(qmax) if quant else 0.0, has_rope=has_rope, pack=pack,
     )
 
     in_specs = [
@@ -812,9 +863,9 @@ def fused_rope_paged_attention(
     # list (scalar-prefetch args included) — the aliasing keys
     idx0 = 6 + (2 if has_rope else 0)
     in_specs += [
-        pl.BlockSpec((1, ps, KV, dk),
+        pl.BlockSpec((1, ps, KV, dkp),
                      lambda r, p, pt, lg, of: (pt[r, p], 0, 0, 0)),
-        pl.BlockSpec((1, ps, KV, dk),
+        pl.BlockSpec((1, ps, KV, dkp),
                      lambda r, p, pt, lg, of: (pt[r, p], 0, 0, 0)),
     ]
     operands += [k_pool, v_pool]
@@ -827,9 +878,9 @@ def fused_rope_paged_attention(
     out_specs = [
         pl.BlockSpec((1, C, KV, G, dk),
                      lambda r, p, pt, lg, of: (r, 0, 0, 0, 0)),
-        pl.BlockSpec((1, ps, KV, dk),
+        pl.BlockSpec((1, ps, KV, dkp),
                      lambda r, p, pt, lg, of: (pt[r, p], 0, 0, 0)),
-        pl.BlockSpec((1, ps, KV, dk),
+        pl.BlockSpec((1, ps, KV, dkp),
                      lambda r, p, pt, lg, of: (pt[r, p], 0, 0, 0)),
     ]
     if quant:

@@ -14,8 +14,9 @@ Layout
 ------
 A quantized page pool stores, per cache tensor (K and V):
 
-* ``(L, num_pages+1, page_size, KV, dk)`` **int8** codes in place of
-  the bf16/f32 pool, and
+* ``(L, num_pages+1, page_size, KV, dk/pack)`` code elements in place
+  of the bf16/f32 pool (int8: one code per byte; int4: two nibble
+  codes per byte along dk), and
 * ``(L, num_pages+1, KV)`` **float32** scales — one symmetric amax
   scale per page per KV head (``k_scale``/``v_scale`` cache keys).
 
@@ -45,10 +46,21 @@ Write-side contract (:func:`quant_line_write`)
    allocation history — which is what keeps run-to-run generation
    bitwise deterministic and preemption/recompute parity exact.
 
-int4 is a designed-for layout (``SPECS["int4"]``: two codes per byte
-packed along dk, qmax 7) whose in-kernel unpack is not implemented yet
-— :func:`resolve_spec` raises ``NotImplementedError`` for it so the
-reservation can't be silently half-used.
+int4 (``SPECS["int4"]``: qmax 7, pack=2) stores TWO codes per byte
+packed along dk — byte ``j`` of a line carries head-dim entries ``j``
+(low nibble) and ``j + dk/2`` (high nibble), each biased by +8 into
+[1, 15] exactly like quantization.py's packed int4 weights (garbage
+bytes of never-written lines decode to the out-of-band code -8, which
+a zero page scale maps to 0.0). The halves-of-dk split (rather than
+even/odd interleave) unpacks as one concatenate — no lane-crossing
+reshuffle in the Pallas kernel. A fixed HBM budget holds ~4x the bf16
+pages (≥3.8x after the scale rows — asserted in the
+``serve_kv_hierarchy`` bench phase); the same write-side contract
+(running amax, rescale-on-growth, offset-0 reset) applies on the
+unpacked code values, so int4 generation keeps the bitwise
+run-to-run and preemption/recompute guarantees, at a wider
+quantization tolerance than int8 (documented in README "Hierarchical
+KV cache" and tests/test_kv_hierarchy.py).
 """
 from __future__ import annotations
 
@@ -76,19 +88,16 @@ class KVQuantSpec:
 
 SPECS = {
     "int8": KVQuantSpec("int8", 8, 127.0, jnp.int8, 1),
-    # Reserved layout: nibbles packed along dk (low nibble = even dk
-    # rows, biased like quantization.py's int4 weights). The page/scale
-    # shapes and byte accounting below already handle pack=2; the
-    # kernel-side unpack is what's missing.
+    # Packed nibbles along dk (halves split, bias +8 — see the module
+    # docstring); uint8 storage is the pack=2 discriminator, matching
+    # quantization.py's packed int4 weights.
     "int4": KVQuantSpec("int4", 4, 7.0, jnp.uint8, 2),
 }
 
 
 def resolve_spec(kv_quant: Optional[str]) -> Optional[KVQuantSpec]:
     """Validate a ``ServingConfig.kv_quant`` value. None passes
-    through; unknown names are a ValueError; designed-but-unimplemented
-    layouts (int4) raise NotImplementedError rather than producing a
-    pool no kernel can read."""
+    through; unknown names are a ValueError."""
     if kv_quant is None:
         return None
     spec = SPECS.get(kv_quant)
@@ -97,13 +106,42 @@ def resolve_spec(kv_quant: Optional[str]) -> Optional[KVQuantSpec]:
             f"unknown kv_quant {kv_quant!r} (expected one of "
             f"{sorted(SPECS)} or None)"
         )
-    if spec.pack != 1:
-        raise NotImplementedError(
-            "kv_quant='int4' is a designed-for layout (packed nibbles "
-            "along dk, qmax 7) whose in-kernel unpack is not implemented "
-            "yet — use kv_quant='int8'"
-        )
     return spec
+
+
+# ---------------------------------------------------------------------------
+# nibble packing (pack=2 layouts). The pair lives in ONE place so the
+# XLA write/read paths and the in-kernel Pallas unpack (serve/kernels.py
+# mirrors the arithmetic op-for-op) can never drift: integer adds,
+# shifts and masks only — exact on every backend.
+
+
+def pack_nibbles(codes: jnp.ndarray) -> jnp.ndarray:
+    """(..., dk) signed codes in [-8, 7] → (..., dk//2) uint8: byte j
+    holds code j (low nibble) and code j + dk/2 (high nibble), each
+    biased +8. dk must be even (the engine validates head_dim % pack
+    up front)."""
+    dk = codes.shape[-1]
+    c = codes.astype(jnp.int32) + 8
+    lo, hi = c[..., : dk // 2], c[..., dk // 2 :]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_nibbles(packed: jnp.ndarray) -> jnp.ndarray:
+    """(..., dkp) uint8 → (..., 2*dkp) f32 signed codes (the inverse of
+    :func:`pack_nibbles`; all-zero garbage bytes decode to -8, which a
+    zero page scale maps to 0.0)."""
+    b = packed.astype(jnp.int32)
+    lo = (b & 0xF) - 8
+    hi = ((b >> 4) & 0xF) - 8
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
+
+
+def pool_pack(pool: jnp.ndarray) -> int:
+    """Codes per storage element of a quantized page pool — uint8 IS
+    the packed-nibble layout (int8 pools store one code per byte), the
+    same storage-dtype convention quantization.py's weight path uses."""
+    return 2 if pool.dtype == jnp.dtype(jnp.uint8) else 1
 
 
 def quant_line_write(
@@ -125,11 +163,26 @@ def quant_line_write(
     Shared (refcounted > 1) pages are never the target of a line write
     — the prefix cache COWs the tail page before any slot appends — so
     rescaling page content in place cannot perturb another reader.
+
+    Packed layouts (int4): the pool's trailing dim is dk/pack and the
+    pack factor is inferred from the shapes; rescale unpacks the
+    touched pages' nibbles, requantizes on code VALUES, and repacks —
+    arithmetically identical to the int8 path per code, so every
+    determinism guarantee above carries over unchanged.
     """
-    P1, ps, KV, dk = kq.shape
+    P1, ps, KV, dkp = kq.shape
     R, C = phys.shape
+    pack = vals.shape[-1] // dkp  # 1 (int8) or 2 (packed int4 nibbles)
     vf = vals.astype(jnp.float32)
     amax = jnp.max(jnp.abs(vf), axis=-1)  # (R, C, KV)
+
+    def _codes(stored):
+        return unpack_nibbles(stored) if pack == 2 else stored.astype(
+            jnp.float32
+        )
+
+    def _store(codes):
+        return pack_nibbles(codes) if pack == 2 else codes.astype(kq.dtype)
 
     # offset-0 writes mark the page's first use by its current owner:
     # drop the previous occupant's stale amax (history independence)
@@ -151,21 +204,21 @@ def quant_line_write(
             old[pages] / jnp.maximum(new[pages], 1e-30),
             0.0,
         )                                               # (R*C, KV)
-        content = kq[pages].astype(jnp.float32)         # (R*C, ps, KV, dk)
+        content = _codes(kq[pages])                     # (R*C, ps, KV, dk)
         requant = jnp.round(content * ratio[:, None, :, None])
-        kq = kq.at[pages].set(requant.astype(kq.dtype))
+        kq = kq.at[pages].set(_store(requant))
     else:
         ratio = jnp.where(
             new > 0.0, old / jnp.maximum(new, 1e-30), 0.0
         )                                               # (P1, KV)
-        requant = jnp.round(kq.astype(jnp.float32) * ratio[:, None, :, None])
-        kq = requant.astype(kq.dtype)
+        requant = jnp.round(_codes(kq) * ratio[:, None, :, None])
+        kq = _store(requant)
 
     # quantize the new lines at their page's (final) scale and scatter
     s_line = new[phys]                                  # (R, C, KV)
     q = jnp.round(vf / jnp.maximum(s_line[..., None], 1e-30))
-    q = jnp.clip(q, -qmax, qmax).astype(kq.dtype)
-    kq = kq.at[phys, off].set(q)
+    q = jnp.clip(q, -qmax, qmax)
+    kq = kq.at[phys, off].set(_store(q))
     return kq, new
 
 
@@ -183,8 +236,14 @@ def quant_commit_lines(
     source lines at their page scales, then re-commit them through
     :func:`quant_line_write` so destination page scales stay exact
     (codes cannot move between pages verbatim — the pages' scales
-    differ). Vectorized over the layer dim. Returns ``(buf, scale)``."""
-    rows = buf[:, s_phys, s_off].astype(jnp.float32)    # (L, R, K, KV, dk)
+    differ). Vectorized over the layer dim. Packed (int4) pools unpack
+    the source nibbles here; the write side repacks. Returns
+    ``(buf, scale)``."""
+    rows = buf[:, s_phys, s_off]                        # (L, R, K, KV, dkp)
+    rows = (
+        unpack_nibbles(rows) if pool_pack(buf) == 2
+        else rows.astype(jnp.float32)
+    )                                                   # (L, R, K, KV, dk)
     rows = rows * scale[:, s_phys][..., None]           # dequant at src scale
     return jax.vmap(
         lambda b, s, r: quant_line_write(b, s, d_phys, d_off, r, qmax)
@@ -218,10 +277,10 @@ def quantized_pool_pages(
     budget of ``fp_pages`` full-precision pages buys. This is how
     ``ServingConfig.max_cached_tokens`` keeps meaning "this much KV
     HBM" with ``kv_quant`` on — the same budget simply holds ~2x the
-    pages (int8 vs bf16; the per-page f32 scales cost
-    ``8·KV / (2·KV·dk·itemsize)`` of a page, well under 1% at real
-    head dims, which is why the ratio lands at ≥1.9x rather than
-    exactly 2x)."""
+    pages at int8 and ~4x at packed int4 (vs bf16; the per-page f32
+    scales cost ``8·KV / (2·KV·dk·itemsize)`` of a page, well under 1%
+    at real head dims, which is why the measured ratios land at ≥1.9x
+    and ≥3.8x rather than exactly 2x/4x)."""
     budget = fp_pages * page_bytes(page_size, kv_heads, head_dim,
                                    fp_itemsize)
     # pack>1 stores several codes per element along dk

@@ -10,19 +10,22 @@ tokens rounded up to the page size — not to the worst-case sequence
 length, which is what lets serving run the reference's 64 request slots
 on one chip (VERDICT.md round 5, missing #3).
 
-HBM accounting: one page costs ``2 · page_size · KV · dk ·
-itemsize(cache_dtype)`` bytes per layer (K and V), and
-``ServingConfig.max_cached_tokens`` prices the pool in those units —
-it is an HBM budget expressed as full-precision tokens. With
-``ServingConfig.kv_quant`` (serve/kv_quant.py) pages store int8 codes
-plus two per-page f32 scale rows (``8·KV`` bytes — under 1% of a page
-at real head dims), so the SAME budget buys ~2x the physical pages
+HBM accounting: one page costs ``2 · page_size · KV · ceil(dk / pack)
+· itemsize(cache_dtype)`` bytes per layer (K and V; ``pack`` is the
+codes-per-element factor of the storage layout — 1 for fp and int8,
+2 for int4's packed nibbles), and ``ServingConfig.max_cached_tokens``
+prices the pool in the pack=1 full-precision units — it is an HBM
+budget expressed as full-precision tokens. With
+``ServingConfig.kv_quant`` (serve/kv_quant.py) pages store quantized
+codes plus two per-page f32 scale rows (``8·KV`` bytes — under 1% of
+a page at real head dims), so the SAME budget buys ~2x (int8) or ~4x
+(int4, two codes per byte along dk) the physical pages
 (``kv_quant.quantized_pool_pages`` converts; the engine sizes this
 allocator with the converted count). The allocator itself is
 dtype-blind — it hands out page INDICES; every invariant below holds
-identically over bf16, f32 and quantized pools (asserted by the
-randomized property test in tests/test_paged_kv.py, which runs the
-same sweep over a quantized engine's pool).
+identically over bf16, f32 and quantized pools of either pack
+(asserted by the randomized property test in tests/test_paged_kv.py,
+which runs the same sweep over int8 and packed-int4 engines' pools).
 
 Pages are **reference counted** so the automatic prefix cache
 (serve/prefix_cache.py) can keep a finished request's prompt pages
@@ -32,7 +35,11 @@ plus one reference held by the prefix-cache radix tree. A page returns
 to the free list exactly when its refcount drains to zero — cached-but-
 idle pages (refcount 1, held only by the tree) are reclaimed through
 ``reclaim_cb`` before an allocation ever fails, so the cache can never
-cause an admission preemption that a cold pool would not.
+cause an admission preemption that a cold pool would not. (With the
+hierarchical host tier — ``ServingConfig.host_cache_bytes`` — that
+reclaim SPILLS the page's content to host RAM instead of discarding
+it; the page index still returns to the free list, and the tree's
+host-resident nodes hold no allocator reference until re-admitted.)
 
 The allocator is host-side state owned by the :class:`InferenceEngine`
 (one per engine — a SpecInfer LLM/SSM pair allocates independently

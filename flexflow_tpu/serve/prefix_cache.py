@@ -35,6 +35,27 @@ Design points:
   LRU leaves until the shortfall is covered — so a cold pool and a
   cached pool admit exactly the same requests, the cached one just
   starts them further along.
+* **Hierarchical host tier (FlexFlow's CPU offloading, PAPER.md
+  §SpecInfer feature list).** With ``ServingConfig.host_cache_bytes``
+  set, reclaim SPILLS instead of dropping: the victim page's content
+  (codes + quantized scale rows) is sliced out of the pool by one
+  jitted program and copied device→host ASYNCHRONOUSLY
+  (``engine.fetch_page``; the copies are harvested to numpy at the
+  scheduler's existing flush sync point, never mid-decode — ffcheck
+  FF107 lints the hot path for accidental blocking transfers), and the
+  node stays in the tree as HOST-resident: tokens, hash chain and
+  content survive, only the HBM page is freed. A later ``match`` that
+  walks through a host-resident node re-admits it in :meth:`attach` —
+  a fresh page is taken, the content uploads host→device
+  (``engine.upload_page``, async, ordered before the prefill that
+  reads it) and the node is device-resident again — so a miss-to-HBM
+  becomes a host HIT instead of a prefill recompute. The round-trip is
+  byte-exact, which keeps cold / spilled-then-readmitted / warm
+  generations BITWISE identical (tests/test_kv_hierarchy.py). The
+  host tier has its own LRU: past the byte budget, cold host LEAVES
+  are dropped for real. Since spilling keeps the node in place, spill
+  victims need not be leaves — any idle (refcount-1) device page can
+  spill, and interior spills keep their chains walkable.
 * **Insertion is pure bookkeeping.** On completion (cache_policy
   "complete", the default — caches prompt AND generated tokens, the
   multi-turn case) or at prefill end ("prefill"), the request's valid
@@ -56,14 +77,21 @@ from ..logging_utils import get_logger
 from .paging import PageAllocator
 
 
+#: ``_Node.page`` sentinel for HOST-resident nodes (spilled to the
+#: hierarchical host tier; ``host`` holds the page content).
+HOST_PAGE = -1
+
+
 class _Node:
     """One cached token block: ``tokens`` (≤ page_size; shorter only for
     tail blocks) backed by physical ``page`` whose first ``len(tokens)``
     lines hold those tokens' K/V. ``key`` is the hash chain identifying
-    the whole prefix ending at this block."""
+    the whole prefix ending at this block. A spilled node has
+    ``page == HOST_PAGE`` and carries the page's content in ``host``
+    (device slices until harvested, numpy afterwards)."""
 
     __slots__ = ("tokens", "page", "key", "parent", "children", "partials",
-                 "last_used")
+                 "last_used", "host")
 
     def __init__(self, tokens: Tuple[int, ...], page: int, key: int,
                  parent: "_Node"):
@@ -74,6 +102,7 @@ class _Node:
         self.children: Dict[Tuple[int, ...], _Node] = {}  # full blocks
         self.partials: Dict[Tuple[int, ...], _Node] = {}  # tail blocks
         self.last_used = 0
+        self.host = None  # device/host page content when spilled
 
     @property
     def is_leaf(self) -> bool:
@@ -102,6 +131,13 @@ class PrefixCache:
     a SchedulerStats or a zero-arg callable returning one — the
     RequestManager passes a callable so event counters follow when a
     bench swaps ``rm.stats`` for a fresh object mid-run.
+
+    The hierarchical host tier activates when ``host_cache_bytes`` > 0
+    and both page movers are supplied: ``fetch_page(page)`` starts an
+    async device→host copy of one physical page's content and returns
+    a handle (engine.fetch_page), ``upload_page(page, values)`` writes
+    a handle back into a pool row (engine.upload_page);
+    ``page_bytes`` prices one spilled page against the byte budget.
     """
 
     def __init__(
@@ -111,6 +147,10 @@ class PrefixCache:
         copy_page: Optional[Callable[[int, int], None]] = None,
         policy: str = "complete",
         stats=None,
+        fetch_page: Optional[Callable[[int], dict]] = None,
+        upload_page: Optional[Callable[[int, dict], None]] = None,
+        host_cache_bytes: int = 0,
+        page_bytes: int = 1,
     ):
         if policy not in ("complete", "prefill"):
             raise ValueError(
@@ -122,9 +162,24 @@ class PrefixCache:
         self.copy_page = copy_page
         self.policy = policy
         self._stats_src = stats
+        self.fetch_page = fetch_page
+        self.upload_page = upload_page
+        self.host_cache_bytes = int(host_cache_bytes or 0)
+        self.page_bytes = max(1, int(page_bytes))
+        self.host_bytes = 0          # current host-tier occupancy
+        self._pending_spills: List[_Node] = []  # un-harvested handles
+        self._pinned: set = set()    # nodes mid-attach: never spill/drop
         self._root = _Node((), pager.scratch_page, hash(()), parent=None)
         self._tick = itertools.count(1)
         self._log = get_logger("serve")
+
+    @property
+    def spill_enabled(self) -> bool:
+        return (
+            self.host_cache_bytes > 0
+            and self.fetch_page is not None
+            and self.upload_page is not None
+        )
 
     @property
     def stats(self):
@@ -149,27 +204,35 @@ class PrefixCache:
     def cached_pages(self) -> int:
         return len(self._nodes())
 
+    @property
+    def host_pages(self) -> int:
+        """Nodes currently resident in the host tier (spilled)."""
+        return sum(1 for n in self._nodes() if n.host is not None)
+
     def page_refs(self) -> Dict[int, int]:
         """References the tree holds per physical page (each page lives
-        in exactly one node) — feeds
+        in exactly one node; HOST-resident nodes hold no device page,
+        so they contribute nothing) — feeds
         ``PageAllocator.check_no_leaks(external=...)``."""
         refs: Dict[int, int] = {}
         for n in self._nodes():
-            refs[n.page] = refs.get(n.page, 0) + 1
+            if n.page != HOST_PAGE:
+                refs[n.page] = refs.get(n.page, 0) + 1
         return refs
 
     # ------------------------------------------------------------------
     # lookup
 
-    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
-        """Longest cached prefix of ``tokens``: returns the physical
-        pages covering it and the matched token count. Capped at
+    def _walk(self, tokens: Sequence[int]) -> Tuple[List[_Node], int]:
+        """Longest cached prefix of ``tokens`` as tree NODES (device- or
+        host-resident) plus the matched token count. Capped at
         ``len(tokens) - 1`` — the last prompt token is always
         recomputed so its logit exists to sample the first output from.
-        A tail block may match partially (the new prompt diverges or
-        ends inside it); the caller COWs that page before any write."""
+        Every matched node except possibly the last is a full
+        page-sized block; the last may be a partial overlap (the new
+        prompt diverges or ends inside it)."""
         limit = len(tokens) - 1
-        node, pages, matched = self._root, [], 0
+        node, nodes, matched = self._root, [], 0
         tick = next(self._tick)
         ps = self.page_size
         while matched < limit:
@@ -178,7 +241,7 @@ class PrefixCache:
                 child = node.children.get(tuple(tokens[matched:matched + ps]))
                 if child is not None:
                     child.last_used = tick
-                    pages.append(child.page)
+                    nodes.append(child)
                     matched += ps
                     node = child
                     continue
@@ -195,35 +258,92 @@ class PrefixCache:
                     best, best_len = cand, n
             if best is not None:
                 best.last_used = tick
-                pages.append(best.page)
+                nodes.append(best)
                 matched += best_len
             break
-        return pages, matched
+        return nodes, matched
+
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of ``tokens``: the physical pages
+        covering it (``HOST_PAGE`` = -1 for spilled blocks whose
+        content lives in the host tier — :meth:`attach` re-admits them
+        before splicing) and the matched token count."""
+        nodes, matched = self._walk(tokens)
+        return [n.page for n in nodes], matched
 
     # ------------------------------------------------------------------
     # admission: splice + COW
 
+    def _readmit(self, node: _Node) -> bool:
+        """Bring one HOST-resident node back to the device: take a free
+        page, upload the spilled content into it (async host→device,
+        ordered before any step that reads it) and hand the tree's
+        reference over to the new page. Byte-exact — codes and scales
+        land exactly as spilled, so generation over the re-admitted
+        prefix is bitwise the warm path's. False when no page could be
+        freed even by further spilling (the match truncates there)."""
+        fresh = self.pager.take_free_page()
+        if fresh is None:
+            return False
+        self.pager.refcount[fresh] = 1  # the tree's reference
+        self.upload_page(fresh, node.host)
+        if node in self._pending_spills:
+            self._pending_spills.remove(node)
+        node.page = fresh
+        node.host = None
+        self.host_bytes -= self.page_bytes
+        st = self.stats
+        if st is not None:
+            st.readmits += 1
+            st.host_hit_tokens += len(node.tokens)
+            st.host_bytes = self.host_bytes
+        self._log.debug(
+            "prefix readmit: host page -> %d (%d tokens, chain %x)",
+            fresh, len(node.tokens), node.key & 0xFFFFFFFF,
+        )
+        return True
+
     def attach(self, slot: int, tokens: Sequence[int]) -> int:
-        """Admission-time hit path: match ``tokens``, splice the matched
-        pages into ``slot``'s (empty) table, COW the tail page when the
-        match ends mid-page, and return the matched token count — the
-        request's prefill start offset. Falls back block-by-block when
-        COW cannot get a page (drops the partial tail rather than fail
-        the admission); returns 0 on a miss."""
-        pages, matched = self.match(tokens)
-        cow_src = None
-        if matched % self.page_size:
-            # the request appends K/V into the tail page → private copy
-            fresh = self.pager.take_free_page()
-            if fresh is None:
-                matched -= matched % self.page_size
-                pages = pages[:-1]
-            else:
-                cow_src = pages[-1]
-                pages[-1] = fresh
-        if not matched:
-            return 0
-        self.pager.splice(slot, pages)
+        """Admission-time hit path: match ``tokens``, re-admit any
+        HOST-resident blocks on the matched path (host tier →
+        device, async upload), splice the matched pages into ``slot``'s
+        (empty) table, COW the tail page when the match ends mid-page,
+        and return the matched token count — the request's prefill
+        start offset. Falls back block-by-block when a page cannot be
+        had (truncates the match / drops the partial tail rather than
+        fail the admission); returns 0 on a miss."""
+        nodes, matched = self._walk(tokens)
+        # Pin the whole matched path for the rest of the admission:
+        # BOTH the re-admissions and the COW below may take free pages,
+        # and a dry free list triggers reclaim — which must not spill,
+        # evict or host-drop a block this admission is about to splice
+        # (an evicted node's page would land on the free list while
+        # still listed here, and splicing it would alias a page another
+        # slot can be handed).
+        self._pinned = set(map(id, nodes))
+        try:
+            for i, n in enumerate(nodes):
+                if n.host is not None and not self._readmit(n):
+                    # nodes[:-1] are full blocks: i full blocks match
+                    nodes = nodes[:i]
+                    matched = i * self.page_size
+                    break
+            pages = [n.page for n in nodes]
+            cow_src = None
+            if matched % self.page_size:
+                # request appends K/V into the tail page → private copy
+                fresh = self.pager.take_free_page()
+                if fresh is None:
+                    matched -= matched % self.page_size
+                    pages = pages[:-1]
+                else:
+                    cow_src = pages[-1]
+                    pages[-1] = fresh
+            if not matched:
+                return 0
+            self.pager.splice(slot, pages)
+        finally:
+            self._pinned = set()
         if cow_src is not None:
             if self.stats is not None:
                 self.stats.prefix_cows += 1
@@ -308,13 +428,21 @@ class PrefixCache:
     # ------------------------------------------------------------------
     # eviction (the allocator's reclaim_cb)
 
+    def _unlink(self, victim: _Node) -> None:
+        parent = victim.parent
+        bucket = (
+            parent.children if victim.tokens in parent.children
+            and parent.children[victim.tokens] is victim else parent.partials
+        )
+        del bucket[victim.tokens]
+
     def _evict_one(self) -> bool:
         """Free the least-recently-used idle leaf (refcount 1 — held
         only by the tree, no slot references, no children pinning it as
         interior). Returns False when nothing is evictable."""
         victim = None
         for n in self._nodes():
-            if not n.is_leaf:
+            if not n.is_leaf or n.host is not None or id(n) in self._pinned:
                 continue
             if int(self.pager.refcount[n.page]) != 1:
                 continue  # spliced into a live slot — not idle
@@ -322,12 +450,7 @@ class PrefixCache:
                 victim = n
         if victim is None:
             return False
-        parent = victim.parent
-        bucket = (
-            parent.children if victim.tokens in parent.children
-            and parent.children[victim.tokens] is victim else parent.partials
-        )
-        del bucket[victim.tokens]
+        self._unlink(victim)
         self.pager.release_ref(victim.page)
         if self.stats is not None:
             self.stats.prefix_evictions += 1
@@ -337,23 +460,116 @@ class PrefixCache:
         )
         return True
 
+    def _spill_one(self) -> bool:
+        """Spill the LRU idle (refcount-1) DEVICE-resident node to the
+        host tier: async device→host content copy, page freed, node
+        kept in the tree as host-resident. Unlike :meth:`_evict_one`
+        this needs no leaf restriction — the node stays in place, so
+        interior chains remain walkable. Returns False when nothing is
+        spillable."""
+        victim = None
+        for n in self._nodes():
+            if n.host is not None or id(n) in self._pinned:
+                continue
+            if int(self.pager.refcount[n.page]) != 1:
+                continue  # spliced into a live slot — not idle
+            if victim is None or n.last_used < victim.last_used:
+                victim = n
+        if victim is None:
+            return False
+        page = victim.page
+        victim.host = self.fetch_page(page)   # async D2H starts here
+        self._pending_spills.append(victim)
+        victim.page = HOST_PAGE
+        self.pager.release_ref(page)
+        self.host_bytes += self.page_bytes
+        st = self.stats
+        if st is not None:
+            st.spills += 1
+            st.host_bytes = self.host_bytes
+        self._log.debug(
+            "prefix spill: page %d -> host (%d tokens, chain %x, "
+            "host %d/%d bytes)",
+            page, len(victim.tokens), victim.key & 0xFFFFFFFF,
+            self.host_bytes, self.host_cache_bytes,
+        )
+        # host-tier LRU: past the byte budget, cold host LEAVES drop
+        # for real (interior host nodes are skipped — removing one
+        # would orphan device-resident descendants; best-effort
+        # overshoot until their subtrees peel)
+        while self.host_bytes > self.host_cache_bytes:
+            if not self._drop_host_one():
+                break
+        return True
+
+    def _drop_host_one(self) -> bool:
+        """Truly evict the LRU host-resident leaf (host-tier LRU).
+        Returns False when no droppable host leaf exists."""
+        victim = None
+        for n in self._nodes():
+            if n.host is None or not n.is_leaf or id(n) in self._pinned:
+                continue
+            if victim is None or n.last_used < victim.last_used:
+                victim = n
+        if victim is None:
+            return False
+        self._unlink(victim)
+        if victim in self._pending_spills:
+            self._pending_spills.remove(victim)
+        self.host_bytes -= self.page_bytes
+        st = self.stats
+        if st is not None:
+            st.prefix_evictions += 1
+            st.host_bytes = self.host_bytes
+        self._log.debug(
+            "prefix host drop: %d tokens (chain %x, lru %d)",
+            len(victim.tokens), victim.key & 0xFFFFFFFF, victim.last_used,
+        )
+        return True
+
+    def harvest(self) -> None:
+        """Convert pending spill handles (device slices with async D2H
+        copies in flight) to numpy host buffers, releasing their device
+        memory. Called from the RequestManager's flush — the
+        scheduler's existing blocking sync point, by which time the
+        copies have landed — so the decode hot path itself never waits
+        on a transfer."""
+        import numpy as np
+
+        for node in self._pending_spills:
+            if node.host is not None:
+                node.host = {
+                    k: np.asarray(v) for k, v in node.host.items()
+                }
+        self._pending_spills.clear()
+
     def reclaim(self, shortfall: int) -> int:
-        """Evict LRU idle cached pages until ``shortfall`` pages hit the
-        free list (or nothing idle remains). Evicting a leaf can expose
-        its parent as the next leaf, so deep idle chains peel bottom-up.
-        Returns the number of pages freed."""
+        """Free ``shortfall`` pages: spill LRU idle cached pages to the
+        host tier when it is enabled (content survives, HBM frees),
+        else evict LRU idle leaves outright. Evicting a leaf can expose
+        its parent as the next leaf, so deep idle chains peel
+        bottom-up. Returns the number of pages freed."""
         freed = 0
-        while freed < shortfall and self._evict_one():
+        while freed < shortfall:
+            ok = (
+                self._spill_one() if self.spill_enabled
+                else self._evict_one()
+            )
+            if not ok:
+                break
             freed += 1
         return freed
 
     def clear(self) -> int:
         """Drop every cached page (tree refs released; pages with no
-        slot references return to the free list). Returns the number of
-        nodes released."""
+        slot references return to the free list; host-tier content is
+        discarded). Returns the number of nodes released."""
         nodes = self._nodes()
         for n in nodes:
-            self.pager.release_ref(n.page)
+            if n.page != HOST_PAGE:
+                self.pager.release_ref(n.page)
         self._root.children.clear()
         self._root.partials.clear()
+        self._pending_spills.clear()
+        self.host_bytes = 0
         return len(nodes)

@@ -176,11 +176,27 @@ class RequestManager:
         ):
             from .prefix_cache import PrefixCache
 
+            # Hierarchical KV cache: with a host_cache_bytes budget the
+            # cache SPILLS cold pages to host RAM instead of evicting
+            # (async D2H via engine.fetch_page; re-admitted with an
+            # async H2D upload on a later match). The spill handles are
+            # harvested to numpy at flush time — the scheduler's
+            # existing sync point — so the decode loop never blocks on
+            # a transfer.
+            host_kw = {}
+            if sc.host_cache_bytes:
+                host_kw = dict(
+                    fetch_page=engine.fetch_page,
+                    upload_page=engine.upload_page,
+                    host_cache_bytes=sc.host_cache_bytes,
+                    page_bytes=engine.page_host_bytes(),
+                )
             self.prefix_cache = PrefixCache(
                 engine.pager,
                 copy_page=engine.copy_page,
                 policy=sc.cache_policy,
                 stats=lambda: self.stats,
+                **host_kw,
             )
             engine.pager.reclaim_cb = self.prefix_cache.reclaim
 
@@ -430,6 +446,7 @@ class RequestManager:
             # back admission releases the spliced references with the
             # slot, so retrying is clean.
             matched = 0
+            host_before = self.stats.host_hit_tokens
             if self.prefix_cache is not None:
                 matched = self.prefix_cache.attach(i, req.tokens)
             if self._paged and not self._ensure_pages(
@@ -454,6 +471,11 @@ class RequestManager:
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
             req.profile.cached_prefix_len = matched
+            # tokens of this prefix that came back from the HOST tier
+            # (the stats counter moved inside attach's re-admissions)
+            req.profile.host_hit_tokens = (
+                self.stats.host_hit_tokens - host_before
+            )
             if self.prefix_cache is not None:
                 if matched:
                     self.stats.prefix_hits += 1
@@ -629,6 +651,7 @@ class RequestManager:
         # the host-side decode head is its own dispatched program — the
         # figure the fused sampling epilogue's one-program step beats
         self.engine.count_dispatch("host_sample")
+        # ffcheck: disable=FF107 -- blocking sync-scheduler decode head: this path trades latency for simplicity by design (the pipelined path samples on device)
         return np.asarray(jax.device_get(toks))
 
     def _append_token(self, req: Request, token: int):
@@ -811,6 +834,7 @@ class RequestManager:
         still drains its pipeline refs — its slot/pages are released at
         the flush that drains the last reference."""
         toks, snapshot = self._inflight.pop(0)
+        # ffcheck: disable=FF107 -- the pipeline flush IS the designed sync point: it drains steps the device already finished, dispatch_ahead steps behind
         toks = np.asarray(jax.device_get(toks))
         self.stats.flushes += 1
         for rid, slot, ntoks, samples in snapshot:
@@ -836,6 +860,11 @@ class RequestManager:
                 and req.pipeline_refs == 0
             ):
                 self._release_slot(req)
+        if self.prefix_cache is not None:
+            # the flush just blocked on device_get — every async spill
+            # copy enqueued before it has landed; convert the handles
+            # to host buffers and release their device memory
+            self.prefix_cache.harvest()
 
     def _flush_all(self):
         if self._inflight:
@@ -970,6 +999,7 @@ class RequestManager:
             )
             self._key, sub = jax.random.split(self._key)
             toks = self.engine.run_sampled(bc, sub, greedy, temp, topp, topk)
+            # ffcheck: disable=FF107 -- blocking sync scheduler: one fetch per step by design
             sampled = np.asarray(jax.device_get(toks))
         else:
             logits = self._run_batch(bc)

@@ -299,6 +299,7 @@ class SpecInferManager(RequestManager):
             root[req.slot] = req.tokens[-1]
             prefix[req.slot] = req.n_cached
             active[req.slot] = True
+        # ffcheck: disable=FF107 -- SpecInfer fetches the finished speculation tree in ONE transfer per round by design (the host builds the verify batch from it)
         toks, parents, logps = jax.device_get(
             ssm.run_speculate(root, prefix, active, W, D)
         )  # one transfer; each (D, R, W)
@@ -348,6 +349,7 @@ class SpecInferManager(RequestManager):
         }
         bc = self._tree_chunk_batch(self.engine, reqs, trees, node_lists, C)
         logits = self.engine.run(bc, all_logits=True)  # (R, C, V)
+        # ffcheck: disable=FF107 -- tree verify: the host acceptance walk needs the greedy tokens; one transfer per round by design
         greedy = np.asarray(jax.device_get(_greedy(logits)))  # (R, C)
         accepted: Dict[int, Tuple[int, List[int]]] = {}  # rid -> (slot, path tokens)
 

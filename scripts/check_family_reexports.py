@@ -60,6 +60,11 @@ SERVE_API = (
     "commit_kv_paged",
     "reorder_slots_paged",
     "copy_page_kv",
+    # hierarchical KV cache host tier (PR 7): page spill/re-admit —
+    # the engine's fetch_page/upload_page programs slice one physical
+    # page out of (or back into) every cache buffer
+    "gather_page_kv",
+    "scatter_page_kv",
     # megakernel decode step (PR 6): the per-family capability tuple
     # the engine validates ServingConfig.fused_decode against — the
     # fused variants themselves ride on serve_step_paged's

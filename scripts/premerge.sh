@@ -9,6 +9,9 @@
 #                           fused-vs-unfused bitwise parity + the
 #                           retrace-guard churn tests (zero steady-state
 #                           recompiles with both fusions on)
+#   4. KV hierarchy       — int4 packed pages + host spill tier:
+#                           nibble-unpack parity, bitwise cold/warm/
+#                           spilled-readmit parity, spill bookkeeping
 #
 # Exits non-zero at the first failing gate. Full tier-1 (ROADMAP.md
 # "Tier-1 verify") is the merge bar; this is the fast inner loop.
@@ -17,16 +20,21 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== premerge 1/3: ffcheck (static hazard lint)" >&2
+echo "== premerge 1/4: ffcheck (static hazard lint)" >&2
 python scripts/ffcheck.py
 
-echo "== premerge 2/3: family serve-API re-exports" >&2
+echo "== premerge 2/4: family serve-API re-exports" >&2
 python scripts/check_family_reexports.py
 
-echo "== premerge 3/3: fused decode parity + retrace guard" >&2
+echo "== premerge 3/4: fused decode parity + retrace guard" >&2
 # unfiltered: runs the interpret-mode Pallas e2e tests that tier-1
 # slow-marks for time-budget reasons
 python -m pytest tests/test_fused_decode.py tests/test_retrace_guard.py \
     -q -p no:cacheprovider
+
+echo "== premerge 4/4: hierarchical KV cache (int4 + host spill)" >&2
+# Pallas/XLA nibble-unpack parity, bitwise cold/warm/spilled-readmit
+# generation parity over fp+int8+int4 pools, spill-tier bookkeeping
+python -m pytest tests/test_kv_hierarchy.py -q -p no:cacheprovider
 
 echo "premerge: all gates passed" >&2

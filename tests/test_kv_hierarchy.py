@@ -1,0 +1,515 @@
+"""Hierarchical KV cache tests (PR 7): int4 packed-nibble paged KV +
+the host-RAM spill tier for cold prefix pages.
+
+int4 (serve/kv_quant.py SPECS["int4"], qmax 7, two codes per byte along
+dk): engine-level logit parity vs the fp pool within a DOCUMENTED
+tolerance — 5% of max|logit| (README "Quantized KV cache"; wider than
+int8's 2% because the quantization grid is 16x coarser) — plus the
+determinism guarantees every quantized layout must keep: bitwise
+run-to-run generation and bitwise preemption/recompute parity (the
+offset-0 scale reset), and Pallas-vs-XLA nibble-unpack parity (the
+in-kernel unpack is integer-exact, so both backends decode identical
+code values).
+
+Host spill tier (serve/prefix_cache.py + ServingConfig.host_cache_bytes):
+under pool pressure, idle cached pages SPILL to host buffers instead of
+being evicted, and a later prompt match re-admits them byte-exactly —
+so cold (never cached), warm (never evicted) and spilled-then-readmitted
+generations must be BITWISE identical, for fp, int8 AND int4 pages.
+The bookkeeping unit tests keep ``check_no_leaks`` honest over
+host-resident nodes (which hold NO allocator reference), the host
+tier's own LRU byte budget, and the truncation fallbacks when no page
+can be had.
+
+Bitwise caveat baked into the workloads here: cold-vs-warm equality
+over a QUANTIZED pool requires the cache match to end page-ALIGNED.
+A partial-tail match COWs the page and the warm occupant then appends
+at a scale whose history includes the previous owner's later lines —
+int8's grid is fine enough that this never flips a greedy argmax on
+the test models, int4's is not. Spilled-vs-warm equality has no such
+caveat (the round-trip is byte-exact); the shared prefixes below are
+page-aligned so all three legs are bitwise-comparable.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import (
+    InferenceEngine,
+    PageAllocator,
+    RequestManager,
+    ServingConfig,
+)
+from flexflow_tpu.serve.kv_quant import (
+    pack_nibbles,
+    resolve_spec,
+    unpack_nibbles,
+)
+from flexflow_tpu.serve.prefix_cache import HOST_PAGE, PrefixCache
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def make_engine(tiny, *, slots=4, page_size=16, max_seq=64, spec_slack=8,
+                **kw):
+    cfg, params = tiny
+    sc = ServingConfig(
+        max_requests_per_batch=slots,
+        max_sequence_length=max_seq,
+        prefill_chunk=8,
+        max_spec_tree_tokens=spec_slack,
+        cache_dtype=jnp.float32,
+        kv_layout="paged",
+        page_size=page_size,
+        **kw,
+    )
+    return InferenceEngine(llama, cfg, params, sc)
+
+
+def generate(rm_or_eng, prompts, n_new=6):
+    rm = (
+        rm_or_eng if isinstance(rm_or_eng, RequestManager)
+        else RequestManager(rm_or_eng)
+    )
+    return [
+        o.output_tokens for o in rm.generate(prompts, max_new_tokens=n_new)
+    ]
+
+
+def family_prompts(cfg, fam, n=4, shared_len=32):
+    """One page-aligned shared prefix per family + ONE unique token per
+    request (the last prompt token is always recomputed, so the cache
+    match ends exactly at the aligned shared prefix — no partial-tail
+    COW, see the module docstring)."""
+    V = cfg.vocab_size
+    shared = [(j * 11 + fam * 41 + 3) % V for j in range(shared_len)]
+    return [shared + [(fam * 31 + i * 7 + 1) % V] for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# int4 packed-nibble layout: kernel + engine parity
+
+
+class TestInt4Kernel:
+    def test_pack_unpack_roundtrip_exact(self):
+        rng = np.random.default_rng(0)
+        codes = jnp.asarray(
+            rng.integers(-7, 8, size=(3, 5, 4, 16)), jnp.float32
+        )
+        np.testing.assert_array_equal(
+            np.asarray(unpack_nibbles(pack_nibbles(codes))),
+            np.asarray(codes),
+        )
+        # garbage (all-zero bytes of a never-written page) decodes to
+        # the out-of-band code -8 — a zero page scale maps it to 0.0
+        zero = jnp.zeros((4, 2), jnp.uint8)
+        np.testing.assert_array_equal(np.asarray(unpack_nibbles(zero)), -8.0)
+
+    def test_pallas_nibble_unpack_matches_xla(self):
+        """The fused ragged paged kernel DMAs uint8 pages and unpacks
+        two nibble codes per byte in VMEM; the XLA fallback unpacks the
+        gathered codes host-program-side. Same integer arithmetic, so
+        attention outputs must agree — decode (C=1) and tree-verify
+        (C>1) shapes."""
+        from flexflow_tpu.serve import kernels as K
+
+        rng = np.random.default_rng(7)
+        for C in (1, 4):
+            R, H, KV, dk, P1, ps, NP = 3, 8, 4, 16, 9, 16, 4
+            q = jnp.asarray(rng.normal(size=(R, C, H, dk)), jnp.float32)
+            kp = pack_nibbles(jnp.asarray(
+                rng.integers(-7, 8, size=(P1, ps, KV, dk)), jnp.float32))
+            vp = pack_nibbles(jnp.asarray(
+                rng.integers(-7, 8, size=(P1, ps, KV, dk)), jnp.float32))
+            ks = jnp.asarray(rng.random(size=(P1, KV)) * 0.2, jnp.float32)
+            vs = jnp.asarray(rng.random(size=(P1, KV)) * 0.2, jnp.float32)
+            pt = jnp.asarray(rng.integers(0, P1, size=(R, NP)), jnp.int32)
+            mask = jnp.asarray(rng.random(size=(R, C, NP * ps)) < 0.4)
+            mask = mask.at[:, :, 0].set(True)
+            got = K.ragged_paged_attention(
+                q, kp, vp, pt, mask, k_scale=ks, v_scale=vs
+            )
+            want = K.ragged_paged_attention_xla(
+                q, kp, vp, pt, mask, k_scale=ks, v_scale=vs
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-2, rtol=1e-2
+            )
+
+    def test_dequant_pages_unpacks_exactly(self):
+        """The XLA read path must decode the exact code values the
+        write path packed — integer-exact, then scaled."""
+        from flexflow_tpu.serve.kernels import dequant_pages
+
+        rng = np.random.default_rng(3)
+        P1, ps, KV, dk = 5, 4, 2, 8
+        codes = rng.integers(-7, 8, size=(P1, ps, KV, dk)).astype(np.float32)
+        pool = pack_nibbles(jnp.asarray(codes))
+        scale = jnp.asarray(rng.random(size=(P1, KV)) + 0.5, jnp.float32)
+        pt = jnp.asarray([[0, 2], [4, 1]], jnp.int32)
+        virt = np.asarray(dequant_pages(pool, scale, pt, jnp.float32))
+        want = (
+            codes[np.asarray(pt).reshape(-1)]
+            * np.asarray(scale)[np.asarray(pt).reshape(-1), None, :, None]
+        ).reshape(2, 2 * ps, KV, dk)
+        np.testing.assert_array_equal(virt, want)
+
+
+class TestInt4Engine:
+    def test_logit_parity_within_documented_tolerance(self, tiny):
+        """int4 vs fp paged logits on a prefill batch: within 5% of
+        max|logit| (the documented int4 tolerance — README "Quantized
+        KV cache"; measured well under it on this model/seed)."""
+        from flexflow_tpu.serve.batch_config import BatchConfig
+
+        cfg, _ = tiny
+        prompts = family_prompts(cfg, 0)
+        logits = {}
+        for kvq in (None, "int4"):
+            eng = make_engine(tiny, kv_quant=kvq)
+            for r in range(4):
+                assert eng.pager.ensure(r, 36)
+            bc = BatchConfig.empty(4, 33, eng.scratch_pos)
+            for r, p in enumerate(prompts):
+                bc.tokens[r, : len(p)] = p
+                bc.positions[r, : len(p)] = np.arange(len(p))
+                bc.logits_idx[r] = len(p) - 1
+                bc.active[r] = True
+            logits[kvq] = np.asarray(jax.device_get(eng.run(bc)))
+        tol = 0.05 * np.abs(logits[None]).max()
+        np.testing.assert_allclose(logits["int4"], logits[None], atol=tol)
+
+    def test_bitwise_run_to_run_and_greedy_agreement(self, tiny):
+        cfg, _ = tiny
+        prompts = family_prompts(cfg, 0)
+        fp = generate(make_engine(tiny), prompts)
+        a = generate(make_engine(tiny, kv_quant="int4"), prompts)
+        b = generate(make_engine(tiny, kv_quant="int4"), prompts)
+        assert a == b  # bitwise run-to-run
+        flat_fp = [t for o in fp for t in o]
+        flat_q = [t for o in a for t in o]
+        agree = sum(x == y for x, y in zip(flat_fp, flat_q)) / len(flat_fp)
+        assert agree >= 0.6, (fp, a)
+
+    def test_preemption_recompute_is_bitwise(self, tiny):
+        """The offset-0 scale reset applies to unpacked code VALUES, so
+        packed content stays a pure function of the tokens written —
+        an oversubscribed int4 pool that preempts and recomputes must
+        reproduce the roomy pool's outputs bitwise."""
+        cfg, _ = tiny
+        # int4 pools floor at pages_per_slot converted (~38 pages here)
+        # — 16 slots of 3-page requests oversubscribe it for real
+        prompts = family_prompts(cfg, 0, n=16)
+        want = generate(
+            make_engine(tiny, kv_quant="int4", slots=16), prompts
+        )
+        rm = RequestManager(
+            make_engine(tiny, kv_quant="int4", slots=16,
+                        max_cached_tokens=20)
+        )
+        got = generate(rm, prompts)
+        assert rm.stats.preemptions > 0  # the tight pool was exercised
+        assert got == want
+        rm.engine.pager.check_no_leaks()
+        assert rm.engine.pager.free_pages == rm.engine.pager.num_pages
+
+    def test_pallas_matches_xla_tokens(self, tiny):
+        cfg, _ = tiny
+        prompts = family_prompts(cfg, 0, n=3)
+        outs = {
+            kern: generate(
+                make_engine(tiny, kv_quant="int4", kernels=kern), prompts
+            )
+            for kern in ("xla", "pallas")
+        }
+        assert outs["pallas"] == outs["xla"]
+
+
+# ---------------------------------------------------------------------------
+# host spill tier: allocator/tree bookkeeping units
+
+
+def _fake_pages():
+    """In-memory stand-ins for engine.fetch_page/upload_page: 'content'
+    is just the page index recorded at spill time, so a test can check
+    what got uploaded where."""
+    log = {"fetched": [], "uploaded": []}
+
+    def fetch(page):
+        log["fetched"].append(page)
+        return {"k": np.full((2, 2), page)}
+
+    def upload(page, values):
+        log["uploaded"].append((page, int(values["k"][0, 0])))
+
+    return fetch, upload, log
+
+
+class TestSpillBookkeeping:
+    def _cache(self, pa, host_bytes=1 << 20, page_bytes=100):
+        fetch, upload, log = _fake_pages()
+        cache = PrefixCache(
+            pa, copy_page=None, fetch_page=fetch, upload_page=upload,
+            host_cache_bytes=host_bytes, page_bytes=page_bytes,
+        )
+        pa.reclaim_cb = cache.reclaim
+        return cache, log
+
+    def test_spill_frees_page_and_keeps_node(self):
+        pa = PageAllocator(4, 4, 2, 4)
+        cache, log = self._cache(pa)
+        assert pa.ensure(0, 8)  # 2 pages
+        toks = list(range(8))
+        cache.insert(0, toks, 8)
+        pa.release(0)
+        assert cache.cached_pages == 2 and pa.free_pages == 2
+        # exhaust the pool: ensure triggers reclaim -> spill, not drop
+        assert pa.ensure(1, 16)  # needs all 4
+        assert cache.cached_pages == 2  # nodes survived as host-resident
+        assert cache.host_pages == 2
+        assert len(log["fetched"]) == 2
+        # host nodes hold NO allocator refs — the audit must balance
+        pa.check_no_leaks(external=cache.page_refs())
+        pa.release(1)
+        pa.check_no_leaks(external=cache.page_refs())
+
+    def test_readmit_restores_content_and_refs(self):
+        pa = PageAllocator(4, 4, 2, 4)
+        cache, log = self._cache(pa)
+        assert pa.ensure(0, 8)
+        orig = [int(p) for p in pa.table[0][:2]]
+        toks = list(range(8))
+        cache.insert(0, toks, 8)
+        pa.release(0)
+        assert pa.ensure(1, 16) and pa.release(1) == 4  # spill everything
+        assert cache.host_pages == 2
+        matched = cache.attach(0, toks + [99])
+        assert matched == 8
+        assert cache.host_pages == 0
+        # each upload received the content fetched from its original page
+        uploaded = {src for _, src in log["uploaded"]}
+        assert uploaded == set(orig)
+        # splice gave the slot one ref per page, the tree another
+        for p in pa.table[0][:2]:
+            assert int(pa.refcount[int(p)]) == 2
+        pa.check_no_leaks(external=cache.page_refs())
+        st = cache.stats  # no stats wired here
+        assert st is None
+
+    def test_host_budget_lru_drops_cold_leaves(self):
+        pa = PageAllocator(4, 4, 2, 4)
+        # budget of ONE page (page_bytes=100): the second spill must
+        # drop the colder host leaf for real
+        cache, log = self._cache(pa, host_bytes=100, page_bytes=100)
+        assert pa.ensure(0, 8)
+        cache.insert(0, list(range(8)), 8)
+        pa.release(0)
+        assert pa.ensure(1, 16)
+        assert cache.host_pages == 1  # one spilled, one dropped
+        assert cache.host_bytes == 100
+        pa.release(1)
+        pa.check_no_leaks(external=cache.page_refs())
+
+    def test_spill_not_leaf_restricted(self):
+        """An idle interior node can spill (the chain stays walkable);
+        plain eviction would have been stuck behind its children."""
+        pa = PageAllocator(6, 6, 2, 4)
+        cache, log = self._cache(pa)
+        toks = list(range(12))
+        assert pa.ensure(0, 12)  # 3 pages: a chain of 3 nodes
+        cache.insert(0, toks, 12)
+        pa.release(0)
+        # reclaim spills nodes regardless of tree position — including
+        # the chain's interior/root (ticks tie; walk order breaks them)
+        pa._reclaim(3)
+        assert cache.host_pages >= 1
+        # the tree still matches through the spilled node(s)
+        nodes, matched = cache._walk(toks + [99])
+        assert matched == 12
+        pa.check_no_leaks(external=cache.page_refs())
+
+    def test_attach_truncates_when_no_page_for_readmit(self):
+        pa = PageAllocator(4, 4, 2, 4)
+        cache, log = self._cache(pa)
+        assert pa.ensure(0, 8)
+        toks = list(range(8))
+        cache.insert(0, toks, 8)
+        pa.release(0)
+        assert pa.ensure(1, 16)  # spills both cached pages
+        assert cache.host_pages == 2
+        # pool fully held by slot 1: re-admission cannot get a page —
+        # the match truncates to 0 instead of failing the admission
+        matched = cache.attach(0, toks + [99])
+        assert matched == 0
+        assert int((pa.table[0] != pa.scratch_page).sum()) == 0
+        pa.check_no_leaks(external=cache.page_refs())
+
+    def test_attach_never_reclaims_its_own_matched_path(self):
+        """Regression: the COW (and re-admit) page grabs inside attach
+        can drain the free list and trigger reclaim — which must NOT
+        spill/evict the very blocks this admission just matched (a
+        spilled node would splice page -1; an evicted one would splice
+        a page already back on the free list — aliasing). With the
+        matched path pinned, reclaim finds nothing idle, the COW
+        fails cleanly and the partial tail is dropped."""
+        pa = PageAllocator(4, 4, 2, 4)
+        cache, log = self._cache(pa)
+        assert pa.ensure(0, 8)
+        toks = list(range(8))
+        cache.insert(0, toks, 8)
+        pa.release(0)
+        assert pa.ensure(1, 16)  # hmm: would spill the cached chain
+        pa.release(1)
+        # restore a clean device-resident chain for the real scenario
+        cache.clear()
+        assert pa.ensure(0, 8)
+        cache.insert(0, toks, 8)
+        pa.release(0)
+        assert pa.ensure(1, 8)  # slot 1 pins the other two pages
+        # partial-tail prompt: full block A + 2 tokens of B -> COW
+        # wants a page; free list empty; the only idle pages are the
+        # matched chain itself
+        matched = cache.attach(0, toks[:6] + [99, 98])
+        assert matched == 4  # tail dropped, aligned prefix spliced
+        assert cache.host_pages == 0  # nothing on the path was spilled
+        pa.check_no_leaks(external=cache.page_refs())
+        pa.release(0)
+        pa.release(1)
+        pa.check_no_leaks(external=cache.page_refs())
+
+    def test_clear_discards_host_tier(self):
+        pa = PageAllocator(4, 4, 2, 4)
+        cache, log = self._cache(pa)
+        assert pa.ensure(0, 8)
+        cache.insert(0, list(range(8)), 8)
+        pa.release(0)
+        assert pa.ensure(1, 16) and pa.release(1) == 4
+        assert cache.host_pages == 2 and cache.host_bytes > 0
+        cache.clear()
+        assert cache.cached_pages == 0 and cache.host_bytes == 0
+        pa.check_no_leaks()
+        assert pa.free_pages == pa.num_pages
+
+
+def test_host_cache_requires_prefix_caching(tiny):
+    with pytest.raises(ValueError, match="host_cache_bytes"):
+        make_engine(tiny, host_cache_bytes=1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# engine-level: spill -> re-admit is bitwise across all pool layouts
+
+
+def _spill_scenario(tiny, kv_quant, budget):
+    """Returns (warm_outputs, spilled_outputs, stats): family 0 served
+    on a roomy pool twice (cold, then warm) and on a tight pool where
+    churn from other prompt families spills family 0's pages to host
+    before it is served again (re-admitted)."""
+    cfg, _ = tiny
+
+    kw = {} if kv_quant is None else {"kv_quant": kv_quant}
+
+    def make_rm(b):
+        return RequestManager(make_engine(
+            tiny, prefix_caching=True, host_cache_bytes=1 << 22,
+            max_cached_tokens=b, **kw,
+        ))
+
+    rm_w = make_rm(4096)
+    cold = generate(rm_w, family_prompts(cfg, 0))
+    warm = generate(rm_w, family_prompts(cfg, 0))
+    assert warm == cold, (kv_quant, "aligned warm hit must be bitwise")
+
+    rm_s = make_rm(budget)
+    first = generate(rm_s, family_prompts(cfg, 0))
+    assert first == cold
+    fam = 1
+    while (
+        not (rm_s.stats.spills and rm_s.prefix_cache.host_pages)
+        and fam < 24
+    ):
+        generate(rm_s, family_prompts(cfg, fam))
+        fam += 1
+    spilled = generate(rm_s, family_prompts(cfg, 0))
+    rm_s.engine.pager.check_no_leaks(
+        external=rm_s.prefix_cache.page_refs()
+    )
+    return warm, spilled, rm_s.stats
+
+
+@pytest.mark.parametrize(
+    "kv_quant,budget",
+    [(None, 160), ("int8", 42), ("int4", 22)],
+)
+def test_spilled_readmit_is_bitwise_warm(tiny, kv_quant, budget):
+    """The acceptance bar: a spilled-then-readmitted prefix page yields
+    BITWISE-identical generation to the never-evicted warm path — fp,
+    int8 and packed-int4 pages alike (the spill round-trip is
+    byte-exact: codes AND scale rows)."""
+    warm, spilled, stats = _spill_scenario(tiny, kv_quant, budget)
+    assert stats.spills > 0 and stats.readmits > 0, (
+        kv_quant, stats.spills, stats.readmits
+    )
+    assert stats.host_hit_tokens > 0
+    assert spilled == warm, (kv_quant, spilled, warm)
+
+
+def test_eviction_vs_spill_pressure_regression(tiny):
+    """Same tight-pool churn with the host tier OFF (plain eviction)
+    and ON (spill): identical outputs (fp pool — recompute is exact),
+    the eviction side recomputes what the spill side host-hits, and
+    both leave the allocator leak-free with the tree's external refs
+    (host-resident nodes holding none)."""
+    cfg, _ = tiny
+    outs, stats = {}, {}
+    for host in (None, 1 << 22):
+        rm = RequestManager(make_engine(
+            tiny, prefix_caching=True, host_cache_bytes=host,
+            max_cached_tokens=160,
+        ))
+        runs = []
+        for fam in (0, 1, 2, 3, 0, 1):
+            runs.append(generate(rm, family_prompts(cfg, fam)))
+        outs[host] = runs
+        stats[host] = rm.stats
+        rm.engine.pager.check_no_leaks(
+            external=rm.prefix_cache.page_refs()
+        )
+    assert outs[None] == outs[1 << 22]
+    s_off, s_on = stats[None], stats[1 << 22]
+    assert s_off.prefix_evictions > 0 and s_off.spills == 0
+    assert s_on.spills > 0 and s_on.prefix_evictions == 0
+    # the host tier converted evictions into host hits
+    assert s_on.readmits > 0
+    assert s_on.host_hit_tokens > 0
+    assert s_on.host_hit_rate > 0
+    # profile mirror: some admission recorded its host-served tokens
+    # (checked via the aggregate — per-request plumbing is the same
+    # counter delta)
+
+
+def test_profile_records_host_hit_tokens(tiny):
+    cfg, _ = tiny
+    rm = RequestManager(make_engine(
+        tiny, prefix_caching=True, host_cache_bytes=1 << 22,
+        max_cached_tokens=160,
+    ))
+    rm.generate(family_prompts(cfg, 0), max_new_tokens=6)
+    fam = 1
+    while not rm.prefix_cache.host_pages and fam < 24:
+        rm.generate(family_prompts(cfg, fam), max_new_tokens=6)
+        fam += 1
+    assert rm.prefix_cache.host_pages > 0
+    res = rm.generate(family_prompts(cfg, 0), max_new_tokens=6)
+    assert any(r.profile.host_hit_tokens > 0 for r in res)
+    assert all(
+        r.profile.host_hit_tokens <= r.profile.cached_prefix_len
+        for r in res
+    )

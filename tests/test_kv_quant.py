@@ -114,11 +114,33 @@ class TestPoolAccounting:
             make_engine(tiny, kv_layout="dense", kv_quant="int8")
         with pytest.raises(ValueError, match="unknown kv_quant"):
             make_engine(tiny, kv_quant="fp8")
-        # int4 is a designed-for layout, loudly unimplemented
-        with pytest.raises(NotImplementedError, match="int4"):
-            make_engine(tiny, kv_quant="int4")
         assert resolve_spec(None) is None
         assert resolve_spec("int8").qmax == 127.0
+        # int4 is live (PR 7): packed nibbles, two codes per byte
+        spec4 = resolve_spec("int4")
+        assert spec4.qmax == 7.0 and spec4.pack == 2
+        # packing needs an even head_dim — loud, at construction
+        import dataclasses
+
+        odd = dataclasses.replace(
+            tiny[0], hidden_size=60, num_attention_heads=4,
+            num_key_value_heads=2,
+        )
+        assert odd.head_dim % 2 == 1
+        with pytest.raises(ValueError, match="head_dim"):
+            llama.init_paged_kv_cache(odd, 8, 16, kv_quant="int4")
+
+    def test_int4_same_budget_buys_4x_pages(self, tiny):
+        """The int4 rung of the capacity ladder: pages store two codes
+        per byte along dk, so a fixed HBM budget exposes ~2x the int8
+        pages again (~4x bf16 / ~8x the f32 test baseline)."""
+        q8 = make_engine(tiny, max_cached_tokens=256, kv_quant="int8")
+        q4 = make_engine(tiny, max_cached_tokens=256, kv_quant="int4")
+        assert q4.pager.num_pages / q8.pager.num_pages >= 1.9
+        assert q4.cache["k"].dtype == jnp.uint8
+        # trailing dim packs two codes per byte
+        assert q4.cache["k"].shape[-1] == tiny[0].head_dim // 2
+        assert q4.kv_bytes_per_line() <= 0.6 * q8.kv_bytes_per_line()
 
 
 # ---------------------------------------------------------------------------

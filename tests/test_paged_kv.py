@@ -134,17 +134,19 @@ class TestPageAllocator:
 
 
 class TestAllocatorProperty:
-    @pytest.mark.parametrize("pool", ["fp", "int8"])
+    @pytest.mark.parametrize("pool", ["fp", "int8", "int4"])
     def test_randomized_interleavings_keep_invariants(self, tiny, pool):
         """Random admit/grow/share(attach)/COW/insert/release
         interleavings across 64 slots: after EVERY step the pool must
         hold no leak, no double-free, and refcount-zero-iff-free
         (check_no_leaks audits all three against the slot tables plus
-        the prefix tree's external refs). The ``int8`` variant runs the
-        SAME sweep over a quantized engine's allocator — the pool the
-        bytes-per-page accounting sized (serve/kv_quant.py) — because
-        the invariants are dtype-independent: the allocator hands out
-        page indices, never bytes."""
+        the prefix tree's external refs). The ``int8``/``int4``
+        variants run the SAME sweep over a quantized engine's
+        allocator — the pool the bytes-per-page accounting sized
+        (serve/kv_quant.py; int4 stores packed nibbles, so the same
+        token budget buys ~2x the int8 pages again) — because the
+        invariants are dtype- and pack-independent: the allocator
+        hands out page indices, never bytes."""
         from flexflow_tpu.serve.prefix_cache import PrefixCache
 
         rng = np.random.default_rng(1234)
@@ -153,14 +155,16 @@ class TestAllocatorProperty:
             pa = PageAllocator(160, pps, slots, ps)
         else:
             # page_size=4, cache_len+1 = 24 -> pages_per_slot = 6; the
-            # 164-token f32 budget converts to ~160 int8 pages
+            # 164-token f32 budget converts to ~160 int8 pages, and a
+            # 92-token budget to ~160 packed-int4 pages
             eng = make_engine(
                 tiny, "paged", slots=slots, page_size=ps, max_seq=19,
-                spec_slack=4, kv_quant="int8", max_cached_tokens=164,
+                spec_slack=4, kv_quant=pool,
+                max_cached_tokens=164 if pool == "int8" else 92,
             )
             pa = eng.pager
             assert pa.pages_per_slot == pps
-            assert pa.num_pages >= 150  # the budget bought ~3.9x pages
+            assert pa.num_pages >= 150  # the budget bought ~4x/~8x f32 pages
         cache = PrefixCache(pa, copy_page=None)  # bookkeeping-only COW
         pa.reclaim_cb = cache.reclaim
         max_lines = pps * ps
