@@ -197,6 +197,13 @@ _PHASES = [
     # on a 64-slot shared-prefix Poisson workload: TTFT p50/p99,
     # spill/readmit counters, host hit rate, bitwise output parity)
     ("serve_kv_hierarchy", 900, 600, True, True),
+    # cluster serving: 2 engine replicas behind the front-end router on
+    # a shared-prefix Poisson workload — prefix-aware vs round-robin
+    # placement (tokens/sec + TTFT p50/p99, hit-rate split, affinity/
+    # migration counters), plus a disaggregated 1-prefill/1-decode
+    # mini-run (byte-exact page migration); bitwise output parity +
+    # zero steady-state recompiles asserted per replica
+    ("serve_cluster", 900, 600, True, True),
     # megakernel decode step: per-fusion ablation (rope_kv_write /
     # sampling / both) on small-batch sync decode — decode_step_ms
     # p50/p99 + dispatched programs per step, bitwise parity asserted
@@ -323,6 +330,30 @@ def orchestrate(which):
                 platform=d.get("platform"),
             )
 
+    # Derived: cross-replica prefix hit rate — the fraction of cluster
+    # admissions served (partly) from SOME replica's radix tree under
+    # prefix-aware routing, next to the round-robin rate on the same
+    # workload. The gap is the router's contribution: how much cache
+    # value placement preserved that spreading the same traffic
+    # destroyed.
+    rec = _RESULTS.get("cluster_serve_tokens_per_sec_per_chip")
+    if rec:
+        d = rec.get("detail") or {}
+        if d.get("prefix_hit_rate") is not None:
+            emit(
+                "cluster_prefix_hit_rate",
+                d["prefix_hit_rate"],
+                "fraction",
+                source=rec["metric"],
+                round_robin_hit_rate=d.get("rr_prefix_hit_rate"),
+                prefix_hit_tokens=d.get("prefix_hit_tokens"),
+                rr_prefix_hit_tokens=d.get("rr_prefix_hit_tokens"),
+                n_replicas=d.get("n_replicas"),
+                migrations=d.get("disagg_migrations"),
+                migrated_bytes=d.get("disagg_migrated_bytes"),
+                platform=d.get("platform"),
+            )
+
     # Derived: decode-step latency, so BENCH_r*.json tracks step time
     # across rounds. The serve_fused phase measures it fused AND
     # unfused — the summary carries the fused p50 (the shipped
@@ -349,6 +380,7 @@ def orchestrate(which):
         "specinfer_tokens_per_sec_per_chip",
         "incr_decode_tokens_per_sec_per_chip",
         "continuous_serve_tokens_per_sec_per_chip",
+        "cluster_serve_tokens_per_sec_per_chip",
         "paged_serve_tokens_per_sec_per_chip",
         "paged_q_serve_tokens_per_sec_per_chip",
         "kv_hier_serve_tokens_per_sec_per_chip",
@@ -1796,6 +1828,270 @@ def serve_kv_hierarchy_bench(on_tpu, kernels):
     return spill["tps"]
 
 
+def serve_cluster_bench(on_tpu, kernels):
+    """Cluster serving (serve/cluster/): N engine replicas behind the
+    front-end router on a shared-system-prompt Poisson workload with
+    SEVERAL prefix families — the regime where placement matters.
+
+    A/B: prefix-aware routing (longest radix-tree match; least-loaded
+    fallback seeds each family on one replica) vs round_robin on the
+    SAME arrival schedule and prompts. Per-replica prefix trees are
+    sized so ONE replica cannot hold every family: prefix routing
+    PARTITIONS the families (each replica serves its own subset at a
+    high hit rate), while round-robin smears every family across every
+    replica and LRU-thrashes the trees. Reports tokens/sec and TTFT
+    p50/p99 for both arms, per-arm cross-replica prefix hit rates,
+    placement/affinity counters, and asserts BITWISE output parity
+    between the arms (placement must never change tokens — the PR-3
+    hit-path guarantee, now load-bearing for routing) plus zero
+    steady-state recompiles on EVERY replica under the strict retrace
+    sentinel.
+
+    A third mini-run exercises disaggregation: 1 prefill + 1 decode
+    replica over a slice of the same workload — prefilled KV pages
+    migrate at the chunked-prefill boundary (gather_page_kv →
+    scatter_page_kv, byte-exact) — asserting bitwise parity vs the
+    prefix arm's outputs for those requests and reporting
+    migrations/migrated bytes.
+
+    Measurement caveat (CPU): as with serve_prefix, XLA:CPU steps are
+    nearly width-flat, so the throughput gap under-reports the
+    accelerator win; the TTFT gap (fewer prefill chunks before the
+    first token) and the hit-rate split are the portable signal. Also,
+    in-process replicas SHARE the one CPU device — N replicas
+    time-slice one chip, so absolute tokens/sec here is not N-way
+    scale-out; the A/B ratio at equal resources is the metric."""
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.serve import ClusterManager, ServingConfig
+    from flexflow_tpu.models import llama
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_rep = 2
+    n_slots = 16 if on_tpu else 8       # per replica
+    # MANY families, FEW requests each — the regime where placement is
+    # structural: prefix routing pays ONE cold prefill per family
+    # (relatives follow the match), round robin smears each family
+    # over every replica and pays a cold prefill per family PER
+    # replica, with the duplicated trees also deeper into LRU pressure.
+    # n_fam is CO-PRIME with n_rep: an even family count over 2
+    # replicas would let round robin (request g -> replica g % 2, g's
+    # family = g % n_fam) accidentally partition families perfectly
+    # and measure nothing.
+    n_fam = 11
+    reqs_per_fam = 4 if on_tpu else 3
+    n_new = 24 if on_tpu else 8
+    sys_len = 128 if on_tpu else 32     # page-aligned shared prefix
+    page_size = 64 if on_tpu else 8
+    tail_len = 8 if on_tpu else 6
+    prefill_chunk = 32 if on_tpu else 8
+    if not on_tpu and kernels == "pallas":
+        _log("serve_cluster: forcing kernels=xla off-TPU (interpret-mode "
+             "pallas would dominate the measurement)")
+        kernels = "xla"
+    assert sys_len % page_size == 0
+    prompt_len = sys_len + tail_len
+
+    def fam_prompt(f, g):
+        sys_p = [(j * 11 + f * 41 + 3) % cfg.vocab_size
+                 for j in range(sys_len)]
+        tail = [(g * 13 + 5 + j * 7) % cfg.vocab_size
+                for j in range(tail_len)]
+        return sys_p + tail
+
+    # families interleave a full cycle apart: a family's first request
+    # has finished prefilling (and published, cache_policy "prefill")
+    # by the time its relatives arrive, so routing-time matches see it
+    fams = [f for _ in range(reqs_per_fam) for f in range(n_fam)]
+    prompts = [fam_prompt(f, g) for g, f in enumerate(fams)]
+    n_req = len(prompts)
+    # Per-replica pool: a TYPICAL live working set (half the slots at
+    # full length — Poisson occupancy rarely pins all slots at once)
+    # plus room for about HALF the families' system pages: prefix
+    # routing's partition (n_fam/n_rep families per replica) fits,
+    # round robin — which wants all n_fam resident on every replica —
+    # runs its trees deeper into LRU eviction on top of its doubled
+    # cold prefills.
+    budget = (
+        (n_slots // 2) * (prompt_len + n_new + page_size)
+        + (n_fam // 2) * (sys_len + page_size)
+    )
+
+    def make_cm(policy, prefill=0, decode=0):
+        sc = ServingConfig(
+            max_requests_per_batch=n_slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=prefill_chunk,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=page_size,
+            max_cached_tokens=budget,
+            prefix_caching=True,
+            # publish prompts at prefill-final dispatch: the router's
+            # match probe then sees a family as soon as its FIRST
+            # request finishes prefilling, not its whole generation —
+            # concurrent same-family arrivals route (and hit) sooner
+            cache_policy="prefill",
+            replicas=n_rep,
+            router_policy=policy,
+            prefill_replicas=prefill,
+            decode_replicas=decode,
+            # a recompile mid-run would skew the A/B — raise instead
+            sanitizers=("retrace",),
+        )
+        cm = ClusterManager.build(llama, cfg, params, sc)
+        # warm every replica's step keys directly (distinct throwaway
+        # prompts so no family pre-seeds a tree), then clear the trees
+        # and reset counters so both arms start cold and equal
+        warm = [
+            [(i * 7 + j * 3 + 11) % cfg.vocab_size
+             for j in range(prompt_len)]
+            for i in range(2)
+        ]
+        for rep in cm.replicas:
+            rep.rm.generate(warm, max_new_tokens=3)
+            if rep.rm.prefix_cache is not None:
+                rep.rm.prefix_cache.clear()
+            rep.rm.stats = type(rep.rm.stats)()
+        cm.stats = type(cm.stats)()
+        return cm
+
+    def percentiles(vals):
+        import numpy as np
+
+        if not vals:
+            return 0.0, 0.0
+        return (float(np.percentile(vals, 50)), float(np.percentile(vals, 99)))
+
+    def run(cm, arrival_s, workload, sessions=None):
+        cids = []
+        due = list(zip(arrival_s, enumerate(workload)))
+        t0 = time.perf_counter()
+        while due or any(not cm._terminal(c) for c in cids):
+            now = time.perf_counter() - t0
+            while due and due[0][0] <= now:
+                _, (i, p) = due.pop(0)
+                cids.append(cm.submit(
+                    p, max_new_tokens=n_new,
+                    session_id=sessions[i] if sessions else None,
+                ))
+            if not cm.step() and due:
+                time.sleep(max(0.0, due[0][0] - (time.perf_counter() - t0)))
+        cm.drain()
+        wall = time.perf_counter() - t0
+        tokens, ttft, outs = 0, [], []
+        for c in cids:
+            res = cm.result(c)
+            assert res.error is None, res.error
+            outs.append(list(res.output_tokens))
+            tokens += len(res.output_tokens)
+            ttft.append(res.profile.ttft_s * 1e3)
+        snap = cm.cluster_stats()
+        for i, per in enumerate(snap["per_replica"]):
+            assert per["retraces"] == 0, (
+                f"replica {i}: {per['retraces']} steady-state recompiles"
+            )
+        return {
+            "tps": tokens / wall,
+            "ttft": percentiles(ttft),
+            "outputs": outs,
+            "stats": snap,
+        }
+
+    # calibrate offered load on the round-robin arm so both arms face
+    # the same sustained churn
+    cm_rr = make_cm("round_robin")
+    t0 = time.perf_counter()
+    cm_rr.generate(prompts[: n_rep * n_slots], max_new_tokens=n_new)
+    est_tps = (n_rep * n_slots * n_new) / (time.perf_counter() - t0)
+    for rep in cm_rr.replicas:
+        if rep.rm.prefix_cache is not None:
+            rep.rm.prefix_cache.clear()
+        rep.rm.stats = type(rep.rm.stats)()
+    cm_rr.stats = type(cm_rr.stats)()
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    arrival_s = np.cumsum(
+        rng.exponential(scale=n_new / est_tps, size=n_req)
+    ).tolist()
+
+    base = run(cm_rr, arrival_s, prompts)
+    del cm_rr
+    warm = run(make_cm("prefix"), arrival_s, prompts)
+
+    assert warm["outputs"] == base["outputs"], (
+        "prefix-aware vs round-robin cluster outputs diverged — "
+        "placement must never change tokens"
+    )
+
+    # ---- disaggregated mini-run: 1 prefill + 1 decode replica --------
+    # per-family session ids model multi-turn chat: repeat requests of
+    # a family route by AFFINITY (counted). With ONE prefill replica
+    # the placement is unchanged, so parity with the prefix arm holds.
+    n_dis = min(n_req, 2 * n_slots)
+    cm_dis = make_cm("prefix", prefill=1, decode=1)
+    dis = run(cm_dis, arrival_s[:n_dis], prompts[:n_dis],
+              sessions=[f"fam-{f}" for f in fams[:n_dis]])
+    ds = dis["stats"]
+    assert dis["outputs"] == warm["outputs"][:n_dis], (
+        "disaggregated outputs diverged from single-pool routing — "
+        "page migration must be byte-exact"
+    )
+    assert ds["migrations"] == n_dis, (
+        f"expected {n_dis} migrations, measured {ds['migrations']}"
+    )
+    cm_dis.check_no_leaks()
+    del cm_dis
+
+    s, b = warm["stats"], base["stats"]
+    emit(
+        "cluster_serve_tokens_per_sec_per_chip",
+        round(warm["tps"], 2),
+        "tokens/sec/chip",
+        vs_baseline=warm["tps"] / max(1e-9, base["tps"]),
+        kernels=kernels,
+        n_replicas=n_rep,
+        n_requests=n_req,
+        n_slots_per_replica=n_slots,
+        n_families=n_fam,
+        new_tokens_per_request=n_new,
+        system_prompt_len=sys_len,
+        prompt_len=prompt_len,
+        page_size=page_size,
+        router_policy="prefix",
+        placements=s["placements"],
+        affinity_hits=ds["affinity_hits"],  # sessions ride the disagg run
+        sheds=s["sheds"],
+        prefix_hit_rate=s["replicas"]["prefix_hit_rate"],
+        prefix_hit_tokens=s["replicas"]["prefix_hit_tokens"],
+        rr_prefix_hit_rate=b["replicas"]["prefix_hit_rate"],
+        rr_prefix_hit_tokens=b["replicas"]["prefix_hit_tokens"],
+        prefix_evictions=s["replicas"]["prefix_evictions"],
+        rr_prefix_evictions=b["replicas"]["prefix_evictions"],
+        ttft_p50_ms=round(warm["ttft"][0], 1),
+        ttft_p99_ms=round(warm["ttft"][1], 1),
+        rr_ttft_p50_ms=round(base["ttft"][0], 1),
+        rr_ttft_p99_ms=round(base["ttft"][1], 1),
+        rr_tokens_per_sec=round(base["tps"], 2),
+        disagg_requests=n_dis,
+        disagg_migrations=ds["migrations"],
+        disagg_migrated_pages=ds["migrated_pages"],
+        disagg_migrated_bytes=ds["migrated_bytes"],
+        disagg_tokens_per_sec=round(dis["tps"], 2),
+        output_parity=1,
+        jit_compiles_measured=s["replicas"]["compiles"],
+        steady_state_recompiles=s["replicas"]["retraces"],
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return warm["tps"]
+
+
 def serve_fused_bench(on_tpu, kernels):
     """Megakernel decode step (serve/kernels.py fused prologue +
     serve/sampling.py fused epilogue, ``ServingConfig.fused_decode``):
@@ -2129,6 +2425,8 @@ def child_main(phase, platform, kernels):
         serve_quantized_bench(on_tpu, kernels, bits=8)
     elif phase == "serve_int4":
         serve_quantized_bench(on_tpu, kernels, bits=4)
+    elif phase == "serve_cluster":
+        serve_cluster_bench(on_tpu, kernels)
     elif phase == "serve_7b":
         serve_7b_bench(on_tpu, kernels)
     else:
@@ -2142,8 +2440,8 @@ def main():
         default="all",
         choices=["all", "train", "searched", "parity", "serve",
                  "serve_paged", "serve_continuous", "serve_prefix",
-                 "serve_paged_q", "serve_kv_hierarchy", "serve_fused",
-                 "serve_int8", "serve_int4", "serve_7b"],
+                 "serve_paged_q", "serve_kv_hierarchy", "serve_cluster",
+                 "serve_fused", "serve_int8", "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)

@@ -104,6 +104,11 @@ def cmd_serve(args):
         fused_decode=tuple(
             s for s in (args.fused_decode or "").split(",") if s
         ),
+        replicas=args.replicas,
+        router_policy=args.router_policy,
+        prefill_replicas=args.prefill_replicas,
+        decode_replicas=args.decode_replicas,
+        slo_queue_delay_s=args.slo_queue_delay_s,
     )
     ssms = []
     spec = None
@@ -238,6 +243,32 @@ def main(argv=None):
                         "--pallas) and/or the greedy/top-k sampling "
                         "epilogue into the step program; each fusion is "
                         "bitwise-identical to the unfused step")
+    s.add_argument("--replicas", type=int, default=1,
+                   help="cluster serving (serve/cluster/): drive this "
+                        "many engine replicas — each its own mesh and "
+                        "KV pool — behind the front-end router")
+    s.add_argument("--router-policy",
+                   choices=["prefix", "round_robin", "least_loaded"],
+                   default="prefix",
+                   help="replica placement: longest prefix-cache match "
+                        "(prefix, the default — falls back to least-"
+                        "loaded on a miss), round_robin, or the "
+                        "smallest queue-delay estimate (least_loaded)")
+    s.add_argument("--prefill-replicas", type=int, default=0,
+                   help="disaggregated serving: the first N replicas "
+                        "only prefill — finished prefills migrate "
+                        "their KV pages to a decode-pool replica "
+                        "(byte-exact; requires --kv-layout paged; "
+                        "must pair with --decode-replicas and sum to "
+                        "--replicas)")
+    s.add_argument("--decode-replicas", type=int, default=0,
+                   help="disaggregated serving: the last N replicas "
+                        "only decode (see --prefill-replicas)")
+    s.add_argument("--slo-queue-delay-s", type=float, default=None,
+                   help="SLO admission: shed a request (terminal "
+                        "GenerationResult.error, never a hang) when "
+                        "every replica's queue-delay estimate exceeds "
+                        "this many seconds")
     # reference -output-file (request_manager.cc:417-440): append each
     # finished request's latency/steps/token-ids
     s.add_argument("--output-file", "-output-file", default=None)

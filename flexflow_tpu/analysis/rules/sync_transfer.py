@@ -57,6 +57,14 @@ HOT_ROOTS = {
     "copy_page",
     "fetch_page",
     "upload_page",
+    # cluster serving (serve/cluster/): the router/manager drive loop
+    # and the prefill→decode migration — its one blocking harvest is a
+    # designed flush point and must carry a reasoned suppression
+    "submit",
+    "route",
+    "migrate_request",
+    "_migrate_ready",
+    "_finish_or_migrate",
 }
 
 # Calls that force a synchronous transfer / device round-trip.

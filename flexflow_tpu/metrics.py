@@ -215,6 +215,91 @@ class SchedulerStats:
 
 
 @dataclasses.dataclass
+class ClusterStats:
+    """Cluster-level serving telemetry (serve/cluster/): the front-end
+    router's own counters plus an aggregation hook over every replica's
+    :class:`SchedulerStats`. The ClusterManager updates the router
+    counters at placement/shed/migration time and passes the per-replica
+    stats as CALLABLES (the same indirection SchedulerStats uses for the
+    prefix cache and retrace guard), so bench-style stat swaps
+    (``rm.stats = SchedulerStats()``) keep counting."""
+
+    submitted: int = 0
+    # placements by HOW the router decided: "prefix" (longest radix-tree
+    # match), "affinity" (session stickiness), "round_robin",
+    # "least_loaded" (policy or prefix-miss fallback)
+    placements: Dict[str, int] = dataclasses.field(default_factory=dict)
+    affinity_hits: int = 0
+    sheds: int = 0                 # SLO admission rejections (ERROR, not hangs)
+    migrations: int = 0            # prefill→decode page hand-offs
+    migrated_pages: int = 0
+    migrated_bytes: int = 0
+
+    def record_placement(self, how: str) -> None:
+        self.placements[how] = self.placements.get(how, 0) + 1
+        if how == "affinity":
+            self.affinity_hits += 1
+
+    def snapshot(
+        self, replicas: Sequence["SchedulerStats"] = ()
+    ) -> Dict[str, object]:
+        """Router counters + the SUM over every replica's scheduler
+        counters (numeric fields only; per-replica snapshots ride along
+        under ``per_replica`` so nothing is averaged away)."""
+        per = [r.snapshot() for r in replicas]
+        agg: Dict[str, float] = {}
+        for snap in per:
+            for k, v in snap.items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+        # rates do not sum — recompute them over the summed counters
+        if per:
+            hits = agg.get("prefix_hits", 0)
+            misses = agg.get("prefix_misses", 0)
+            agg["prefix_hit_rate"] = round(
+                hits / (hits + misses), 4
+            ) if hits + misses else 0.0
+            hit_toks = agg.get("prefix_hit_tokens", 0)
+            agg["host_hit_rate"] = round(
+                agg.get("host_hit_tokens", 0) / hit_toks, 4
+            ) if hit_toks else 0.0
+            agg["mean_occupancy"] = round(
+                sum(s["mean_occupancy"] for s in per) / len(per), 4
+            )
+            agg["mean_budget_fill"] = round(
+                sum(s["mean_budget_fill"] for s in per) / len(per), 4
+            )
+        return {
+            "submitted": self.submitted,
+            "placements": dict(self.placements),
+            "affinity_hits": self.affinity_hits,
+            "sheds": self.sheds,
+            "migrations": self.migrations,
+            "migrated_pages": self.migrated_pages,
+            "migrated_bytes": self.migrated_bytes,
+            "replicas": agg,
+            "per_replica": per,
+        }
+
+    def report(self, replicas: Sequence["SchedulerStats"] = ()) -> str:
+        s = self.snapshot(replicas)
+        place = " ".join(
+            f"{k}={v}" for k, v in sorted(s["placements"].items())
+        ) or "none"
+        agg = s["replicas"]
+        return (
+            f"[cluster {len(replicas)} replicas] sub={s['submitted']} "
+            f"place[{place}] affinity={s['affinity_hits']} "
+            f"shed={s['sheds']} migr={s['migrations']} "
+            f"migrB={s['migrated_bytes']} "
+            f"pfx_hit_rate={agg.get('prefix_hit_rate', 0.0)} "
+            f"adm={agg.get('admitted', 0)} "
+            f"preempt={agg.get('preemptions', 0)} "
+            f"retraces={agg.get('retraces', 0)}"
+        )
+
+
+@dataclasses.dataclass
 class PerfMetrics:
     """Host-side running aggregate — reference ``PerfMetrics`` future chain
     (``FFModel::update_metrics_task``, reference ``model.cc:3911``)."""

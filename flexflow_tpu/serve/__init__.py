@@ -12,6 +12,7 @@ from .batch_config import (
     GenerationResult,
     StreamEvent,
 )
+from .cluster import ClusterManager, Replica, Router
 from .engine import InferenceEngine, ServingConfig
 from .llm import LLM, SSM, detect_family
 from .paging import PageAllocator
@@ -22,6 +23,9 @@ from .specinfer import SpecConfig, SpecInferManager, TokenTree
 
 __all__ = [
     "BatchConfig",
+    "ClusterManager",
+    "Replica",
+    "Router",
     "GenerationConfig",
     "GenerationResult",
     "InferenceEngine",

@@ -123,6 +123,12 @@ class ProfileInfo:
     ssm_decoding_steps: int = 0
     speculated_tokens: int = 0
     accepted_tokens: int = 0
+    # Cluster serving (serve/cluster/): which engine replica served the
+    # request's decode phase (-1 outside a cluster), and the router's
+    # queue-delay estimate for that replica at placement time — the
+    # figure SLO admission sheds on (ServingConfig.slo_queue_delay_s).
+    replica_id: int = -1
+    router_queue_delay_s: float = 0.0
 
     @property
     def latency_s(self) -> float:

@@ -74,7 +74,20 @@ def init(cfg_json: str) -> int:
 
     sc = ServingConfig(**_dtypes(cfg.get("serving", {}), "cache_dtype"))
     params = mod.init_params(jax.random.PRNGKey(cfg.get("seed", 0)), mcfg)
-    rm = RequestManager(InferenceEngine(mod, mcfg, params, sc))
+    if sc.replicas > 1 or sc.prefill_replicas:
+        # Cluster serving: the C host drives the ClusterManager through
+        # the SAME step loop — register/step/num_active/fetch all read
+        # the RequestStatus-shaped cluster requests, so a request SHED
+        # by SLO admission is terminal (ERROR) exactly like the PR-2
+        # unservable-request path: num_active drops, fetch returns
+        # None, and the host's loop never spins on it.
+        from .cluster import ClusterManager
+
+        rm = ClusterManager.build(
+            mod, mcfg, params, sc, seed=cfg.get("seed", 0)
+        )
+    else:
+        rm = RequestManager(InferenceEngine(mod, mcfg, params, sc))
     _STATE["rm"] = rm
     _STATE["max_new_tokens"] = int(cfg.get("max_new_tokens", 32))
     return 0

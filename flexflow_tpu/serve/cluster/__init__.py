@@ -1,0 +1,44 @@
+"""Cluster serving — multi-replica engine pool behind a prefix-aware
+router, with optional disaggregated prefill/decode pools.
+
+The "millions of users" layer over the single-engine serve stack
+(ROADMAP item 1): one process drives N :class:`Replica` — each its own
+:class:`InferenceEngine` with its own mesh/TP group, KV page pool and
+prefix-cache radix tree — behind a front-end :class:`Router` that
+places each request by prefix-cache affinity (longest radix-tree match
+wins; FlexFlow's RequestManager-orchestrated batches, scaled out),
+session affinity for multi-turn chat, and SLO-aware admission with
+load shedding. Disaggregation (``ServingConfig.prefill_replicas`` /
+``decode_replicas``) splits the pools and ships prefilled KV PAGES
+from a prefill replica to a decode replica at the chunked-prefill
+boundary (:mod:`.migration` — byte-exact over fp/int8/int4 pools, so
+disaggregated generation is bitwise the single-replica path's).
+
+Configuration lives on :class:`~flexflow_tpu.serve.ServingConfig`
+(``replicas``, ``router_policy``, ``prefill_replicas`` /
+``decode_replicas``, ``slo_queue_delay_s``) and is validated at
+construction. Entry points::
+
+    cm = ClusterManager.build(llama, cfg, params, serving)
+    cm.generate(prompts, max_new_tokens=32)      # blocking
+    cid = cm.submit(prompt, session_id="chat-7") # non-blocking
+    for ev in cm.generate_stream(prompts): ...   # per-token events
+
+Telemetry: :class:`flexflow_tpu.metrics.ClusterStats` (router counters
++ per-replica SchedulerStats aggregation) via
+``ClusterManager.cluster_stats()``, logged at ``FF_LOG=serve=debug``;
+per-request ``ProfileInfo.replica_id`` / ``router_queue_delay_s``.
+"""
+from .manager import ClusterManager, ClusterRequest
+from .migration import migrate_request
+from .replica import Replica
+from .router import POLICIES, Router
+
+__all__ = [
+    "ClusterManager",
+    "ClusterRequest",
+    "Replica",
+    "Router",
+    "POLICIES",
+    "migrate_request",
+]

@@ -1,0 +1,446 @@
+"""ClusterManager — one process driving N engine replicas.
+
+The cluster front-end: RequestManager-shaped API (``submit`` /
+``step`` / ``drain`` / ``generate`` / ``generate_stream`` / ``result``)
+over a pool of :class:`Replica` (each its own engine, mesh and KV pool)
+behind a :class:`Router`. The manager owns cluster-level request
+identity (cluster ids are independent of any replica's guids), the
+per-step drive loop over every replica's scheduler, and — under
+disaggregation — the prefill→decode page migrations.
+
+Request lifecycle::
+
+    submit ──router──┬── shed ──────────────→ ERROR (terminal, PR-2 contract)
+                     ├── mixed replica ─────→ prefill+decode there ("single")
+                     └── prefill replica ───→ prefill, max_new_tokens=1
+                             │ held slot        ("prefill")
+                             └─ COMPLETED → migrate pages → decode replica
+                                             adopts into DECODING ("decode")
+
+Sheds come from SLO admission (``ServingConfig.slo_queue_delay_s``):
+they surface as ``GenerationResult.error`` exactly like the PR-2
+unservable-request path — a shed request is terminal the moment it is
+submitted and can never hang a ``generate()``/stream/C-host loop.
+
+With ``replicas=1`` the manager routes everything to replica 0 and the
+replica runs the bit-for-bit single-engine scheduler — the router adds
+bookkeeping, never a different step sequence (asserted bitwise in
+tests/test_cluster.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+from ...logging_utils import get_logger
+from ...metrics import ClusterStats
+from ..batch_config import (
+    GenerationConfig,
+    GenerationResult,
+    ProfileInfo,
+    StreamEvent,
+)
+from ..engine import ServingConfig
+from ..request_manager import TERMINAL_STATUSES, RequestStatus
+from .migration import migrate_request
+from .replica import Replica
+from .router import Router
+
+
+@dataclasses.dataclass
+class ClusterRequest:
+    """Cluster-level view of one request: where it lives now (replica
+    position + replica-local rid) and which phase of the disaggregated
+    lifecycle it is in. ``rid is None`` iff the request was shed."""
+
+    cluster_id: int
+    tokens: List[int]
+    prompt_text: str
+    gen: GenerationConfig
+    session_id: Optional[object] = None
+    replica: Optional[int] = None       # position into manager.replicas
+    rid: Optional[int] = None           # replica-local request id
+    phase: str = "single"               # "single" | "prefill" | "decode"
+    error: Optional[str] = None         # shed reason (rid is None)
+    profile: ProfileInfo = dataclasses.field(default_factory=ProfileInfo)
+
+    _manager: Any = dataclasses.field(default=None, repr=False)
+
+    @property
+    def status(self) -> RequestStatus:
+        """RequestStatus-shaped view (c_backend drives clusters through
+        the same loop it drives a bare RequestManager with)."""
+        if self.rid is None:
+            return RequestStatus.ERROR
+        home = self._manager.replicas[self.replica].rm
+        st = home.requests[self.rid].status
+        if self.phase == "prefill" and st in TERMINAL_STATUSES:
+            # completed ON THE PREFILL POOL means "awaiting migration",
+            # not done — unless the manager decided it finished there
+            return (
+                st if st is RequestStatus.ERROR
+                else RequestStatus.DECODING
+            )
+        return st
+
+    @property
+    def output_tokens(self) -> List[int]:
+        if self.rid is None:
+            return []
+        home = self._manager.replicas[self.replica].rm
+        return home.requests[self.rid].output_tokens
+
+
+class ClusterManager:
+    """Drive ``replicas`` behind a router (see module docstring)."""
+
+    def __init__(
+        self,
+        replicas: Sequence[Replica],
+        serving: ServingConfig,
+        *,
+        router: Optional[Router] = None,
+        tokenizer: Any = None,
+        eos_token_id: Optional[int] = None,
+    ):
+        serving.validate_cluster()
+        if len(replicas) != serving.replicas:
+            raise ValueError(
+                f"ServingConfig.replicas={serving.replicas} but "
+                f"{len(replicas)} replicas were built"
+            )
+        self.serving = serving
+        self.replicas = list(replicas)
+        self.tokenizer = tokenizer
+        self.eos_token_id = eos_token_id
+        if eos_token_id is None and tokenizer is not None:
+            self.eos_token_id = getattr(tokenizer, "eos_token_id", None)
+        self.stats = ClusterStats()
+        self.prefill_pool = [r for r in self.replicas if r.role == "prefill"]
+        self.decode_pool = [r for r in self.replicas if r.role == "decode"]
+        self.disaggregated = bool(self.prefill_pool)
+        if self.disaggregated and not self.decode_pool:
+            raise ValueError("prefill pool without a decode pool")
+        routing = self.prefill_pool if self.disaggregated else self.replicas
+        self.router = router or Router(
+            routing,
+            serving.router_policy,
+            slo_queue_delay_s=serving.slo_queue_delay_s,
+            stats=lambda: self.stats,
+        )
+        # router positions index the ROUTING pool; map back to cluster
+        # positions so ClusterRequest.replica is always cluster-wide
+        self._routing_pos = [self.replicas.index(r) for r in routing]
+        self.requests: Dict[int, ClusterRequest] = {}
+        self._next_cid = 1
+        self._step_counter = 0
+        self._log = get_logger("serve")
+
+    # ------------------------------------------------------------------
+    # construction
+
+    @classmethod
+    def build(
+        cls,
+        model: Any,
+        cfg: Any,
+        params: Any,
+        serving: Optional[ServingConfig] = None,
+        *,
+        tokenizer: Any = None,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+        devices: Optional[Sequence[Any]] = None,
+    ) -> "ClusterManager":
+        """Build ``serving.replicas`` in-process replicas — params
+        shared by reference, each replica with its own mesh over a
+        device picked round-robin from ``devices`` (all of them on a
+        1-device host: independent engines on one chip is the
+        in-process cluster this PR ships; per-host processes slot in
+        behind the same Replica surface later)."""
+        serving = serving or ServingConfig()
+        serving.validate_cluster()
+        import jax
+
+        devs = list(devices or jax.devices())
+        roles = ["mixed"] * serving.replicas
+        if serving.prefill_replicas:
+            roles = (
+                ["prefill"] * serving.prefill_replicas
+                + ["decode"] * serving.decode_replicas
+            )
+        replicas = [
+            Replica.build(
+                i, model, cfg, params, serving,
+                role=roles[i],
+                devices=[devs[i % len(devs)]],
+                tokenizer=tokenizer,
+                eos_token_id=eos_token_id,
+                seed=seed,
+            )
+            for i in range(serving.replicas)
+        ]
+        return cls(
+            replicas, serving, tokenizer=tokenizer,
+            eos_token_id=eos_token_id,
+        )
+
+    # ------------------------------------------------------------------
+    # submission
+
+    def _tokenize(self, prompt: Union[str, Sequence[int]]):
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompt requires a tokenizer")
+            return list(self.tokenizer.encode(prompt)), prompt
+        return [int(t) for t in prompt], ""
+
+    def submit(
+        self,
+        prompt: Union[str, Sequence[int]],
+        gen: Optional[GenerationConfig] = None,
+        max_new_tokens: Optional[int] = None,
+        session_id: Optional[object] = None,
+    ) -> int:
+        """Route + queue one request; returns its CLUSTER id
+        immediately (non-blocking — drive with :meth:`step` or a
+        concurrent :meth:`generate`/:meth:`generate_stream`). A shed
+        request is terminal on return (``result`` carries the error)."""
+        gen = gen or GenerationConfig()
+        if max_new_tokens is not None:
+            gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
+        tokens, text = self._tokenize(prompt)
+        cid = self._next_cid
+        self._next_cid += 1
+        self.stats.submitted += 1
+        cr = ClusterRequest(
+            cluster_id=cid, tokens=tokens, prompt_text=text, gen=gen,
+            session_id=session_id, _manager=self,
+        )
+        self.requests[cid] = cr
+        pos, how = self.router.route(tokens, session_id)
+        if pos is None:
+            cr.error = (
+                "shed by SLO admission: every replica's queue-delay "
+                f"estimate exceeds slo_queue_delay_s="
+                f"{self.serving.slo_queue_delay_s}"
+            )
+            return cid
+        rep = self.replicas[self._routing_pos[pos]]
+        cr.replica = self._routing_pos[pos]
+        delay = rep.queue_delay_s()
+        if self.disaggregated:
+            # prefill pass only: max_new_tokens=1 makes the prefill-final
+            # dispatch (which samples the first output token on device)
+            # the request's LAST step there — the chunked-prefill
+            # boundary — and the held slot keeps its pages alive for
+            # the migration that follows
+            cr.phase = "prefill"
+            cr.rid = rep.rm.submit(
+                tokens, dataclasses.replace(gen, max_new_tokens=1)
+            )
+            rep.rm.hold_on_finish(cr.rid)
+        else:
+            cr.phase = "single"
+            cr.rid = rep.rm.submit(tokens, gen)
+        req = rep.rm.requests[cr.rid]
+        req.profile.replica_id = rep.index
+        req.profile.router_queue_delay_s = delay
+        cr.profile = req.profile
+        return cid
+
+    # convenience alias (c_backend drives both manager kinds identically)
+    def register_request(
+        self,
+        prompt: Union[str, Sequence[int]],
+        gen: Optional[GenerationConfig] = None,
+    ) -> int:
+        return self.submit(prompt, gen)
+
+    # ------------------------------------------------------------------
+    # the drive loop
+
+    def _finish_or_migrate(self, cr: ClusterRequest) -> bool:
+        """Handle one held prefill-pool completion: either the request
+        is ALREADY done (1-token budget, a stop token, or an error — no
+        decode phase owed) and finishes on the prefill replica, or its
+        pages migrate to the least-loaded decode replica. Returns True
+        when state changed."""
+        src = self.replicas[cr.replica]
+        req = src.rm.requests[cr.rid]
+        if req.status not in TERMINAL_STATUSES or req.pipeline_refs:
+            return False
+        if req.status is RequestStatus.ERROR:
+            # unservable on the prefill pool (PR-2 ERROR path) — the
+            # cluster request is terminal with that error
+            src.rm.release_held(cr.rid)
+            cr.phase = "single"
+            return True
+        done = len(req.tokens) >= self.serving.max_sequence_length
+        if req.tokens[req.prompt_len:]:
+            first = req.tokens[-1]
+            stops = set(cr.gen.stop_token_ids)
+            if self.eos_token_id is not None:
+                stops.add(self.eos_token_id)
+            done = done or first in stops or cr.gen.max_new_tokens <= 1
+        if done:
+            src.rm.release_held(cr.rid)
+            cr.phase = "single"
+            return True
+        dst = min(
+            self.decode_pool,
+            key=lambda r: (r.queue_delay_s(), r.load(), r.index),
+        )
+        rid_dst = migrate_request(src, dst, cr.rid, cr.gen,
+                                  stats=self.stats)
+        if rid_dst is None:
+            return False  # decode pool full right now — retry next step
+        src.rm.release_held(cr.rid)
+        cr.replica = self.replicas.index(dst)
+        cr.rid = rid_dst
+        cr.phase = "decode"
+        req = dst.rm.requests[rid_dst]
+        req.profile.replica_id = dst.index
+        cr.profile = req.profile
+        return True
+
+    def _migrate_ready(self) -> bool:
+        progressed = False
+        for cr in self.requests.values():
+            if cr.phase == "prefill" and cr.rid is not None:
+                progressed = self._finish_or_migrate(cr) or progressed
+        return progressed
+
+    def step(self) -> bool:
+        """One cluster step: advance every replica with work, then run
+        any pending prefill→decode migrations. Returns False when no
+        replica has work left."""
+        progressed = False
+        for rep in self.replicas:
+            if rep.has_work():
+                progressed = rep.step() or progressed
+        if self.disaggregated:
+            progressed = self._migrate_ready() or progressed
+        self._step_counter += 1
+        if self._step_counter % 200 == 0:
+            self._log.debug(
+                "%s", self.stats.report([r.rm.stats for r in self.replicas])
+            )
+        return progressed
+
+    def drain(self) -> None:
+        """Flush every replica's pipeline, then settle any migrations
+        those flushes unblocked (a prefill pass whose completion was
+        still in the pipeline hands its pages off here; the adopted
+        decode work itself is driven by later :meth:`step` calls, same
+        as RequestManager.drain never runs new steps)."""
+        for rep in self.replicas:
+            rep.drain()
+        if self.disaggregated:
+            self._migrate_ready()
+
+    # ------------------------------------------------------------------
+    # results
+
+    def cluster_stats(self) -> Dict[str, object]:
+        """ClusterStats snapshot over the live per-replica stats."""
+        return self.stats.snapshot([r.rm.stats for r in self.replicas])
+
+    def check_no_leaks(self) -> None:
+        for rep in self.replicas:
+            rep.check_no_leaks()
+
+    def result(self, cid: int) -> GenerationResult:
+        cr = self.requests[cid]
+        if cr.rid is None:  # shed at the router
+            return GenerationResult(
+                request_id=cid,
+                prompt=cr.prompt_text,
+                input_tokens=list(cr.tokens),
+                output_tokens=[],
+                output_text="",
+                profile=cr.profile,
+                error=cr.error,
+            )
+        res = self.replicas[cr.replica].rm.result(cr.rid)
+        return dataclasses.replace(res, request_id=cid)
+
+    def _terminal(self, cid: int) -> bool:
+        return self.requests[cid].status in TERMINAL_STATUSES
+
+    def generate(
+        self,
+        prompts: Union[str, Sequence[Union[str, Sequence[int]]]],
+        gen: Optional[GenerationConfig] = None,
+        max_new_tokens: Optional[int] = None,
+        session_ids: Optional[Sequence[object]] = None,
+    ) -> List[GenerationResult]:
+        """Blocking generate across the cluster (router-placed)."""
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        cids = [
+            self.submit(
+                p, gen, max_new_tokens,
+                session_id=session_ids[i] if session_ids else None,
+            )
+            for i, p in enumerate(prompts)
+        ]
+        while any(not self._terminal(c) for c in cids):
+            if not self.step():
+                break
+        self.drain()
+        return [self.result(c) for c in cids]
+
+    def generate_stream(
+        self,
+        prompts: Union[str, Sequence[Union[str, Sequence[int]]]],
+        gen: Optional[GenerationConfig] = None,
+        max_new_tokens: Optional[int] = None,
+        session_ids: Optional[Sequence[object]] = None,
+    ) -> Iterator[StreamEvent]:
+        """Streaming generate across the cluster: one StreamEvent per
+        drained token (``request_id`` is the CLUSTER id) + a terminal
+        event per request (``error`` set for sheds/failures). Token
+        counts are monotone across a migration — the first output token
+        is visible on both sides of the hand-off, so nothing is dropped
+        or re-sent."""
+        if isinstance(prompts, str):
+            prompts = [prompts]
+        cids = [
+            self.submit(
+                p, gen, max_new_tokens,
+                session_id=session_ids[i] if session_ids else None,
+            )
+            for i, p in enumerate(prompts)
+        ]
+        sent = {c: 0 for c in cids}
+        finished: set = set()
+
+        def drain_events():
+            for c in cids:
+                if c in finished:
+                    continue
+                cr = self.requests[c]
+                out = cr.output_tokens
+                while sent[c] < len(out):
+                    tok = out[sent[c]]
+                    sent[c] += 1
+                    yield StreamEvent(c, int(tok))
+                if self._terminal(c):
+                    finished.add(c)
+                    err = cr.error
+                    if err is None and cr.rid is not None:
+                        home = self.replicas[cr.replica].rm
+                        err = home.requests[cr.rid].error
+                    yield StreamEvent(c, None, done=True, error=err)
+
+        while len(finished) < len(cids):
+            progressed = self.step()
+            yield from drain_events()
+            if not progressed and len(finished) < len(cids):
+                self.drain()
+                yield from drain_events()
+                if len(finished) < len(cids):
+                    break  # nothing schedulable remains — avoid spinning
+        self.drain()
+        yield from drain_events()

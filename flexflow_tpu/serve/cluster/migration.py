@@ -1,0 +1,107 @@
+"""Prefill→decode KV page migration (disaggregated serving).
+
+Under disaggregation (``ServingConfig.prefill_replicas`` /
+``decode_replicas``) a request prefills on a prefill-pool replica and
+decodes on a decode-pool replica. The hand-off ships the PAGES, not the
+prompt: re-prefilling on the decode side would cost the whole prompt's
+compute again, while the prefilled K/V already exists page-granular in
+the source pool (the Ragged Paged Attention layout is exactly what
+makes this tractable — PAPERS.md).
+
+The hand-off point is the chunked-prefill boundary from PR 2: the
+prefill-final mixed-step dispatch writes the last prompt lines AND
+samples the first output token on device, so the source replica runs
+the request with ``max_new_tokens=1`` — its completion IS the boundary
+— and what migrates is (pages covering lines ``[0, prompt_len)``) +
+(the first sampled token). The destination adopts the request straight
+into DECODING (``RequestManager.adopt_prefilled``) and its next step is
+bit-for-bit the step the source would have run.
+
+Byte-exactness: pages move through the PR-7 spill-tier hooks —
+``engine.fetch_page`` (one jitted gather per page, ``gather_page_kv``,
+async D2H copies) then ``engine.upload_page`` (``scatter_page_kv``,
+async H2D) — which round-trip codes, quantized scale rows and
+generic-decoder position lines exactly (tests/test_kv_hierarchy.py).
+Quantized pools need no special casing: a partial tail page's scale
+rows migrate with it, so rescale-on-growth on the destination continues
+the same scale history the source would have (the offset-0-reset
+guarantee), keeping disaggregated generation BITWISE identical to
+single-replica over fp, int8 and int4 pools (tests/test_cluster.py).
+
+The harvest between gather and upload is a BLOCKING sync — the one
+deliberate flush point of the hand-off. It runs at the prefill→decode
+boundary, outside every decode loop (the decode replica has not even
+seen the request yet; the source replica's pipeline is already drained
+because the request completed), which is why the FF107 suppression
+below is a reviewed decision and not an accident.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...logging_utils import get_logger
+from ...metrics import ClusterStats
+from ..request_manager import RequestStatus
+
+_log = get_logger("serve")
+
+
+def migrate_request(
+    src,
+    dst,
+    rid: int,
+    gen,
+    *,
+    stats: Optional[ClusterStats] = None,
+) -> Optional[int]:
+    """Move a prefilled request from replica ``src`` to replica ``dst``.
+
+    ``rid`` must be COMPLETED on ``src`` (the ``max_new_tokens=1``
+    prefill pass) with its slot HELD (``hold_on_finish``) and no
+    dispatches in flight. ``gen`` is the request's ORIGINAL generation
+    config (the source ran a 1-token override). Returns the request id
+    on ``dst`` — adopted into DECODING with the migrated pages — or
+    None when ``dst`` has no slot/pages right now (nothing moved; the
+    caller retries later; the source keeps holding).
+    """
+    req = src.rm.requests[rid]
+    assert req.status is RequestStatus.COMPLETED, (
+        f"migrating request {rid} in state {req.status}"
+    )
+    assert req.pipeline_refs == 0, "migration with dispatches in flight"
+    assert req.slot >= 0, "migration source slot already released"
+    src_eng, dst_eng = src.engine, dst.engine
+    assert src_eng.pager.page_size == dst_eng.pager.page_size, (
+        "prefill and decode pools disagree on page_size"
+    )
+    prompt_len = req.prompt_len
+    rid_dst = dst.rm.adopt_prefilled(
+        req.tokens, prompt_len, gen,
+        profile=req.profile, prompt_text=req.prompt,
+    )
+    if rid_dst is None:
+        return None
+    n_pages = src_eng.pager.pages_for(prompt_len)
+    src_row = src_eng.pager.table[req.slot]
+    dst_row = dst_eng.pager.table[dst.rm.requests[rid_dst].slot]
+    # start every page's async D2H gather before the one blocking
+    # harvest, then upload (async H2D, ordered before any dst step that
+    # reads the pages)
+    handles = [src_eng.fetch_page(int(src_row[j])) for j in range(n_pages)]
+    import jax
+
+    # ffcheck: disable=FF107 -- migration flush point: the prefill→decode hand-off harvests its page gathers in ONE blocking sync at the chunked-prefill boundary — the source pipeline is already drained (request completed) and the destination has not started the request, so no decode step anywhere waits on this transfer
+    values = jax.device_get(handles)
+    for j in range(n_pages):
+        dst_eng.upload_page(int(dst_row[j]), values[j])
+    bytes_moved = dst_eng.page_host_bytes() * n_pages
+    if stats is not None:
+        stats.migrations += 1
+        stats.migrated_pages += n_pages
+        stats.migrated_bytes += bytes_moved
+    _log.debug(
+        "migrate: request %d replica %d -> %d (%d pages, %d bytes, "
+        "prompt %d tokens)",
+        rid, src.index, dst.index, n_pages, bytes_moved, prompt_len,
+    )
+    return rid_dst

@@ -1,0 +1,192 @@
+"""Replica — one engine + scheduler pair inside a cluster.
+
+A :class:`Replica` wraps one :class:`InferenceEngine` (its own mesh, its
+own KV pool, its own prefix-cache radix tree) plus the
+:class:`RequestManager` that drives it, and exposes exactly the surface
+the front-end router needs to place work:
+
+* ``prefix_score(tokens)`` — how many leading prompt tokens this
+  replica's radix tree already holds (a READ-ONLY probe,
+  ``PrefixCache.match_len``: scoring N replicas must not touch the
+  N-1 losers' LRU state);
+* ``queue_delay_s()`` — an admission-delay estimate: backlog tokens
+  (undispatched prompt tokens of queued + prefilling requests, plus
+  one token per decode row) over the replica's OBSERVED token rate
+  (an EMA over ``SchedulerStats`` deltas, updated by :meth:`step`).
+  Optimistically 0 before any rate is observed — SLO shedding
+  (``ServingConfig.slo_queue_delay_s``) only ever acts on measured
+  load, never on a cold start;
+* ``load()`` — queued + active requests, the least-loaded tiebreak.
+
+Replicas here are IN-PROCESS: on this CPU box every replica's mesh maps
+onto the same device, which is what makes N-replica runs testable and
+bit-exact-checkable anywhere. The API is deliberately shaped so a later
+multi-host deployment can swap the in-process engine for a per-host
+process behind the same five methods (score/delay/load/step/drain) —
+the router never reaches past them.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional, Sequence
+
+from ...logging_utils import get_logger
+from ..engine import InferenceEngine, ServingConfig
+from ..request_manager import TERMINAL_STATUSES, RequestManager, RequestStatus
+
+#: Pool roles under disaggregated serving (ServingConfig.prefill_replicas
+#: / decode_replicas). "mixed" replicas serve both phases.
+ROLES = ("mixed", "prefill", "decode")
+
+
+class Replica:
+    """One cluster member: engine + request manager + routing telemetry."""
+
+    def __init__(self, index: int, rm: RequestManager, role: str = "mixed"):
+        if role not in ROLES:
+            raise ValueError(f"unknown replica role {role!r} "
+                             f"(expected one of {ROLES})")
+        self.index = int(index)
+        self.rm = rm
+        self.role = role
+        # token-rate EMA (tokens/sec the scheduler actually retired) —
+        # the denominator of the queue-delay estimate
+        self._rate = 0.0
+        self._last_tokens = 0
+        self._last_t: Optional[float] = None
+        self._log = get_logger("serve")
+
+    @classmethod
+    def build(
+        cls,
+        index: int,
+        model: Any,
+        cfg: Any,
+        params: Any,
+        serving: ServingConfig,
+        *,
+        role: str = "mixed",
+        mesh=None,
+        devices: Optional[Sequence[Any]] = None,
+        tokenizer: Any = None,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> "Replica":
+        """Construct a replica with its OWN mesh (and so its own TP
+        group) over ``devices``. Params are shared by reference across
+        replicas — on one host that is free; per-host processes would
+        each load their own copy behind the same constructor."""
+        if mesh is None:
+            import jax
+
+            from ...core.mesh import MachineSpec
+
+            devices = list(devices or jax.devices()[:1])
+            mesh = MachineSpec().make_mesh(devices)
+        engine = InferenceEngine(model, cfg, params, serving, mesh)
+        rm = RequestManager(
+            engine, tokenizer=tokenizer, eos_token_id=eos_token_id,
+            seed=seed,
+        )
+        return cls(index, rm, role=role)
+
+    # ------------------------------------------------------------------
+    # router-facing telemetry
+
+    @property
+    def engine(self) -> InferenceEngine:
+        return self.rm.engine
+
+    @property
+    def stats(self):
+        return self.rm.stats
+
+    def prefix_score(self, tokens: Sequence[int]) -> int:
+        """Leading prompt tokens this replica's radix tree would serve
+        from cache (0 without prefix caching) — read-only."""
+        pc = self.rm.prefix_cache
+        if pc is None or len(tokens) < 2:
+            return 0
+        return pc.match_len(tokens)
+
+    def active_requests(self) -> int:
+        return sum(
+            1 for r in self.rm.requests.values()
+            if r.status not in TERMINAL_STATUSES
+        )
+
+    def load(self) -> float:
+        """Least-loaded tiebreak: live requests (queued + in slots)."""
+        return float(self.active_requests())
+
+    def backlog_tokens(self) -> int:
+        """Tokens of work already accepted but not yet dispatched:
+        undispatched prompt tokens (queued requests count their whole
+        prompt) plus one pending token per decode row."""
+        n = 0
+        for req in self.rm.requests.values():
+            if req.status in TERMINAL_STATUSES:
+                continue
+            if req.status is RequestStatus.DECODING:
+                n += 1
+            else:  # PENDING / PREFILLING
+                n += max(0, req.prompt_len - req.n_sched)
+        return n
+
+    def token_rate(self) -> float:
+        """EMA tokens/sec this replica's scheduler has been retiring
+        (prefill + decode tokens dispatched, from SchedulerStats)."""
+        return self._rate
+
+    def queue_delay_s(self) -> float:
+        """Estimated seconds before NEW work would start executing:
+        backlog over the observed token rate. 0 while no rate has been
+        observed (cold replicas are never shed on a guess)."""
+        if self._rate <= 0.0:
+            return 0.0
+        return self.backlog_tokens() / self._rate
+
+    # ------------------------------------------------------------------
+    # scheduling passthrough
+
+    def has_work(self) -> bool:
+        return bool(self.rm.pending) or self.active_requests() > 0 or bool(
+            self.rm._inflight
+        )
+
+    def step(self) -> bool:
+        """One scheduler step + a rate-EMA update from the stats delta."""
+        progressed = self.rm.step()
+        now = time.perf_counter()
+        done = self.rm.stats.prefill_tokens + self.rm.stats.decode_tokens
+        if self._last_t is not None:
+            dt = now - self._last_t
+            delta = done - self._last_tokens
+            if dt > 0 and delta > 0:
+                inst = delta / dt
+                self._rate = (
+                    inst if self._rate == 0.0
+                    else 0.8 * self._rate + 0.2 * inst
+                )
+        self._last_t = now
+        self._last_tokens = done
+        return progressed
+
+    def drain(self) -> None:
+        self.rm.drain()
+
+    # ------------------------------------------------------------------
+    # audits
+
+    def check_no_leaks(self) -> None:
+        """Page-pool refcount audit for THIS replica (paged layout):
+        slot tables + this replica's own radix tree must account for
+        every reference — run by tests after migrations to prove no
+        page leaked on either side of a hand-off."""
+        pager = getattr(self.engine, "pager", None)
+        if pager is None:
+            return
+        external = None
+        if self.rm.prefix_cache is not None:
+            external = self.rm.prefix_cache.page_refs()
+        pager.check_no_leaks(external=external)

@@ -1,0 +1,165 @@
+"""Router — prefix-aware placement, session affinity, SLO admission.
+
+The cluster front-end's placement brain. Given the candidate replicas
+(the whole cluster, or the prefill pool under disaggregation), each
+:meth:`route` call answers "which replica takes this prompt — or do we
+shed it":
+
+* **prefix** (default): score every candidate by how many leading
+  prompt tokens its radix tree already holds
+  (``Replica.prefix_score`` → ``PrefixCache.match_len``, a read-only
+  probe) and place on the longest match — the request then prefills
+  only its uncached suffix, and same-prefix traffic naturally
+  PARTITIONS across replicas instead of duplicating every prefix
+  family into every replica's limited tree. A universal miss falls
+  back to least-loaded (which is also what seeds the partition: the
+  first request of a new prefix family lands on the coldest replica,
+  and every later relative follows it by match).
+* **round_robin**: cycle the candidates — the ablation baseline.
+* **least_loaded**: smallest queue-delay estimate (ties: fewest live
+  requests, then lowest index for determinism).
+
+**Session affinity** overrides the policy: a ``session_id`` seen before
+routes to the replica that served it last (multi-turn chat keeps
+hitting the replica whose tree holds the transcript). Affinity is
+recorded on every placement, hit or miss.
+
+**SLO admission** (``ServingConfig.slo_queue_delay_s``): when every
+candidate's queue-delay estimate exceeds the bound, the request is
+SHED — :meth:`route` returns ``(None, "shed")`` and the ClusterManager
+surfaces it as ``RequestStatus.ERROR`` / ``GenerationResult.error``,
+the PR-2 unservable-request contract (terminal, never a hang). With
+room anywhere, the delay bound also REDIRECTS: an over-SLO preferred
+replica loses the request to the best under-SLO one.
+
+Counters land in :class:`flexflow_tpu.metrics.ClusterStats` through the
+callable-stats pattern (a zero-arg callable, so a bench swapping the
+stats object mid-run keeps counting).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from ...logging_utils import get_logger
+from ...metrics import ClusterStats
+
+POLICIES = ("prefix", "round_robin", "least_loaded")
+
+
+class Router:
+    """Placement over ``replicas`` (Replica-shaped: ``prefix_score`` /
+    ``queue_delay_s`` / ``load`` / ``index``). ``stats`` is a
+    ClusterStats or a zero-arg callable returning one."""
+
+    def __init__(
+        self,
+        replicas: Sequence,
+        policy: str = "prefix",
+        *,
+        slo_queue_delay_s: Optional[float] = None,
+        stats=None,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown router_policy {policy!r} (expected one of "
+                f"{POLICIES})"
+            )
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self.policy = policy
+        self.slo_queue_delay_s = slo_queue_delay_s
+        self._stats_src = stats
+        self._rr_next = 0
+        self.sessions: Dict[object, int] = {}  # session_id -> replica pos
+        self._log = get_logger("serve")
+
+    @property
+    def stats(self) -> Optional[ClusterStats]:
+        return (
+            self._stats_src() if callable(self._stats_src)
+            else self._stats_src
+        )
+
+    # ------------------------------------------------------------------
+
+    def _under_slo(self, pos: int) -> bool:
+        if self.slo_queue_delay_s is None:
+            return True
+        return self.replicas[pos].queue_delay_s() <= self.slo_queue_delay_s
+
+    def _least_loaded(self, positions: Sequence[int]) -> int:
+        return min(
+            positions,
+            key=lambda p: (
+                self.replicas[p].queue_delay_s(),
+                self.replicas[p].load(),
+                p,
+            ),
+        )
+
+    def route(
+        self,
+        tokens: Sequence[int],
+        session_id: Optional[object] = None,
+    ) -> Tuple[Optional[int], str]:
+        """Place one prompt. Returns ``(position, how)`` — a position
+        into ``self.replicas`` and the decision kind ("affinity",
+        "prefix", "round_robin", "least_loaded") — or ``(None, "shed")``
+        when SLO admission rejects it. Records the placement (and the
+        session) in the stats."""
+        eligible = [
+            p for p in range(len(self.replicas)) if self._under_slo(p)
+        ]
+        st = self.stats
+        if not eligible:
+            if st is not None:
+                st.sheds += 1
+            self._log.debug(
+                "router shed: every replica over slo_queue_delay_s=%s "
+                "(delays: %s)",
+                self.slo_queue_delay_s,
+                [round(r.queue_delay_s(), 3) for r in self.replicas],
+            )
+            return None, "shed"
+
+        pos, how = None, self.policy
+        if session_id is not None and session_id in self.sessions:
+            cand = self.sessions[session_id]
+            if cand in eligible:
+                pos, how = cand, "affinity"
+        if pos is None:
+            if self.policy == "prefix":
+                scored = [(self.replicas[p].prefix_score(tokens), p)
+                          for p in eligible]
+                best_score = max(s for s, _ in scored)
+                if best_score > 0:
+                    ties = [p for s, p in scored if s == best_score]
+                    pos = (
+                        ties[0] if len(ties) == 1
+                        else self._least_loaded(ties)
+                    )
+                else:
+                    pos, how = self._least_loaded(eligible), "least_loaded"
+            elif self.policy == "round_robin":
+                # next eligible at or after the cursor, cursor advances
+                # past the chosen one — a full cycle over a healthy
+                # cluster touches every replica exactly once
+                n = len(self.replicas)
+                for off in range(n):
+                    cand = (self._rr_next + off) % n
+                    if cand in eligible:
+                        pos = cand
+                        self._rr_next = (cand + 1) % n
+                        break
+            else:  # least_loaded
+                pos = self._least_loaded(eligible)
+        if session_id is not None:
+            self.sessions[session_id] = pos
+        if st is not None:
+            st.record_placement(how)
+        self._log.debug(
+            "router place: replica %d via %s (prompt %d tokens)",
+            self.replicas[pos].index, how, len(tokens),
+        )
+        return pos, how

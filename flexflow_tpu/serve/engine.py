@@ -145,6 +145,40 @@ class ServingConfig:
     # Off by default; () compiles exactly the pre-fusion step programs
     # under exactly the pre-fusion step keys.
     fused_decode: Tuple[str, ...] = ()
+    # Cluster serving (serve/cluster/): one process drives this many
+    # engine replicas — each its own mesh and KV pool — behind a
+    # front-end Router (prefix-cache-aware placement, session affinity,
+    # SLO-aware load shedding). 1 (default) = the single-engine path,
+    # byte-for-byte unchanged. The per-replica engine is cluster-blind:
+    # every replica is built with this same ServingConfig and the
+    # cluster fields only steer the ClusterManager above them.
+    replicas: int = 1
+    # Placement policy of the front-end router: "prefix" routes to the
+    # replica whose radix tree holds the longest match on the incoming
+    # prompt (falling back to least-loaded on a universal miss),
+    # "round_robin" cycles, "least_loaded" picks the smallest
+    # queue-delay estimate. Session affinity (submit(session_id=...))
+    # overrides the policy for multi-turn chat whichever is chosen.
+    router_policy: str = "prefix"
+    # Disaggregated prefill/decode pools: the first ``prefill_replicas``
+    # replicas only prefill, the remaining ``decode_replicas`` only
+    # decode — a request prefills on a prefill-pool replica and its KV
+    # pages MIGRATE to a decode-pool replica at the chunked-prefill
+    # boundary (serve/cluster/migration.py: gather_page_kv →
+    # scatter_page_kv, byte-exact, so disaggregated generation is
+    # bitwise the single-replica path's). Both 0 (default) = every
+    # replica serves both phases; when set they must sum to
+    # ``replicas`` and the layout must be paged (pages are the unit
+    # being shipped).
+    prefill_replicas: int = 0
+    decode_replicas: int = 0
+    # SLO-aware admission: shed a request at the router when EVERY
+    # eligible replica's queue-delay estimate (backlog tokens over its
+    # observed token rate, serve/cluster/replica.py) exceeds this many
+    # seconds. A shed surfaces as RequestStatus.ERROR /
+    # GenerationResult.error — the PR-2 contract: terminal, never a
+    # hang. None (default) = never shed.
+    slo_queue_delay_s: Optional[float] = None
     # Runtime hazard sanitizers (flexflow_tpu/analysis/): "retrace" — a
     # strict RetraceGuard on the engine's jit chokepoint that raises on
     # any step recompile after its first compile (the shape/dtype-drift
@@ -156,6 +190,52 @@ class ServingConfig:
     # them on, and FF_SANITIZERS=retrace,donation enables them from the
     # environment without touching code.
     sanitizers: Tuple[str, ...] = ()
+
+    def validate_cluster(self) -> None:
+        """Fail-fast validation of the cluster fields — called from
+        engine construction (every replica carries this config, so a
+        bad value dies before any replica exists) AND from
+        ClusterManager, the consumer (cluster/manager.py), mirroring
+        how ``kv_quant``/``fused_decode`` fail at construction rather
+        than mid-serve."""
+        if self.replicas < 1:
+            raise ValueError(
+                f"replicas must be >= 1 (got {self.replicas})"
+            )
+        if self.router_policy not in ("prefix", "round_robin",
+                                      "least_loaded"):
+            raise ValueError(
+                f"unknown router_policy {self.router_policy!r} (expected "
+                "'prefix', 'round_robin' or 'least_loaded')"
+            )
+        if (self.prefill_replicas < 0) or (self.decode_replicas < 0):
+            raise ValueError("prefill_replicas/decode_replicas must be >= 0")
+        if bool(self.prefill_replicas) != bool(self.decode_replicas):
+            raise ValueError(
+                "disaggregated serving needs BOTH pools: set "
+                "prefill_replicas and decode_replicas together (got "
+                f"prefill={self.prefill_replicas}, "
+                f"decode={self.decode_replicas})"
+            )
+        if self.prefill_replicas:
+            if self.prefill_replicas + self.decode_replicas != self.replicas:
+                raise ValueError(
+                    f"prefill_replicas ({self.prefill_replicas}) + "
+                    f"decode_replicas ({self.decode_replicas}) must equal "
+                    f"replicas ({self.replicas})"
+                )
+            if self.kv_layout != "paged":
+                raise ValueError(
+                    "disaggregated prefill/decode pools require "
+                    "kv_layout='paged' — prefill→decode migration ships "
+                    "KV PAGES (gather_page_kv/scatter_page_kv), which "
+                    "the dense layout does not have"
+                )
+        if self.slo_queue_delay_s is not None and self.slo_queue_delay_s < 0:
+            raise ValueError(
+                f"slo_queue_delay_s must be >= 0 (got "
+                f"{self.slo_queue_delay_s})"
+            )
 
     @property
     def cache_len(self) -> int:
@@ -253,6 +333,9 @@ class InferenceEngine:
                     f"unknown sanitizer {name!r} (expected 'retrace', "
                     "'retrace-warn' or 'donation')"
                 )
+        # Cluster fields (serve/cluster/) fail here, at the first
+        # replica's engine construction, like kv_quant/fused_decode do.
+        self.serving.validate_cluster()
         self.paged = self.serving.kv_layout == "paged"
         if self.serving.kv_layout not in ("dense", "paged"):
             raise ValueError(

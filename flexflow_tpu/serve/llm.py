@@ -149,6 +149,24 @@ class LLM:
         self.params = self._place_params(
             self.family, self.cfg, self.params, pipelined, quantization, offload
         )
+        if serving.replicas > 1 or serving.prefill_replicas:
+            # Cluster serving (serve/cluster/): N engine replicas behind
+            # the prefix-aware router. Not composed with SpecInfer yet —
+            # the SSM pools would need per-replica mirrors.
+            if ssms:
+                raise ValueError(
+                    "cluster serving (replicas > 1 / disaggregated "
+                    "pools) is not composed with SpecInfer ssms yet"
+                )
+            from .cluster import ClusterManager
+
+            self.rm = ClusterManager.build(
+                self.family, self.cfg, self.params, serving,
+                tokenizer=self.tokenizer, eos_token_id=eos_token_id,
+                seed=seed,
+            )
+            self.engine = self.rm.replicas[0].engine
+            return
         self.engine = InferenceEngine(
             self.family, self.cfg, self.params, serving, self.mesh
         )

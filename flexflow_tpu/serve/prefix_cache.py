@@ -223,24 +223,30 @@ class PrefixCache:
     # ------------------------------------------------------------------
     # lookup
 
-    def _walk(self, tokens: Sequence[int]) -> Tuple[List[_Node], int]:
+    def _walk(
+        self, tokens: Sequence[int], *, peek: bool = False
+    ) -> Tuple[List[_Node], int]:
         """Longest cached prefix of ``tokens`` as tree NODES (device- or
         host-resident) plus the matched token count. Capped at
         ``len(tokens) - 1`` — the last prompt token is always
         recomputed so its logit exists to sample the first output from.
         Every matched node except possibly the last is a full
         page-sized block; the last may be a partial overlap (the new
-        prompt diverges or ends inside it)."""
+        prompt diverges or ends inside it). ``peek`` leaves the LRU
+        ticks untouched — a read-only probe (the cluster router scores
+        every replica's tree but places on at most one; a scoring walk
+        must not make a losing replica's blocks look recently used)."""
         limit = len(tokens) - 1
         node, nodes, matched = self._root, [], 0
-        tick = next(self._tick)
+        tick = None if peek else next(self._tick)
         ps = self.page_size
         while matched < limit:
             rem = limit - matched
             if rem >= ps:
                 child = node.children.get(tuple(tokens[matched:matched + ps]))
                 if child is not None:
-                    child.last_used = tick
+                    if tick is not None:
+                        child.last_used = tick
                     nodes.append(child)
                     matched += ps
                     node = child
@@ -257,7 +263,8 @@ class PrefixCache:
                 if n > best_len:
                     best, best_len = cand, n
             if best is not None:
-                best.last_used = tick
+                if tick is not None:
+                    best.last_used = tick
                 nodes.append(best)
                 matched += best_len
             break
@@ -270,6 +277,14 @@ class PrefixCache:
         before splicing) and the matched token count."""
         nodes, matched = self._walk(tokens)
         return [n.page for n in nodes], matched
+
+    def match_len(self, tokens: Sequence[int]) -> int:
+        """Read-only probe: how many leading tokens a fresh admission
+        of ``tokens`` would find cached (device OR host tier), WITHOUT
+        touching LRU state. The cluster router's prefix-aware placement
+        score (serve/cluster/router.py)."""
+        _, matched = self._walk(tokens, peek=True)
+        return matched
 
     # ------------------------------------------------------------------
     # admission: splice + COW

@@ -137,6 +137,13 @@ class RequestManager:
         self.requests: Dict[int, Request] = {}
         self.pending: List[int] = []
         self.slots: List[Optional[int]] = [None] * engine.num_slots
+        # Request ids whose slot + pages must SURVIVE completion: the
+        # cluster's prefill→decode migration (serve/cluster/) reads the
+        # finished prefill's pages out of the pool after the request
+        # completes — releasing them at _finish would hand the pages to
+        # the next admission before they were shipped. The holder calls
+        # :meth:`release_held` once the pages have migrated.
+        self.hold_finished: set = set()
         self._next_id = 1000000  # reference starts guids at 1000000
         self._admit_counter = 0
         self._key = jax.random.PRNGKey(seed)
@@ -251,6 +258,79 @@ class RequestManager:
         if max_new_tokens is not None:
             gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
         return self.register_request(prompt, gen)
+
+    # ------------------------------------------------------------------
+    # cluster hooks (serve/cluster/): hold-for-migration + adoption of
+    # an externally prefilled request
+
+    def hold_on_finish(self, rid: int) -> None:
+        """Mark ``rid`` so completion does NOT release its slot/pages —
+        the prefill→decode migration reads them from the pool after the
+        request finishes. Pair with :meth:`release_held`."""
+        self.hold_finished.add(rid)
+
+    def release_held(self, rid: int) -> None:
+        """Release the slot + pages of a finished held request (the
+        migration shipped its pages, or the hold is abandoned)."""
+        self.hold_finished.discard(rid)
+        req = self.requests.get(rid)
+        if (
+            req is not None
+            and req.status in TERMINAL_STATUSES
+            and req.slot >= 0
+            and req.pipeline_refs == 0
+        ):
+            self._release_slot(req)
+
+    def adopt_prefilled(
+        self,
+        tokens: Sequence[int],
+        prompt_len: int,
+        gen: GenerationConfig,
+        *,
+        profile: Optional[ProfileInfo] = None,
+        prompt_text: str = "",
+    ) -> Optional[int]:
+        """Admit an EXTERNALLY prefilled request straight into DECODING
+        (cluster prefill→decode migration, serve/cluster/migration.py):
+        ``tokens`` is prompt + the first sampled output token, and cache
+        lines [0, prompt_len) are about to be filled by page uploads
+        into the slot this method allocates. Returns the new request id,
+        or None when no slot (or no pages) can be had right now — the
+        caller keeps the request on its source replica and retries.
+        All-or-nothing: a page-allocation failure rolls the slot back."""
+        assert len(tokens) > prompt_len, "adopt needs the first output token"
+        slot = next(
+            (i for i, occ in enumerate(self.slots) if occ is None), None
+        )
+        if slot is None:
+            return None
+        if self._paged:
+            for eng in self._engines():
+                if not eng.pager.ensure(slot, prompt_len):
+                    self._release_pages(slot)
+                    return None
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(
+            request_id=rid,
+            prompt=prompt_text,
+            tokens=[int(t) for t in tokens],
+            prompt_len=int(prompt_len),
+            gen=gen,
+        )
+        req.slot = slot
+        req.status = RequestStatus.DECODING
+        req.n_cached = int(prompt_len)
+        req.n_sched = int(prompt_len)
+        req.admit_seq = self._admit_counter
+        self._admit_counter += 1
+        if profile is not None:
+            req.profile = profile
+        self.requests[rid] = req
+        self.slots[slot] = rid
+        self.stats.admitted += 1
+        return rid
 
     # ------------------------------------------------------------------
     # paged-KV page management (serve/paging.py PageAllocator; one
@@ -528,8 +608,14 @@ class RequestManager:
         # release to the flush that drains the last of them: those
         # dispatches keep writing (garbage) K/V through the page table
         # they were launched with, so reallocating the pages or the slot
-        # now would corrupt whoever received them.
-        if req.slot >= 0 and req.pipeline_refs == 0:
+        # now would corrupt whoever received them. Held requests
+        # (cluster migration sources) keep slot + pages until
+        # :meth:`release_held`.
+        if (
+            req.slot >= 0
+            and req.pipeline_refs == 0
+            and req.request_id not in self.hold_finished
+        ):
             self._release_slot(req)
         if self.output_file and error is None:
             self._write_output_record(req)
@@ -858,6 +944,7 @@ class RequestManager:
                 req.status in TERMINAL_STATUSES
                 and req.slot == slot
                 and req.pipeline_refs == 0
+                and req.request_id not in self.hold_finished
             ):
                 self._release_slot(req)
         if self.prefix_cache is not None:
@@ -893,7 +980,11 @@ class RequestManager:
                 continue
             req = self.requests[rid]
             if req.status in TERMINAL_STATUSES:
-                return True
+                # held slots (cluster migration sources) only leave via
+                # release_held — flushing cannot reclaim them
+                if rid not in self.hold_finished:
+                    return True
+                continue
             if (
                 req.status is RequestStatus.DECODING
                 and self._sched_exhausted(req)

@@ -49,6 +49,24 @@ def test_cli_serve_spec_reference_style_flags(tmp_path):
     assert "steps=" in r.stdout
 
 
+def test_cli_serve_cluster_flags():
+    """--replicas/--router-policy/--prefill-replicas/--decode-replicas
+    drive the cluster path end to end (serve/cluster/): disaggregated
+    1 prefill + 1 decode over the tiny random model."""
+    r = _run([
+        "serve", "--max-new-tokens", "6",
+        "--kv-layout", "paged", "--page-size", "16",
+        "--replicas", "2", "--prefill-replicas", "1",
+        "--decode-replicas", "1", "--router-policy", "prefix",
+    ])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "steps=" in r.stdout
+    # bad cluster configs die at construction with a clear error
+    r = _run(["serve", "--replicas", "2", "--prefill-replicas", "1"])
+    assert r.returncode != 0
+    assert "BOTH pools" in r.stderr
+
+
 def test_cli_search_exports(tmp_path):
     dot = str(tmp_path / "strategy.dot")
     strat = str(tmp_path / "strategy.json")
