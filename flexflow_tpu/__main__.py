@@ -109,6 +109,7 @@ def cmd_serve(args):
         prefill_replicas=args.prefill_replicas,
         decode_replicas=args.decode_replicas,
         slo_queue_delay_s=args.slo_queue_delay_s,
+        migration_queue_budget=args.migration_queue_budget,
     )
     ssms = []
     spec = None
@@ -132,6 +133,16 @@ def cmd_serve(args):
     llm.compile(sc, ssms=ssms, spec=spec,
                 quantization=args.quantization, offload=args.offload,
                 output_file=args.output_file)
+    if args.fault_plan:
+        from .serve.cluster import ClusterManager, FaultPlan
+
+        if not isinstance(llm.rm, ClusterManager):
+            raise SystemExit(
+                "--fault-plan requires a cluster (--replicas > 1 or "
+                "disaggregated pools) — faults inject at the Replica "
+                "surface"
+            )
+        llm.rm.attach_faults(FaultPlan.from_json(args.fault_plan))
     prompts = args.prompt or [[3, 17, 91, 42, 7]]
     gen = GenerationConfig(num_beams=args.num_beams)
     outs = llm.generate(
@@ -269,6 +280,23 @@ def main(argv=None):
                         "GenerationResult.error, never a hang) when "
                         "every replica's queue-delay estimate exceeds "
                         "this many seconds")
+    s.add_argument("--migration-queue-budget", type=int, default=None,
+                   help="disaggregated back-pressure: at most this many "
+                        "finished prefills wait for decode-pool "
+                        "capacity holding their slot + pages; overflow "
+                        "entries release their pages and drain through "
+                        "recompute re-admission on the decode pool's "
+                        "own queue (default: unbounded holds)")
+    s.add_argument("--fault-plan", default=None,
+                   help="deterministic fault injection "
+                        "(serve/cluster/faults.py; requires a cluster): "
+                        "a JSON list of faults, e.g. "
+                        "'[{\"kind\": \"crash\", \"replica\": 1, "
+                        "\"step\": 20}]' — kinds: crash, transient, "
+                        "latency, migration, oom. The same plan replays "
+                        "the same failure scenario bit-for-bit; failed "
+                        "replicas' requests fail over to survivors via "
+                        "recompute re-admission")
     # reference -output-file (request_manager.cc:417-440): append each
     # finished request's latency/steps/token-ids
     s.add_argument("--output-file", "-output-file", default=None)

@@ -63,8 +63,22 @@ HOT_ROOTS = {
     "submit",
     "route",
     "migrate_request",
-    "_migrate_ready",
-    "_finish_or_migrate",
+    "_queue_migrations",
+    "_drain_migration_queue",
+    "_recompute_readmit",
+    # fault tolerance (health/failover/probe): everything that runs
+    # when a replica dies or recovers is ON the drive loop — a blocking
+    # transfer in a failover would stall every healthy replica's decode
+    # exactly when the cluster is degraded
+    "_place",
+    "_on_replica_down",
+    "_run_failovers",
+    "_schedule_failover",
+    "abandon",
+    "on_step",
+    "record_failure",
+    "record_success",
+    "maybe_probe",
 }
 
 # Calls that force a synchronous transfer / device round-trip.

@@ -234,6 +234,30 @@ class ClusterStats:
     migrations: int = 0            # prefill→decode page hand-offs
     migrated_pages: int = 0
     migrated_bytes: int = 0
+    # Fault tolerance (serve/cluster/health.py + manager failover):
+    # step exceptions observed, replica state transitions (DOWN trips /
+    # half-open probes / closed circuits), requests re-admitted off a
+    # dead replica through recompute, total re-admission attempts
+    # (failovers + migration-drain recomputes), and requests that ended
+    # in a terminal error because retries exhausted or no healthy
+    # replica remained (the bounded alternative to a hang).
+    step_faults: int = 0
+    replica_down: int = 0
+    replica_suspect: int = 0
+    probes: int = 0
+    replica_recoveries: int = 0
+    failovers: int = 0
+    retries: int = 0
+    failover_errors: int = 0
+    # Migration back-pressure (ServingConfig.migration_queue_budget):
+    # failed migrate attempts (exceptions, retried with backoff), the
+    # bounded queue's current depth (gauge) and high-water mark, and
+    # held prefills that overflowed the budget and drained through
+    # recompute re-admission instead of parking with their pages.
+    migration_failures: int = 0
+    migration_queue_depth: int = 0
+    migration_queue_peak: int = 0
+    migration_queue_overflows: int = 0
 
     def record_placement(self, how: str) -> None:
         self.placements[how] = self.placements.get(how, 0) + 1
@@ -277,6 +301,18 @@ class ClusterStats:
             "migrations": self.migrations,
             "migrated_pages": self.migrated_pages,
             "migrated_bytes": self.migrated_bytes,
+            "step_faults": self.step_faults,
+            "replica_down": self.replica_down,
+            "replica_suspect": self.replica_suspect,
+            "probes": self.probes,
+            "replica_recoveries": self.replica_recoveries,
+            "failovers": self.failovers,
+            "retries": self.retries,
+            "failover_errors": self.failover_errors,
+            "migration_failures": self.migration_failures,
+            "migration_queue_depth": self.migration_queue_depth,
+            "migration_queue_peak": self.migration_queue_peak,
+            "migration_queue_overflows": self.migration_queue_overflows,
             "replicas": agg,
             "per_replica": per,
         }
@@ -292,6 +328,8 @@ class ClusterStats:
             f"place[{place}] affinity={s['affinity_hits']} "
             f"shed={s['sheds']} migr={s['migrations']} "
             f"migrB={s['migrated_bytes']} "
+            f"faults={s['step_faults']} down={s['replica_down']} "
+            f"failover={s['failovers']} migq={s['migration_queue_depth']} "
             f"pfx_hit_rate={agg.get('prefix_hit_rate', 0.0)} "
             f"adm={agg.get('admitted', 0)} "
             f"preempt={agg.get('preemptions', 0)} "

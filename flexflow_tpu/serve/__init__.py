@@ -12,7 +12,15 @@ from .batch_config import (
     GenerationResult,
     StreamEvent,
 )
-from .cluster import ClusterManager, Replica, Router
+from .cluster import (
+    ClusterManager,
+    Fault,
+    FaultPlan,
+    HealthConfig,
+    HealthState,
+    Replica,
+    Router,
+)
 from .engine import InferenceEngine, ServingConfig
 from .llm import LLM, SSM, detect_family
 from .paging import PageAllocator
@@ -24,6 +32,10 @@ from .specinfer import SpecConfig, SpecInferManager, TokenTree
 __all__ = [
     "BatchConfig",
     "ClusterManager",
+    "Fault",
+    "FaultPlan",
+    "HealthConfig",
+    "HealthState",
     "Replica",
     "Router",
     "GenerationConfig",

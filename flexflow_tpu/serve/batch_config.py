@@ -129,6 +129,13 @@ class ProfileInfo:
     # figure SLO admission sheds on (ServingConfig.slo_queue_delay_s).
     replica_id: int = -1
     router_queue_delay_s: float = 0.0
+    # Fault tolerance: how many times this request was RE-ADMITTED
+    # (replica death failover or migration-queue recompute drain — each
+    # re-prefills prompt + tokens generated so far, the vLLM-style
+    # recompute path), and the replica that received the most recent
+    # failover re-admission (-1 when the request never moved).
+    retries: int = 0
+    failover_replica_id: int = -1
 
     @property
     def latency_s(self) -> float:

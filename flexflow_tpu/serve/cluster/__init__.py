@@ -24,11 +24,24 @@ construction. Entry points::
     cid = cm.submit(prompt, session_id="chat-7") # non-blocking
     for ev in cm.generate_stream(prompts): ...   # per-token events
 
+Fault tolerance (:mod:`.health` + :mod:`.faults`): every replica runs
+under a per-replica health state machine (HEALTHY → SUSPECT → DOWN →
+PROBING) with a circuit breaker — a DOWN replica leaves routing, its
+in-flight requests re-admit to survivors through recompute (bounded
+retries, terminal ``GenerationResult.error`` past them — never a hang),
+and probe re-admission closes the circuit after exponential backoff.
+Failure scenarios are scripted deterministically with
+:class:`FaultPlan` / :class:`FaultInjector`
+(``ClusterManager.attach_faults``).
+
 Telemetry: :class:`flexflow_tpu.metrics.ClusterStats` (router counters
-+ per-replica SchedulerStats aggregation) via
-``ClusterManager.cluster_stats()``, logged at ``FF_LOG=serve=debug``;
-per-request ``ProfileInfo.replica_id`` / ``router_queue_delay_s``.
++ failover/health/migration-queue counters + per-replica SchedulerStats
+aggregation) via ``ClusterManager.cluster_stats()``, logged at
+``FF_LOG=serve=debug``; per-request ``ProfileInfo.replica_id`` /
+``router_queue_delay_s`` / ``retries`` / ``failover_replica_id``.
 """
+from .faults import Fault, FaultInjector, FaultPlan, InjectedFault
+from .health import HealthConfig, HealthMonitor, HealthState, ReplicaHealth
 from .manager import ClusterManager, ClusterRequest
 from .migration import migrate_request
 from .replica import Replica
@@ -41,4 +54,12 @@ __all__ = [
     "Router",
     "POLICIES",
     "migrate_request",
+    "HealthConfig",
+    "HealthMonitor",
+    "HealthState",
+    "ReplicaHealth",
+    "Fault",
+    "FaultPlan",
+    "FaultInjector",
+    "InjectedFault",
 ]

@@ -10,11 +10,11 @@ disaggregation — the prefill→decode page migrations.
 
 Request lifecycle::
 
-    submit ──router──┬── shed ──────────────→ ERROR (terminal, PR-2 contract)
+    submit ──router──┬── shed / all DOWN ──→ ERROR (terminal, PR-2 contract)
                      ├── mixed replica ─────→ prefill+decode there ("single")
                      └── prefill replica ───→ prefill, max_new_tokens=1
                              │ held slot        ("prefill")
-                             └─ COMPLETED → migrate pages → decode replica
+                             └─ COMPLETED → migration queue → decode replica
                                              adopts into DECODING ("decode")
 
 Sheds come from SLO admission (``ServingConfig.slo_queue_delay_s``):
@@ -22,15 +22,42 @@ they surface as ``GenerationResult.error`` exactly like the PR-2
 unservable-request path — a shed request is terminal the moment it is
 submitted and can never hang a ``generate()``/stream/C-host loop.
 
-With ``replicas=1`` the manager routes everything to replica 0 and the
-replica runs the bit-for-bit single-engine scheduler — the router adds
-bookkeeping, never a different step sequence (asserted bitwise in
-tests/test_cluster.py).
+**Fault tolerance** (serve/cluster/health.py): every replica step runs
+under the health monitor — a step exception or sustained latency spike
+demotes the replica (HEALTHY → SUSPECT → DOWN), and a DOWN replica's
+circuit opens: it leaves ``Router.route`` scoring, its session
+affinities drop (they re-pin on survivors, which also re-seeds its
+prefix families there), and every request it held is RE-ADMITTED to a
+healthy replica through the recompute path — prompt + tokens generated
+so far resubmit as a prompt, exactly the vLLM-style preemption recompute
+the scheduler already runs, so greedy generations stay bitwise the
+fault-free run's. Retries are bounded (``ServingConfig.failover_retries``
+with exponential cluster-step backoff); when they exhaust, or no healthy
+replica remains, the request turns into a terminal
+``GenerationResult.error`` — never a hang. After an exponential backoff
+the breaker half-opens (PROBING) and routed traffic is the probe.
+
+**Migration back-pressure** (``ServingConfig.migration_queue_budget``):
+finished prefills waiting for decode-pool capacity sit in a bounded
+FIFO. Within budget they wait holding their pages (the cheap page
+hand-off); past it they release the pages immediately and drain through
+recompute re-admission on the decode pool's own pending queue — a full
+decode pool costs recompute, not unbounded held slots on the prefill
+pool. Degraded pools fall back: a dead decode pool means the surviving
+pool serves both phases (recompute re-admission in place of page
+migration); a dead prefill pool routes new requests single-phase onto
+the decode pool.
+
+With ``replicas=1`` and no faults the manager routes everything to
+replica 0 and the replica runs the bit-for-bit single-engine scheduler —
+the router adds bookkeeping, never a different step sequence (asserted
+bitwise in tests/test_cluster.py).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Union
 
 from ...logging_utils import get_logger
 from ...metrics import ClusterStats
@@ -42,6 +69,7 @@ from ..batch_config import (
 )
 from ..engine import ServingConfig
 from ..request_manager import TERMINAL_STATUSES, RequestStatus
+from .health import HealthConfig, HealthMonitor, HealthState
 from .migration import migrate_request
 from .replica import Replica
 from .router import Router
@@ -51,7 +79,9 @@ from .router import Router
 class ClusterRequest:
     """Cluster-level view of one request: where it lives now (replica
     position + replica-local rid) and which phase of the disaggregated
-    lifecycle it is in. ``rid is None`` iff the request was shed."""
+    lifecycle it is in. ``rid is None`` means the request is not on any
+    replica right now: shed / terminally failed (``error`` set) or
+    between homes awaiting a failover re-admission (``error`` None)."""
 
     cluster_id: int
     tokens: List[int]
@@ -61,17 +91,30 @@ class ClusterRequest:
     replica: Optional[int] = None       # position into manager.replicas
     rid: Optional[int] = None           # replica-local request id
     phase: str = "single"               # "single" | "prefill" | "decode"
-    error: Optional[str] = None         # shed reason (rid is None)
+    error: Optional[str] = None         # terminal failure (shed/failover)
     profile: ProfileInfo = dataclasses.field(default_factory=ProfileInfo)
+    # ORIGINAL prompt length (the output-token baseline): a failover
+    # re-admission's home sees prompt+generated as its prompt, so the
+    # home's prompt_len stops being the boundary — this one always is.
+    prompt_len: int = 0
+    retries: int = 0                    # re-admissions so far
+    mig_attempts: int = 0               # failed page-migration attempts
 
     _manager: Any = dataclasses.field(default=None, repr=False)
+    # prompt + flushed generated tokens captured when the home replica
+    # went DOWN — the recompute re-admission's submission (and the
+    # partial output while between homes)
+    _known: Optional[List[int]] = dataclasses.field(default=None, repr=False)
+    _retry_at_step: int = 0             # failover/migration backoff gate
 
     @property
     def status(self) -> RequestStatus:
         """RequestStatus-shaped view (c_backend drives clusters through
         the same loop it drives a bare RequestManager with)."""
         if self.rid is None:
-            return RequestStatus.ERROR
+            # shed / failed = terminal; between homes (failover pending)
+            # = PENDING, so nothing treats an in-flight recovery as done
+            return RequestStatus.ERROR if self.error else RequestStatus.PENDING
         home = self._manager.replicas[self.replica].rm
         st = home.requests[self.rid].status
         if self.phase == "prefill" and st in TERMINAL_STATUSES:
@@ -86,9 +129,13 @@ class ClusterRequest:
     @property
     def output_tokens(self) -> List[int]:
         if self.rid is None:
+            if self._known:
+                return list(self._known[self.prompt_len:])
             return []
         home = self._manager.replicas[self.replica].rm
-        return home.requests[self.rid].output_tokens
+        # slice at the ORIGINAL prompt boundary: a failover home's own
+        # prompt_len includes carried-over generated tokens
+        return home.requests[self.rid].tokens[self.prompt_len:]
 
 
 class ClusterManager:
@@ -102,6 +149,7 @@ class ClusterManager:
         router: Optional[Router] = None,
         tokenizer: Any = None,
         eos_token_id: Optional[int] = None,
+        health_config: Optional[HealthConfig] = None,
     ):
         serving.validate_cluster()
         if len(replicas) != serving.replicas:
@@ -116,24 +164,38 @@ class ClusterManager:
         if eos_token_id is None and tokenizer is not None:
             self.eos_token_id = getattr(tokenizer, "eos_token_id", None)
         self.stats = ClusterStats()
+        self.health = HealthMonitor(len(self.replicas), health_config)
+        self.fault_injector = None
         self.prefill_pool = [r for r in self.replicas if r.role == "prefill"]
         self.decode_pool = [r for r in self.replicas if r.role == "decode"]
         self.disaggregated = bool(self.prefill_pool)
         if self.disaggregated and not self.decode_pool:
             raise ValueError("prefill pool without a decode pool")
         routing = self.prefill_pool if self.disaggregated else self.replicas
+        # router positions index the ROUTING pool; map back to cluster
+        # positions so ClusterRequest.replica is always cluster-wide
+        self._routing_pos = [self.replicas.index(r) for r in routing]
+        health_cb = (
+            lambda pos: self.health[self._routing_pos[pos]].routable
+        )
         self.router = router or Router(
             routing,
             serving.router_policy,
             slo_queue_delay_s=serving.slo_queue_delay_s,
             stats=lambda: self.stats,
+            health=health_cb,
         )
-        # router positions index the ROUTING pool; map back to cluster
-        # positions so ClusterRequest.replica is always cluster-wide
-        self._routing_pos = [self.replicas.index(r) for r in routing]
+        if router is not None and self.router.health is None:
+            self.router.health = health_cb
         self.requests: Dict[int, ClusterRequest] = {}
         self._next_cid = 1
         self._step_counter = 0
+        # failover re-admissions pending their backoff (cluster ids)
+        self._failovers: List[int] = []
+        # finished prefills awaiting decode-pool capacity (cluster ids,
+        # FIFO; bounded by ServingConfig.migration_queue_budget)
+        self._migration_queue: List[int] = []
+        self._mig_queued: Set[int] = set()
         self._log = get_logger("serve")
 
     # ------------------------------------------------------------------
@@ -151,6 +213,7 @@ class ClusterManager:
         eos_token_id: Optional[int] = None,
         seed: int = 0,
         devices: Optional[Sequence[Any]] = None,
+        health_config: Optional[HealthConfig] = None,
     ) -> "ClusterManager":
         """Build ``serving.replicas`` in-process replicas — params
         shared by reference, each replica with its own mesh over a
@@ -182,11 +245,27 @@ class ClusterManager:
         ]
         return cls(
             replicas, serving, tokenizer=tokenizer,
-            eos_token_id=eos_token_id,
+            eos_token_id=eos_token_id, health_config=health_config,
         )
 
+    def attach_faults(self, plan):
+        """Wire a :class:`~.faults.FaultPlan` (or a prebuilt injector,
+        or its JSON) into every replica and the migration path. Returns
+        the :class:`~.faults.FaultInjector` for ``fired``/``release_all``."""
+        from .faults import FaultInjector, FaultPlan
+
+        if isinstance(plan, str):
+            plan = FaultPlan.from_json(plan)
+        injector = plan if isinstance(plan, FaultInjector) else (
+            FaultInjector(plan)
+        )
+        self.fault_injector = injector
+        for rep in self.replicas:
+            rep.fault_injector = injector
+        return injector
+
     # ------------------------------------------------------------------
-    # submission
+    # submission + placement
 
     def _tokenize(self, prompt: Union[str, Sequence[int]]):
         if isinstance(prompt, str):
@@ -194,6 +273,9 @@ class ClusterManager:
                 raise ValueError("string prompt requires a tokenizer")
             return list(self.tokenizer.encode(prompt)), prompt
         return [int(t) for t in prompt], ""
+
+    def _routable_rep(self, rep: Replica) -> bool:
+        return self.health[self.replicas.index(rep)].routable
 
     def submit(
         self,
@@ -205,7 +287,8 @@ class ClusterManager:
         """Route + queue one request; returns its CLUSTER id
         immediately (non-blocking — drive with :meth:`step` or a
         concurrent :meth:`generate`/:meth:`generate_stream`). A shed
-        request is terminal on return (``result`` carries the error)."""
+        (or no-healthy-replica) request is terminal on return
+        (``result`` carries the error)."""
         gen = gen or GenerationConfig()
         if max_new_tokens is not None:
             gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
@@ -215,39 +298,126 @@ class ClusterManager:
         self.stats.submitted += 1
         cr = ClusterRequest(
             cluster_id=cid, tokens=tokens, prompt_text=text, gen=gen,
-            session_id=session_id, _manager=self,
+            session_id=session_id, prompt_len=len(tokens), _manager=self,
         )
         self.requests[cid] = cr
-        pos, how = self.router.route(tokens, session_id)
-        if pos is None:
+        self._place(cr, tokens)
+        return cid
+
+    def _place_failed(self, cr: ClusterRequest, how: str) -> bool:
+        cr.rid = None
+        cr.replica = None
+        if how == "shed":
             cr.error = (
                 "shed by SLO admission: every replica's queue-delay "
                 f"estimate exceeds slo_queue_delay_s="
                 f"{self.serving.slo_queue_delay_s}"
             )
-            return cid
-        rep = self.replicas[self._routing_pos[pos]]
-        cr.replica = self._routing_pos[pos]
+        else:  # "down"
+            cr.error = (
+                "no healthy replica: every replica is circuit-broken "
+                "(DOWN) — the request fails terminally instead of "
+                "waiting for a probe that may never succeed"
+            )
+        return False
+
+    def _place(
+        self,
+        cr: ClusterRequest,
+        known: Sequence[int],
+        *,
+        ignore_slo: bool = False,
+    ) -> bool:
+        """Route ``known`` (the prompt, or prompt + tokens generated so
+        far on a failover re-admission) and submit it to the chosen
+        replica. Returns True when placed; False means TERMINAL — shed,
+        or no healthy replica (``cr.error`` set). Failover
+        re-admissions pass ``ignore_slo=True``: a request admitted once
+        is never shed on its second landing."""
+        produced = max(0, len(known) - cr.prompt_len)
+        remaining = cr.gen.max_new_tokens - produced
+        gen_home = (
+            cr.gen if produced == 0
+            else dataclasses.replace(cr.gen, max_new_tokens=remaining)
+        )
+        first = cr.retries == 0
+        phase = "single"
+        if self.disaggregated and any(
+            self._routable_rep(r) for r in self.prefill_pool
+        ):
+            pos, how = self.router.route(
+                known, cr.session_id, ignore_slo=ignore_slo
+            )
+            if pos is None:
+                return self._place_failed(cr, how)
+            rep = self.replicas[self._routing_pos[pos]]
+            if any(self._routable_rep(r) for r in self.decode_pool):
+                phase = "prefill"
+            else:
+                # decode pool entirely DOWN: non-disaggregated serving
+                # on the surviving prefill pool — the chosen replica
+                # runs BOTH phases (no hold, no doomed migration)
+                self._log.warning(
+                    "decode pool is DOWN — request %d served "
+                    "single-phase on prefill replica %d",
+                    cr.cluster_id, rep.index,
+                )
+        elif self.disaggregated:
+            # prefill pool entirely DOWN: fall back to non-disaggregated
+            # serving on the surviving decode pool (ROADMAP'd degrade —
+            # the decode replicas prefill too rather than refuse traffic)
+            cands = [r for r in self.decode_pool if self._routable_rep(r)]
+            if not cands:
+                return self._place_failed(cr, "down")
+            rep = min(
+                cands,
+                key=lambda r: (r.queue_delay_s(), r.load(), r.index),
+            )
+            self.stats.record_placement("pool_fallback")
+            self._log.warning(
+                "prefill pool is DOWN — request %d served single-phase "
+                "on decode replica %d", cr.cluster_id, rep.index,
+            )
+        else:
+            pos, how = self.router.route(
+                known, cr.session_id, ignore_slo=ignore_slo
+            )
+            if pos is None:
+                return self._place_failed(cr, how)
+            rep = self.replicas[self._routing_pos[pos]]
         delay = rep.queue_delay_s()
-        if self.disaggregated:
+        cr.replica = self.replicas.index(rep)
+        cr.phase = phase
+        if phase == "prefill":
             # prefill pass only: max_new_tokens=1 makes the prefill-final
             # dispatch (which samples the first output token on device)
             # the request's LAST step there — the chunked-prefill
             # boundary — and the held slot keeps its pages alive for
             # the migration that follows
-            cr.phase = "prefill"
             cr.rid = rep.rm.submit(
-                tokens, dataclasses.replace(gen, max_new_tokens=1)
+                known, dataclasses.replace(gen_home, max_new_tokens=1)
             )
             rep.rm.hold_on_finish(cr.rid)
         else:
-            cr.phase = "single"
-            cr.rid = rep.rm.submit(tokens, gen)
+            cr.rid = rep.rm.submit(known, gen_home)
         req = rep.rm.requests[cr.rid]
-        req.profile.replica_id = rep.index
-        req.profile.router_queue_delay_s = delay
-        cr.profile = req.profile
-        return cid
+        if first:
+            req.profile.replica_id = rep.index
+            req.profile.router_queue_delay_s = delay
+            cr.profile = req.profile
+            # the home may have truncated an over-long prompt — its
+            # prompt_len is the authoritative output boundary
+            cr.prompt_len = req.prompt_len
+        else:
+            # re-admission: keep the ORIGINAL profile (start time, TTFT)
+            # on the new home and record the move on it
+            req.profile = cr.profile
+            cr.profile.retries = cr.retries
+            cr.profile.failover_replica_id = rep.index
+            cr.profile.replica_id = rep.index
+            cr.profile.router_queue_delay_s = delay
+        cr._known = None
+        return True
 
     # convenience alias (c_backend drives both manager kinds identically)
     def register_request(
@@ -258,86 +428,390 @@ class ClusterManager:
         return self.submit(prompt, gen)
 
     # ------------------------------------------------------------------
-    # the drive loop
+    # fault handling: health transitions + failover re-admission
 
-    def _finish_or_migrate(self, cr: ClusterRequest) -> bool:
-        """Handle one held prefill-pool completion: either the request
-        is ALREADY done (1-token budget, a stop token, or an error — no
-        decode phase owed) and finishes on the prefill replica, or its
-        pages migrate to the least-loaded decode replica. Returns True
-        when state changed."""
-        src = self.replicas[cr.replica]
-        req = src.rm.requests[cr.rid]
-        if req.status not in TERMINAL_STATUSES or req.pipeline_refs:
-            return False
-        if req.status is RequestStatus.ERROR:
-            # unservable on the prefill pool (PR-2 ERROR path) — the
-            # cluster request is terminal with that error
-            src.rm.release_held(cr.rid)
-            cr.phase = "single"
-            return True
-        done = len(req.tokens) >= self.serving.max_sequence_length
-        if req.tokens[req.prompt_len:]:
-            first = req.tokens[-1]
-            stops = set(cr.gen.stop_token_ids)
-            if self.eos_token_id is not None:
-                stops.add(self.eos_token_id)
-            done = done or first in stops or cr.gen.max_new_tokens <= 1
-        if done:
-            src.rm.release_held(cr.rid)
-            cr.phase = "single"
-            return True
-        dst = min(
-            self.decode_pool,
-            key=lambda r: (r.queue_delay_s(), r.load(), r.index),
+    def _note_transition(self, pos: int, transition: Optional[str],
+                         exc: Optional[BaseException] = None) -> None:
+        if transition is None:
+            return
+        rep = self.replicas[pos]
+        if transition == "suspect":
+            self.stats.replica_suspect += 1
+            self._log.warning(
+                "replica %d SUSPECT: %s", rep.index,
+                self.health[pos].last_error,
+            )
+        elif transition == "recovered":
+            self.stats.replica_recoveries += 1
+            self._log.warning("replica %d recovered (circuit closed)",
+                              rep.index)
+        elif transition == "down":
+            self.stats.replica_down += 1
+            self._on_replica_down(pos, exc)
+
+    def _on_replica_down(self, pos: int,
+                         exc: Optional[BaseException]) -> None:
+        """The breaker opened: fail every request on the replica over
+        to survivors (recompute re-admission), drop its session pins
+        (they re-pin — which also re-seeds its prefix families on
+        survivors), and tear its scheduler state down so a later probe
+        re-admission starts clean."""
+        rep = self.replicas[pos]
+        self._log.warning(
+            "replica %d DOWN (%s) — failing over its requests",
+            rep.index, exc if exc is not None else
+            self.health[pos].last_error,
         )
-        rid_dst = migrate_request(src, dst, cr.rid, cr.gen,
-                                  stats=self.stats)
-        if rid_dst is None:
-            return False  # decode pool full right now — retry next step
-        src.rm.release_held(cr.rid)
-        cr.replica = self.replicas.index(dst)
-        cr.rid = rid_dst
-        cr.phase = "decode"
-        req = dst.rm.requests[rid_dst]
-        req.profile.replica_id = dst.index
-        cr.profile = req.profile
-        return True
+        try:
+            rpos = self.router.replicas.index(rep)
+        except ValueError:
+            rpos = None  # decode-pool replica: not in the routing pool
+        if rpos is not None:
+            dropped = self.router.drop_replica_sessions(rpos)
+            if dropped:
+                self._log.debug(
+                    "replica %d: %d session affinities dropped "
+                    "(re-pin on survivors)", rep.index, dropped,
+                )
+        victims = [
+            cr for cr in self.requests.values()
+            if cr.rid is not None and cr.replica == pos
+            and cr.status not in TERMINAL_STATUSES
+        ]
+        for cr in victims:
+            req = rep.rm.requests[cr.rid]
+            # the host token list only ever holds FLUSHED truth — the
+            # recompute re-admission regenerates anything in flight
+            cr._known = list(req.tokens)
+            cr.rid = None
+            cr.replica = None
+            cr.phase = "single"
+            self._schedule_failover(cr)
+        # queued migrations whose source died are failover victims now
+        self._migration_queue = [
+            c for c in self._migration_queue
+            if self.requests[c].rid is not None
+        ]
+        self._mig_queued = set(self._migration_queue)
+        try:
+            rep.abandon()
+        except Exception as abandon_exc:  # the pool may be torn mid-step
+            self._log.warning(
+                "replica %d abandon() failed (%s) — its pool is "
+                "excluded from audits until it recovers",
+                rep.index, abandon_exc,
+            )
 
-    def _migrate_ready(self) -> bool:
+    def _schedule_failover(self, cr: ClusterRequest) -> None:
+        """Bounded retries with exponential (cluster-step) backoff; past
+        the bound the request fails terminally — never a hang."""
+        cr.retries += 1
+        self.stats.retries += 1
+        if cr.retries > self.serving.failover_retries:
+            cr.error = (
+                f"replica failed and failover retries exhausted "
+                f"({cr.retries - 1} re-admissions, failover_retries="
+                f"{self.serving.failover_retries})"
+            )
+            self.stats.failover_errors += 1
+            return
+        backoff = (
+            0 if cr.retries == 1
+            else self.serving.failover_backoff_steps
+            * (2 ** (cr.retries - 2))
+        )
+        cr._retry_at_step = self._step_counter + backoff
+        self._failovers.append(cr.cluster_id)
+
+    def _run_failovers(self) -> bool:
+        """Re-admit requests whose backoff expired. A request that
+        cannot be placed (no healthy replica) fails terminally."""
+        if not self._failovers:
+            return False
         progressed = False
-        for cr in self.requests.values():
-            if cr.phase == "prefill" and cr.rid is not None:
-                progressed = self._finish_or_migrate(cr) or progressed
+        still: List[int] = []
+        for cid in self._failovers:
+            cr = self.requests[cid]
+            if cr.error is not None or cr.rid is not None:
+                continue
+            if self._step_counter < cr._retry_at_step:
+                still.append(cid)
+                continue
+            if self._place(cr, cr._known, ignore_slo=True):
+                self.stats.failovers += 1
+                progressed = True
+                self._log.warning(
+                    "failover: request %d re-admitted on replica %d "
+                    "(retry %d, %d tokens recomputed)",
+                    cid, cr.profile.failover_replica_id, cr.retries,
+                    len(cr.tokens),
+                )
+            else:
+                self.stats.failover_errors += 1
+                progressed = True
+        self._failovers = still
         return progressed
 
-    def step(self) -> bool:
-        """One cluster step: advance every replica with work, then run
-        any pending prefill→decode migrations. Returns False when no
-        replica has work left."""
+    # ------------------------------------------------------------------
+    # prefill→decode migration (bounded queue + back-pressure)
+
+    def _queue_migrations(self) -> None:
+        """Move newly completed held prefills into the migration FIFO
+        (finishing the ones that owe no decode phase), then apply the
+        back-pressure budget: entries past it release their held pages
+        and drain through recompute re-admission instead of parking."""
+        for cid, cr in list(self.requests.items()):
+            if (
+                cr.phase != "prefill" or cr.rid is None
+                or cid in self._mig_queued
+            ):
+                continue
+            src = self.replicas[cr.replica]
+            req = src.rm.requests[cr.rid]
+            if req.status not in TERMINAL_STATUSES or req.pipeline_refs:
+                continue
+            if req.status is RequestStatus.ERROR:
+                # unservable on the prefill pool (PR-2 ERROR path) — the
+                # cluster request is terminal with that error
+                src.rm.release_held(cr.rid)
+                cr.phase = "single"
+                continue
+            done = len(req.tokens) >= self.serving.max_sequence_length
+            if req.tokens[req.prompt_len:]:
+                last = req.tokens[-1]
+                stops = set(cr.gen.stop_token_ids)
+                if self.eos_token_id is not None:
+                    stops.add(self.eos_token_id)
+                remaining = cr.gen.max_new_tokens - (
+                    len(req.tokens) - cr.prompt_len
+                )
+                done = done or last in stops or remaining <= 0
+            if done:
+                # 1-token budget, a stop token, or max length — no
+                # decode phase owed: it finished on the prefill replica
+                src.rm.release_held(cr.rid)
+                cr.phase = "single"
+                continue
+            self._migration_queue.append(cid)
+            self._mig_queued.add(cid)
+        budget = self.serving.migration_queue_budget
+        if budget is not None:
+            while len(self._migration_queue) > budget:
+                # newest entries overflow (FIFO heads keep their pages —
+                # they hand off next); the overflow recomputes instead
+                cid = self._migration_queue.pop()
+                self._mig_queued.discard(cid)
+                self.stats.migration_queue_overflows += 1
+                self._recompute_readmit(cid)
+        depth = len(self._migration_queue)
+        self.stats.migration_queue_depth = depth
+        self.stats.migration_queue_peak = max(
+            self.stats.migration_queue_peak, depth
+        )
+
+    def _drain_migration_queue(self) -> bool:
+        """Hand queued prefills to the decode pool: page migration when
+        a healthy decode replica has capacity; recompute re-admission
+        when the decode pool is gone or a migration keeps failing."""
+        if not self._migration_queue:
+            return False
         progressed = False
-        for rep in self.replicas:
-            if rep.has_work():
-                progressed = rep.step() or progressed
-        if self.disaggregated:
-            progressed = self._migrate_ready() or progressed
+        remaining_q: List[int] = []
+        for cid in self._migration_queue:
+            cr = self.requests[cid]
+            if cr.rid is None or cr.error is not None:
+                continue  # source died — the failover path owns it now
+            if self._step_counter < cr._retry_at_step:
+                remaining_q.append(cid)  # migration-failure backoff
+                continue
+            src = self.replicas[cr.replica]
+            req = src.rm.requests[cr.rid]
+            dsts = [r for r in self.decode_pool if self._routable_rep(r)]
+            if not dsts:
+                # decode pool entirely DOWN: fall back to
+                # non-disaggregated serving on the surviving pool —
+                # recompute re-admission frees the held pages and the
+                # prefill replica (or any survivor) serves the decode
+                # phase itself
+                self._recompute_readmit(cid)
+                progressed = True
+                continue
+            dst = min(
+                dsts,
+                key=lambda r: (r.queue_delay_s(), r.load(), r.index),
+            )
+            # the decode side runs the REMAINING budget: after a
+            # failover the home's prompt already carries generated
+            # tokens, and the dst counts generation from its own
+            # adopted baseline (= the home's prompt_len)
+            gen_dst = dataclasses.replace(
+                cr.gen,
+                max_new_tokens=cr.gen.max_new_tokens
+                - (req.prompt_len - cr.prompt_len),
+            )
+            try:
+                rid_dst = migrate_request(
+                    src, dst, cr.rid, gen_dst,
+                    stats=self.stats, injector=self.fault_injector,
+                )
+            except Exception as exc:
+                self.stats.migration_failures += 1
+                cr.mig_attempts += 1
+                self._log.warning(
+                    "migration of request %d -> replica %d failed "
+                    "(attempt %d): %s", cid, dst.index,
+                    cr.mig_attempts, exc,
+                )
+                if cr.mig_attempts > self.serving.failover_retries:
+                    self._recompute_readmit(cid)
+                else:
+                    cr._retry_at_step = self._step_counter + (
+                        self.serving.failover_backoff_steps
+                        * (2 ** (cr.mig_attempts - 1))
+                    )
+                    remaining_q.append(cid)
+                progressed = True
+                continue
+            if rid_dst is None:
+                remaining_q.append(cid)  # dst full right now — waits
+                continue
+            src.rm.release_held(cr.rid)
+            cr.replica = self.replicas.index(dst)
+            cr.rid = rid_dst
+            cr.phase = "decode"
+            cr.profile.replica_id = dst.index
+            progressed = True
+        self._migration_queue = remaining_q
+        self._mig_queued = set(remaining_q)
+        self.stats.migration_queue_depth = len(remaining_q)
+        return progressed
+
+    def _recompute_readmit(self, cid: int) -> None:
+        """Drain one held prefill WITHOUT moving pages: release the
+        hold (its pages free immediately) and resubmit prompt + first
+        token through the recompute path on the best surviving replica
+        — the decode pool when any of it is healthy, else any healthy
+        replica. The re-prefill is the back-pressure price (warm where
+        prefix caching holds the prompt); greedy outputs stay bitwise."""
+        cr = self.requests[cid]
+        src = self.replicas[cr.replica]
+        req = src.rm.requests[cr.rid]
+        known = list(req.tokens)
+        src.rm.release_held(cr.rid)
+        cr.rid = None
+        cr.replica = None
+        cr.phase = "single"
+        cr.retries += 1
+        self.stats.retries += 1
+        cands = [r for r in self.decode_pool if self._routable_rep(r)] or [
+            r for r in self.replicas if self._routable_rep(r)
+        ]
+        if not cands:
+            cr._known = known
+            cr.error = (
+                "no healthy replica to drain the held prefill to — "
+                "the request fails terminally instead of parking"
+            )
+            self.stats.failover_errors += 1
+            return
+        rep = min(
+            cands, key=lambda r: (r.queue_delay_s(), r.load(), r.index)
+        )
+        produced = len(known) - cr.prompt_len
+        gen_home = dataclasses.replace(
+            cr.gen, max_new_tokens=cr.gen.max_new_tokens - produced
+        )
+        cr.rid = rep.rm.submit(known, gen_home)
+        cr.replica = self.replicas.index(rep)
+        rep.rm.requests[cr.rid].profile = cr.profile
+        cr.profile.retries = cr.retries
+        cr.profile.failover_replica_id = rep.index
+        cr.profile.replica_id = rep.index
+        self._log.debug(
+            "migration back-pressure: request %d drained to replica %d "
+            "via recompute (%d tokens re-prefill)",
+            cid, rep.index, len(known),
+        )
+
+    # ------------------------------------------------------------------
+    # the drive loop
+
+    def step(self) -> bool:
+        """One cluster step: advance every steppable replica under the
+        health monitor, settle prefill→decode migrations, then run any
+        due failover re-admissions. Returns False when no replica has
+        work left and nothing is pending recovery."""
         self._step_counter += 1
-        if self._step_counter % 200 == 0:
+        step_no = self._step_counter
+        progressed = False
+        for pos, rep in enumerate(self.replicas):
+            h = self.health[pos]
+            if h.state is HealthState.DOWN:
+                if h.maybe_probe(step_no):
+                    self.stats.probes += 1
+                    self._log.warning(
+                        "replica %d probing (circuit half-open after "
+                        "%d-step backoff)", rep.index, h.backoff_steps,
+                    )
+                    progressed = True
+                else:
+                    continue
+            if not rep.has_work():
+                continue
+            t0 = time.perf_counter()
+            try:
+                stepped = rep.step()
+            except Exception as exc:
+                self.stats.step_faults += 1
+                self._note_transition(
+                    pos, h.record_failure(exc, step_no), exc
+                )
+                progressed = True
+                continue
+            latency = (time.perf_counter() - t0) + rep.injected_latency_s
+            self._note_transition(
+                pos, h.record_success(latency, step_no, had_work=True)
+            )
+            progressed = stepped or progressed
+        if self.disaggregated:
+            self._queue_migrations()
+            progressed = self._drain_migration_queue() or progressed
+        progressed = self._run_failovers() or progressed
+        if self._failovers or self._migration_queue:
+            # pending recoveries keep the drive loop alive through their
+            # backoff windows — a generate() must never break out and
+            # strand a request between homes
+            progressed = True
+        if step_no % 200 == 0:
             self._log.debug(
                 "%s", self.stats.report([r.rm.stats for r in self.replicas])
             )
         return progressed
 
     def drain(self) -> None:
-        """Flush every replica's pipeline, then settle any migrations
-        those flushes unblocked (a prefill pass whose completion was
-        still in the pipeline hands its pages off here; the adopted
-        decode work itself is driven by later :meth:`step` calls, same
-        as RequestManager.drain never runs new steps)."""
-        for rep in self.replicas:
-            rep.drain()
+        """Flush every healthy replica's pipeline, then settle any
+        migrations those flushes unblocked (a prefill pass whose
+        completion was still in the pipeline hands its pages off here;
+        the adopted decode work itself is driven by later :meth:`step`
+        calls, same as RequestManager.drain never runs new steps). A
+        flush failure is a replica failure — same health path as a
+        step exception."""
+        for pos, rep in enumerate(self.replicas):
+            if self.health[pos].state is HealthState.DOWN:
+                continue
+            try:
+                rep.drain()
+            except Exception as exc:
+                self.stats.step_faults += 1
+                self._note_transition(
+                    pos,
+                    self.health[pos].record_failure(exc, self._step_counter),
+                    exc,
+                )
         if self.disaggregated:
-            self._migrate_ready()
+            self._queue_migrations()
+            self._drain_migration_queue()
+        self._run_failovers()
 
     # ------------------------------------------------------------------
     # results
@@ -346,24 +820,37 @@ class ClusterManager:
         """ClusterStats snapshot over the live per-replica stats."""
         return self.stats.snapshot([r.rm.stats for r in self.replicas])
 
+    def health_snapshot(self) -> List[str]:
+        return self.health.snapshot()
+
     def check_no_leaks(self) -> None:
-        for rep in self.replicas:
+        """Page-pool audits on every replica that is NOT circuit-broken
+        — a DOWN replica's pool is unreachable (on multi-host it is
+        gone with the process), not leaked; it re-enters the audit set
+        the moment it probes back."""
+        for pos, rep in enumerate(self.replicas):
+            if self.health[pos].state is HealthState.DOWN:
+                continue
             rep.check_no_leaks()
 
     def result(self, cid: int) -> GenerationResult:
         cr = self.requests[cid]
-        if cr.rid is None:  # shed at the router
-            return GenerationResult(
-                request_id=cid,
-                prompt=cr.prompt_text,
-                input_tokens=list(cr.tokens),
-                output_tokens=[],
-                output_text="",
-                profile=cr.profile,
-                error=cr.error,
-            )
-        res = self.replicas[cr.replica].rm.result(cr.rid)
-        return dataclasses.replace(res, request_id=cid)
+        out = cr.output_tokens
+        text = (
+            self.tokenizer.decode(out) if self.tokenizer is not None else ""
+        )
+        error = cr.error
+        if error is None and cr.rid is not None:
+            error = self.replicas[cr.replica].rm.requests[cr.rid].error
+        return GenerationResult(
+            request_id=cid,
+            prompt=cr.prompt_text,
+            input_tokens=list(cr.tokens),
+            output_tokens=list(out),
+            output_text=text,
+            profile=cr.profile,
+            error=error,
+        )
 
     def _terminal(self, cid: int) -> bool:
         return self.requests[cid].status in TERMINAL_STATUSES
@@ -403,7 +890,9 @@ class ClusterManager:
         event per request (``error`` set for sheds/failures). Token
         counts are monotone across a migration — the first output token
         is visible on both sides of the hand-off, so nothing is dropped
-        or re-sent."""
+        or re-sent — and across a failover: the re-admission's known
+        tokens are exactly the flushed (= streamed) prefix, so the
+        stream resumes where it stopped."""
         if isinstance(prompts, str):
             prompts = [prompts]
         cids = [

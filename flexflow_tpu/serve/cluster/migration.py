@@ -53,17 +53,31 @@ def migrate_request(
     gen,
     *,
     stats: Optional[ClusterStats] = None,
+    injector=None,
 ) -> Optional[int]:
     """Move a prefilled request from replica ``src`` to replica ``dst``.
 
     ``rid`` must be COMPLETED on ``src`` (the ``max_new_tokens=1``
     prefill pass) with its slot HELD (``hold_on_finish``) and no
-    dispatches in flight. ``gen`` is the request's ORIGINAL generation
-    config (the source ran a 1-token override). Returns the request id
-    on ``dst`` — adopted into DECODING with the migrated pages — or
+    dispatches in flight. ``gen`` is the generation config the DECODE
+    side should run (the source ran a 1-token override; after an
+    earlier failover it is the remaining budget). Returns the request
+    id on ``dst`` — adopted into DECODING with the migrated pages — or
     None when ``dst`` has no slot/pages right now (nothing moved; the
     caller retries later; the source keeps holding).
+
+    Transactional: an exception during the page hand-off (a real
+    transport error on multi-host, or the fault harness's
+    ``InjectedMigrationFault``) rolls the destination's adoption back
+    (``RequestManager.rollback_adopt``) and re-raises — the source
+    still holds the request with its pages, so the caller can retry or
+    fall back to recompute re-admission with nothing leaked on either
+    side. ``injector`` (serve/cluster/faults.py) is consulted FIRST,
+    before any adoption, so scripted failures exercise the clean path
+    and real mid-transfer exceptions exercise the rollback.
     """
+    if injector is not None:
+        injector.migration_fault(src)  # may raise InjectedMigrationFault
     req = src.rm.requests[rid]
     assert req.status is RequestStatus.COMPLETED, (
         f"migrating request {rid} in state {req.status}"
@@ -81,19 +95,25 @@ def migrate_request(
     )
     if rid_dst is None:
         return None
-    n_pages = src_eng.pager.pages_for(prompt_len)
-    src_row = src_eng.pager.table[req.slot]
-    dst_row = dst_eng.pager.table[dst.rm.requests[rid_dst].slot]
-    # start every page's async D2H gather before the one blocking
-    # harvest, then upload (async H2D, ordered before any dst step that
-    # reads the pages)
-    handles = [src_eng.fetch_page(int(src_row[j])) for j in range(n_pages)]
-    import jax
+    try:
+        n_pages = src_eng.pager.pages_for(prompt_len)
+        src_row = src_eng.pager.table[req.slot]
+        dst_row = dst_eng.pager.table[dst.rm.requests[rid_dst].slot]
+        # start every page's async D2H gather before the one blocking
+        # harvest, then upload (async H2D, ordered before any dst step
+        # that reads the pages)
+        handles = [
+            src_eng.fetch_page(int(src_row[j])) for j in range(n_pages)
+        ]
+        import jax
 
-    # ffcheck: disable=FF107 -- migration flush point: the prefill→decode hand-off harvests its page gathers in ONE blocking sync at the chunked-prefill boundary — the source pipeline is already drained (request completed) and the destination has not started the request, so no decode step anywhere waits on this transfer
-    values = jax.device_get(handles)
-    for j in range(n_pages):
-        dst_eng.upload_page(int(dst_row[j]), values[j])
+        # ffcheck: disable=FF107 -- migration flush point: the prefill→decode hand-off harvests its page gathers in ONE blocking sync at the chunked-prefill boundary — the source pipeline is already drained (request completed) and the destination has not started the request, so no decode step anywhere waits on this transfer
+        values = jax.device_get(handles)
+        for j in range(n_pages):
+            dst_eng.upload_page(int(dst_row[j]), values[j])
+    except Exception:
+        dst.rm.rollback_adopt(rid_dst)
+        raise
     bytes_moved = dst_eng.page_host_bytes() * n_pages
     if stats is not None:
         stats.migrations += 1

@@ -50,10 +50,19 @@ class Replica:
         self.rm = rm
         self.role = role
         # token-rate EMA (tokens/sec the scheduler actually retired) —
-        # the denominator of the queue-delay estimate
+        # the denominator of the queue-delay estimate. ``_rate_samples``
+        # gates the estimate: a single (or stale) observation is not a
+        # denominator — SLO shedding must never act on a cold rate.
         self._rate = 0.0
+        self._rate_samples = 0
         self._last_tokens = 0
         self._last_t: Optional[float] = None
+        # fault-injection harness (serve/cluster/faults.py): consulted
+        # at the top of step(); injected latency accumulates here per
+        # step and is read by the manager's health monitor.
+        self.fault_injector = None
+        self.steps_taken = 0
+        self.injected_latency_s = 0.0
         self._log = get_logger("serve")
 
     @classmethod
@@ -140,9 +149,12 @@ class Replica:
 
     def queue_delay_s(self) -> float:
         """Estimated seconds before NEW work would start executing:
-        backlog over the observed token rate. 0 while no rate has been
-        observed (cold replicas are never shed on a guess)."""
-        if self._rate <= 0.0:
+        backlog over the observed token rate. 0 until at least two rate
+        samples exist (cold replicas — first steps after start, after
+        ``abandon``, or after probe re-admission — are never shed on a
+        guess or a stale denominator, and the division cannot see a
+        zero/near-zero rate)."""
+        if self._rate_samples < 2 or self._rate <= 0.0:
             return 0.0
         return self.backlog_tokens() / self._rate
 
@@ -155,7 +167,14 @@ class Replica:
         )
 
     def step(self) -> bool:
-        """One scheduler step + a rate-EMA update from the stats delta."""
+        """One scheduler step + a rate-EMA update from the stats delta.
+        The fault injector (when attached) runs FIRST — an injected
+        crash/transient raises here, at the replica surface, exactly
+        where a remote replica's RPC failure would surface."""
+        self.steps_taken += 1
+        self.injected_latency_s = 0.0
+        if self.fault_injector is not None:
+            self.fault_injector.on_step(self)  # may raise InjectedFault
         progressed = self.rm.step()
         now = time.perf_counter()
         done = self.rm.stats.prefill_tokens + self.rm.stats.decode_tokens
@@ -168,12 +187,63 @@ class Replica:
                     inst if self._rate == 0.0
                     else 0.8 * self._rate + 0.2 * inst
                 )
+                self._rate_samples += 1
         self._last_t = now
         self._last_tokens = done
         return progressed
 
     def drain(self) -> None:
         self.rm.drain()
+
+    # ------------------------------------------------------------------
+    # fault tolerance (serve/cluster/health.py drives these)
+
+    def reset_rate(self) -> None:
+        """Forget the token-rate EMA (and its wall-clock anchor). Called
+        when the replica goes DOWN so probe re-admission starts with a
+        cold, optimistic estimate instead of a stale denominator — the
+        dt across the outage would otherwise read as a near-zero rate
+        and SLO-shed everything routed at the recovered replica."""
+        self._rate = 0.0
+        self._rate_samples = 0
+        self._last_t = None
+        self._last_tokens = (
+            self.rm.stats.prefill_tokens + self.rm.stats.decode_tokens
+        )
+
+    def abandon(self) -> int:
+        """Tear the scheduler state down after the replica was declared
+        DOWN: drop every in-flight dispatch WITHOUT flushing (the device
+        results are suspect and nothing may block on them), mark every
+        live request ERROR (the manager has already captured their
+        flushed tokens for recompute re-admission elsewhere), and
+        release every slot's pages so a later probe re-admission starts
+        from a clean pool. The prefix-cache radix tree is KEPT — its
+        pages were written by completed, flushed dispatches and survive
+        the fault, so a recovered replica rejoins with its prefix
+        families warm. Returns the number of live requests dropped."""
+        rm = self.rm
+        rm._inflight.clear()
+        rm._prev_dispatch_slots = set()
+        rm.pending.clear()
+        rm.hold_finished.clear()
+        dropped = 0
+        for req in rm.requests.values():
+            req.pipeline_refs = 0
+            req.inflight = 0
+            if req.status not in TERMINAL_STATUSES:
+                req.status = RequestStatus.ERROR
+                req.error = "replica down — failed over"
+                dropped += 1
+        for slot, rid in enumerate(rm.slots):
+            if rid is None:
+                continue
+            if rm._paged:
+                rm._release_pages(slot)
+            rm.slots[slot] = None
+            rm.requests[rid].slot = -1
+        self.reset_rate()
+        return dropped
 
     # ------------------------------------------------------------------
     # audits

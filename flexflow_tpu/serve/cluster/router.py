@@ -58,6 +58,7 @@ class Router:
         *,
         slo_queue_delay_s: Optional[float] = None,
         stats=None,
+        health=None,
     ):
         if policy not in POLICIES:
             raise ValueError(
@@ -70,6 +71,11 @@ class Router:
         self.policy = policy
         self.slo_queue_delay_s = slo_queue_delay_s
         self._stats_src = stats
+        # Health filter (serve/cluster/health.py): a zero-arg-per-pos
+        # callable ``health(pos) -> bool`` — False (circuit-broken DOWN
+        # replica) excludes the position from every scoring pass. None =
+        # every replica is always routable (the PR-8 behavior).
+        self.health = health
         self._rr_next = 0
         self.sessions: Dict[object, int] = {}  # session_id -> replica pos
         self._log = get_logger("serve")
@@ -83,10 +89,24 @@ class Router:
 
     # ------------------------------------------------------------------
 
+    def _routable(self, pos: int) -> bool:
+        return self.health is None or bool(self.health(pos))
+
     def _under_slo(self, pos: int) -> bool:
         if self.slo_queue_delay_s is None:
             return True
         return self.replicas[pos].queue_delay_s() <= self.slo_queue_delay_s
+
+    def drop_replica_sessions(self, pos: int) -> int:
+        """Forget every session pinned to ``pos`` (the replica went
+        DOWN): each session re-pins on its next turn — which is also
+        what re-seeds a dead replica's prefix families on survivors
+        (the next relative misses everywhere and lands least-loaded,
+        exactly like a brand-new family). Returns sessions dropped."""
+        stale = [k for k, v in self.sessions.items() if v == pos]
+        for k in stale:
+            del self.sessions[k]
+        return len(stale)
 
     def _least_loaded(self, positions: Sequence[int]) -> int:
         return min(
@@ -102,22 +122,34 @@ class Router:
         self,
         tokens: Sequence[int],
         session_id: Optional[object] = None,
+        *,
+        ignore_slo: bool = False,
     ) -> Tuple[Optional[int], str]:
         """Place one prompt. Returns ``(position, how)`` — a position
         into ``self.replicas`` and the decision kind ("affinity",
         "prefix", "round_robin", "least_loaded") — or ``(None, "shed")``
-        when SLO admission rejects it. Records the placement (and the
+        when SLO admission rejects it, or ``(None, "down")`` when every
+        replica is circuit-broken (the caller surfaces a terminal
+        error, never a hang). ``ignore_slo`` bypasses SLO admission —
+        failover re-admissions were already admitted once and must not
+        be shed on their second landing. Records the placement (and the
         session) in the stats."""
-        eligible = [
-            p for p in range(len(self.replicas)) if self._under_slo(p)
-        ]
         st = self.stats
+        alive = [
+            p for p in range(len(self.replicas)) if self._routable(p)
+        ]
+        if not alive:
+            self._log.debug("router: every replica is DOWN")
+            return None, "down"
+        eligible = [
+            p for p in alive if ignore_slo or self._under_slo(p)
+        ]
         if not eligible:
             if st is not None:
                 st.sheds += 1
             self._log.debug(
-                "router shed: every replica over slo_queue_delay_s=%s "
-                "(delays: %s)",
+                "router shed: every healthy replica over "
+                "slo_queue_delay_s=%s (delays: %s)",
                 self.slo_queue_delay_s,
                 [round(r.queue_delay_s(), 3) for r in self.replicas],
             )

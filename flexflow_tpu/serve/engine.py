@@ -179,6 +179,25 @@ class ServingConfig:
     # GenerationResult.error — the PR-2 contract: terminal, never a
     # hang. None (default) = never shed.
     slo_queue_delay_s: Optional[float] = None
+    # Fault tolerance (serve/cluster/health.py + manager failover):
+    # when a replica is circuit-broken (DOWN), each of its in-flight
+    # requests is re-admitted to a healthy replica through recompute
+    # (prompt + tokens generated so far re-prefill — the vLLM-style
+    # preemption path, so greedy generations stay bitwise the
+    # fault-free run's). failover_retries bounds how many times ONE
+    # request may be re-admitted before it turns into a terminal
+    # GenerationResult.error (never a hang); repeat re-admissions back
+    # off failover_backoff_steps × 2^(retries-2) cluster steps.
+    failover_retries: int = 2
+    failover_backoff_steps: int = 4
+    # Migration back-pressure (disaggregated serving): at most this
+    # many finished prefills may WAIT for decode-pool capacity holding
+    # their slot + pages (ROADMAP item 1: a full decode pool must not
+    # park held prefills unboundedly). Overflow entries release their
+    # pages immediately and drain through recompute re-admission on the
+    # decode pool's own pending queue instead. None (default) = no
+    # bound — the PR-8 behavior.
+    migration_queue_budget: Optional[int] = None
     # Runtime hazard sanitizers (flexflow_tpu/analysis/): "retrace" — a
     # strict RetraceGuard on the engine's jit chokepoint that raises on
     # any step recompile after its first compile (the shape/dtype-drift
@@ -191,13 +210,21 @@ class ServingConfig:
     # environment without touching code.
     sanitizers: Tuple[str, ...] = ()
 
-    def validate_cluster(self) -> None:
+    def validate_cluster(self, *, specinfer: bool = False) -> None:
         """Fail-fast validation of the cluster fields — called from
         engine construction (every replica carries this config, so a
         bad value dies before any replica exists) AND from
         ClusterManager, the consumer (cluster/manager.py), mirroring
         how ``kv_quant``/``fused_decode`` fail at construction rather
-        than mid-serve."""
+        than mid-serve. ``specinfer=True`` (LLM.compile with ssms)
+        additionally rejects the SpecInfer × cluster combination."""
+        if specinfer and (self.replicas > 1 or self.prefill_replicas):
+            raise ValueError(
+                "cluster serving (replicas > 1 / disaggregated pools) "
+                "is not composed with SpecInfer ssms yet — per-replica "
+                "SSM mirrors are an open ROADMAP item (item 1: "
+                "SpecInfer × cluster)"
+            )
         if self.replicas < 1:
             raise ValueError(
                 f"replicas must be >= 1 (got {self.replicas})"
@@ -235,6 +262,24 @@ class ServingConfig:
             raise ValueError(
                 f"slo_queue_delay_s must be >= 0 (got "
                 f"{self.slo_queue_delay_s})"
+            )
+        if self.failover_retries < 0:
+            raise ValueError(
+                f"failover_retries must be >= 0 (got "
+                f"{self.failover_retries})"
+            )
+        if self.failover_backoff_steps < 1:
+            raise ValueError(
+                f"failover_backoff_steps must be >= 1 (got "
+                f"{self.failover_backoff_steps})"
+            )
+        if (
+            self.migration_queue_budget is not None
+            and self.migration_queue_budget < 0
+        ):
+            raise ValueError(
+                f"migration_queue_budget must be >= 0 or None (got "
+                f"{self.migration_queue_budget})"
             )
 
     @property

@@ -133,6 +133,10 @@ class LLM:
         zero-copy double buffering, config.h:155-157).
         """
         serving = serving or ServingConfig()
+        # SpecInfer × cluster fails HERE, with the other cluster-field
+        # validation, before any params are placed or engines built —
+        # per-replica SSM mirrors are an open ROADMAP item (item 1).
+        serving.validate_cluster(specinfer=bool(ssms))
         from ..core.mesh import PIPE_AXIS
         from ..config import get_config
         from ..core.dtypes import DataType
@@ -151,13 +155,8 @@ class LLM:
         )
         if serving.replicas > 1 or serving.prefill_replicas:
             # Cluster serving (serve/cluster/): N engine replicas behind
-            # the prefix-aware router. Not composed with SpecInfer yet —
-            # the SSM pools would need per-replica mirrors.
-            if ssms:
-                raise ValueError(
-                    "cluster serving (replicas > 1 / disaggregated "
-                    "pools) is not composed with SpecInfer ssms yet"
-                )
+            # the prefix-aware router (the SpecInfer combination was
+            # rejected by validate_cluster above).
             from .cluster import ClusterManager
 
             self.rm = ClusterManager.build(

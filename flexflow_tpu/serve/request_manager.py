@@ -332,6 +332,26 @@ class RequestManager:
         self.stats.admitted += 1
         return rid
 
+    def rollback_adopt(self, rid: int) -> None:
+        """Undo :meth:`adopt_prefilled` before any step ran — the
+        migration failed AFTER adoption (a page gather/upload raised),
+        so the destination must release the slot + pages it granted and
+        forget the request entirely: the source still holds the
+        original, and a half-adopted ghost would leak its pages and
+        double-count the admission."""
+        req = self.requests.pop(rid)
+        assert req.status is RequestStatus.DECODING, (
+            f"rollback_adopt of request {rid} in state {req.status}"
+        )
+        assert req.pipeline_refs == 0 and req.n_cached == req.prompt_len, (
+            "rollback_adopt after the adopted request already stepped"
+        )
+        if req.slot >= 0:
+            if self._paged:
+                self._release_pages(req.slot)
+            self.slots[req.slot] = None
+        self.stats.admitted -= 1
+
     # ------------------------------------------------------------------
     # paged-KV page management (serve/paging.py PageAllocator; one
     # allocator per engine — a SpecInfer LLM/SSM pair allocates

@@ -16,6 +16,10 @@
 #                           1-replica == bare-engine bitwise, and
 #                           prefill→decode page migration byte-exact
 #                           over fp/int8/int4 with zero page leaks
+#   6. fault tolerance    — replica health/circuit-breaker units,
+#                           deterministic fault injection, failover
+#                           bitwise vs fault-free, seeded chaos with
+#                           zero hangs/leaks, migration back-pressure
 #
 # Exits non-zero at the first failing gate. Full tier-1 (ROADMAP.md
 # "Tier-1 verify") is the merge bar; this is the fast inner loop.
@@ -24,26 +28,33 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== premerge 1/5: ffcheck (static hazard lint)" >&2
+echo "== premerge 1/6: ffcheck (static hazard lint)" >&2
 python scripts/ffcheck.py
 
-echo "== premerge 2/5: family serve-API re-exports" >&2
+echo "== premerge 2/6: family serve-API re-exports" >&2
 python scripts/check_family_reexports.py
 
-echo "== premerge 3/5: fused decode parity + retrace guard" >&2
+echo "== premerge 3/6: fused decode parity + retrace guard" >&2
 # unfiltered: runs the interpret-mode Pallas e2e tests that tier-1
 # slow-marks for time-budget reasons
 python -m pytest tests/test_fused_decode.py tests/test_retrace_guard.py \
     -q -p no:cacheprovider
 
-echo "== premerge 4/5: hierarchical KV cache (int4 + host spill)" >&2
+echo "== premerge 4/6: hierarchical KV cache (int4 + host spill)" >&2
 # Pallas/XLA nibble-unpack parity, bitwise cold/warm/spilled-readmit
 # generation parity over fp+int8+int4 pools, spill-tier bookkeeping
 python -m pytest tests/test_kv_hierarchy.py -q -p no:cacheprovider
 
-echo "== premerge 5/5: cluster serving (router + migration)" >&2
+echo "== premerge 5/6: cluster serving (router + migration)" >&2
 # router units, cluster-vs-bare-engine bitwise parity, disaggregated
 # prefill→decode migration over fp/int8/int4, shed-is-terminal
 python -m pytest tests/test_cluster.py -q -p no:cacheprovider
+
+echo "== premerge 6/6: fault-tolerant cluster serving" >&2
+# health state machine + circuit breaker, deterministic FaultPlan
+# injection, replica-death failover bitwise vs the fault-free run,
+# seeded chaos (every request terminal, zero leaks on survivors),
+# migration queue back-pressure, pool-death fallbacks
+python -m pytest tests/test_cluster_faults.py -q -p no:cacheprovider
 
 echo "premerge: all gates passed" >&2
