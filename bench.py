@@ -209,6 +209,12 @@ _PHASES = [
     # failed-over outputs vs the fault-free run, zero hung requests,
     # zero steady-state recompiles on survivors asserted
     ("serve_faults", 700, 500, True, True),
+    # adaptive speculation: acceptance-driven W×D tree shaping vs the
+    # fixed tree (drafted accept rate >=3x asserted) + the early-exit
+    # self-draft's tokens/sec vs non-speculative continuous batching
+    # (>=1x asserted); bitwise greedy parity + zero steady-state
+    # recompiles asserted in both arms
+    ("serve_spec_adaptive", 700, 500, True, True),
     # megakernel decode step: per-fusion ablation (rope_kv_write /
     # sampling / both) on small-batch sync decode — decode_step_ms
     # p50/p99 + dispatched programs per step, bitwise parity asserted
@@ -356,6 +362,40 @@ def orchestrate(which):
                 n_replicas=d.get("n_replicas"),
                 migrations=d.get("disagg_migrations"),
                 migrated_bytes=d.get("disagg_migrated_bytes"),
+                platform=d.get("platform"),
+            )
+
+    # Derived: the speculation-efficiency trajectory — drafted accept
+    # rate (accepted drafted tokens / drafted tokens; free root/bonus
+    # tokens in neither side) so BENCH_r*.json tracks it across rounds.
+    # The adaptive controller's rate on its A/B workload outranks the
+    # flagship serve phase's fixed-tree rate (same counting, better
+    # policy); the fixed figure rides along for the gap.
+    rec = _RESULTS.get("spec_adaptive_accept_uplift")
+    flag = _RESULTS.get("specinfer_tokens_per_sec_per_chip")
+    if rec or flag:
+        if rec:
+            d = rec.get("detail") or {}
+            emit(
+                "spec_accept_rate",
+                d.get("drafted_accept_rate_adaptive"),
+                "fraction",
+                source=rec["metric"],
+                fixed_tree_rate=d.get("drafted_accept_rate_fixed"),
+                accept_uplift=rec["value"],
+                tokens_per_verify_step=d.get(
+                    "tokens_per_verify_step_adaptive"
+                ),
+                platform=d.get("platform"),
+            )
+        else:
+            d = flag.get("detail") or {}
+            emit(
+                "spec_accept_rate",
+                d.get("drafted_accept_rate"),
+                "fraction",
+                source=flag["metric"],
+                tokens_per_verify_step=d.get("tokens_per_verify_step"),
                 platform=d.get("platform"),
             )
 
@@ -810,12 +850,250 @@ def serve_bench(on_tpu, kernels):
         vs_baseline=spec_tps / A100_SPECINFER_TOKS_PER_SEC,
         kernels=kernels,
         spec_step_reduction=round(incr_steps / max(1, spec_steps), 3),
-        accept_rate=round(accepted / max(1, speculated), 3),
+        # honest speculation accounting (two numbers, not one blurred
+        # "accept rate"): drafted_accept_rate = accepted DRAFTED tokens
+        # over drafted tokens (free root/bonus tokens in neither side —
+        # ProfileInfo.speculated_tokens docstring), and the committed
+        # output per verify dispatch, which DOES credit the bonus token
+        # (that is where the step reduction comes from)
+        drafted_accept_rate=round(accepted / max(1, speculated), 3),
+        tokens_per_verify_step=round(spec_tokens / max(1, spec_steps), 3),
         incr_tokens_per_sec=round(incr_tps, 2),
         n_requests=n_req,
         new_tokens_per_request=n_new,
         model_params_b=round(llama.num_params(cfg) / 1e9, 3),
         platform=_platform(),
+    )
+    return spec_tps
+
+
+def _damped_deep_layers(cfg, params, k, scale=0.05):
+    """Scale the RESIDUAL-branch output projections (wo, w2) of layers
+    >= k by ``scale`` — an early-exit-friendly target whose deep layers
+    refine rather than rewrite. Trained checkpoints have exactly that
+    redundancy (the LayerSkip premise: late layers mostly sharpen the
+    early layers' prediction); random init has NONE of it, so without
+    this the early-exit throughput arm would measure draft noise, not
+    the controller/verify machinery it exists to measure. The adaptive
+    ACCEPT-RATE arm deliberately keeps the raw random weights — a weak
+    draft is the regime adaptive shaping is for."""
+    import jax.numpy as jnp
+
+    layers = dict(params["layers"])
+    for name in ("wo", "w2"):
+        w = layers[name]
+        layers[name] = jnp.concatenate([w[:k], w[k:] * scale], axis=0)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def serve_spec_adaptive_bench(on_tpu, kernels):
+    """Adaptive speculation (ROADMAP item 4): acceptance-driven tree
+    shaping + the early-exit self-draft, on the paged pool under the
+    continuous-batching scheduler (8 requests into 4 slots — admission
+    churn rides the pipelined mixed step, speculation rounds run the
+    pure-decode phases).
+
+    Two sub-workloads, each asserting its half of the claim:
+
+    * **accept-rate A/B** (weak 1-layer layer-skip draft on raw random
+      weights — the hard-prompt regime): the FIXED tree at the
+      reference's own MAX_BEAM_WIDTH=3 / MAX_BEAM_DEPTH=8 defaults
+      (batch_config.h:157-161) vs the adaptive controller under the
+      same 3x8 bounds on the identical workload. Asserts drafted
+      accept rate (accepted drafted / drafted — root/bonus in neither
+      side) >= 3x the fixed tree's, bitwise greedy parity vs
+      incremental decoding for BOTH arms, zero retraces and zero
+      steady-state recompiles (second identical run compiles nothing
+      new; one program per W x D bucket by construction).
+    * **throughput** (early-exit self-draft on a deep-residual-damped
+      target — the trained-model regime, see _damped_deep_layers): the
+      SAME engine drafts from its first 2 layers, adaptive controller
+      on. Asserts speculative tokens/sec >= the non-speculative
+      continuous-batching scheduler on the identical workload, bitwise
+      parity, zero steady-state recompiles.
+
+    CPU caveat: XLA:CPU runs steps inline and width-flat, so the wide
+    verify dispatch is underpriced relative to the chip and the
+    tokens/sec ratio is a parity-grade smoke, not the TPU claim; the
+    accept-rate ratio, by contrast, is platform-independent counting.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import (
+        InferenceEngine,
+        RequestManager,
+        ServingConfig,
+        SpecConfig,
+        SpecInferManager,
+    )
+
+    cfg = llama.LLaMAConfig.tiny(
+        dtype=jnp.float32, num_hidden_layers=4, hidden_size=128,
+        intermediate_size=256, num_attention_heads=4,
+        num_key_value_heads=2, vocab_size=512,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_new = 64
+    n_req, slots, prompt_len = 8, 4, 12
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_req)
+    ]
+
+    def make_sc(**kw):
+        d = dict(
+            max_requests_per_batch=slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=8,
+            max_spec_tree_tokens=32,
+            cache_dtype=jnp.float32,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=16,
+        )
+        d.update(kw)
+        return ServingConfig(**d)
+
+    def guards(mgr):
+        return [
+            g for g in (
+                e.retrace_guard for e in [mgr.engine, *mgr.ssms]
+            ) if g is not None
+        ]
+
+    # ---- accept-rate A/B: fixed 2x4 tree vs adaptive, weak draft ----
+    dcfg, dparams = _layer_skip_draft(cfg, params, 1)
+    rm = RequestManager(InferenceEngine(llama, cfg, params, make_sc()))
+    ref = [o.output_tokens for o in rm.generate(prompts, max_new_tokens=n_new)]
+
+    mgr_fixed = SpecInferManager(
+        InferenceEngine(llama, cfg, params, make_sc()),
+        InferenceEngine(llama, dcfg, dparams, make_sc()),
+        SpecConfig(beam_width=3, beam_depth=8),
+    )
+    fixed_outs = mgr_fixed.generate(prompts, max_new_tokens=n_new)
+    assert [o.output_tokens for o in fixed_outs] == ref, (
+        "fixed-tree speculation broke greedy parity"
+    )
+    fixed_rate = mgr_fixed.stats.spec_accept_rate
+    fixed_tpv = sum(len(o.output_tokens) for o in fixed_outs) / max(
+        1, sum(o.profile.llm_decoding_steps for o in fixed_outs)
+    )
+
+    spec_ad = SpecConfig(beam_width=3, beam_depth=8, adaptive=True)
+    mgr_ad = SpecInferManager(
+        InferenceEngine(llama, cfg, params, make_sc(sanitizers=("retrace",))),
+        InferenceEngine(llama, dcfg, dparams,
+                        make_sc(sanitizers=("retrace",))),
+        spec_ad,
+    )
+    ad_outs = mgr_ad.generate(prompts, max_new_tokens=n_new)
+    assert [o.output_tokens for o in ad_outs] == ref, (
+        "adaptive speculation broke greedy parity"
+    )
+    compiles_warm = sum(g.total_compiles for g in guards(mgr_ad))
+    # steady state: the identical workload again — fresh requests walk
+    # the same controller trajectory through the same W x D buckets,
+    # so NOTHING may compile (and the strict guard raises on retraces)
+    ad_outs2 = mgr_ad.generate(prompts, max_new_tokens=n_new)
+    assert [o.output_tokens for o in ad_outs2] == ref
+    steady_recompiles = (
+        sum(g.total_compiles for g in guards(mgr_ad)) - compiles_warm
+    )
+    assert steady_recompiles == 0, steady_recompiles
+    assert all(g.retraces == 0 for g in guards(mgr_ad))
+    ad_rate = mgr_ad.stats.spec_accept_rate
+    ad_tpv = sum(len(o.output_tokens) for o in ad_outs) / max(
+        1, sum(o.profile.llm_decoding_steps for o in ad_outs)
+    )
+    uplift = ad_rate / max(fixed_rate, 1e-9)
+    emit(
+        "spec_adaptive_accept_uplift",
+        round(uplift, 2),
+        "ratio",
+        vs_baseline=uplift / 3.0,  # the >=3x target
+        drafted_accept_rate_adaptive=round(ad_rate, 4),
+        drafted_accept_rate_fixed=round(fixed_rate, 4),
+        tokens_per_verify_step_adaptive=round(ad_tpv, 3),
+        tokens_per_verify_step_fixed=round(fixed_tpv, 3),
+        tree_resizes=mgr_ad.stats.spec_resizes,
+        bucket_ladder=str(spec_ad.bucket_ladder),
+        output_parity=1,
+        steady_state_recompiles=steady_recompiles,
+        kernels=kernels,
+        platform=_platform(),
+    )
+    assert uplift >= 3.0, (
+        f"adaptive drafted accept rate {ad_rate:.4f} is only "
+        f"{uplift:.2f}x the fixed tree's {fixed_rate:.4f} (>=3x required)"
+    )
+
+    # ---- throughput: early-exit self-draft vs incremental, both under
+    # the continuous-batching scheduler ----
+    bparams = _damped_deep_layers(cfg, params, k=1)
+    rm_b = RequestManager(InferenceEngine(llama, cfg, bparams, make_sc()))
+    rm_b.generate(prompts, max_new_tokens=n_new)  # warm compiles
+    t0 = time.perf_counter()
+    ref_b = rm_b.generate(prompts, max_new_tokens=n_new)
+    incr_dt = time.perf_counter() - t0
+    incr_tokens = sum(len(o.output_tokens) for o in ref_b)
+    incr_tps = incr_tokens / incr_dt
+
+    mgr_b = SpecInferManager(
+        InferenceEngine(llama, cfg, bparams, make_sc(sanitizers=("retrace",))),
+        None,
+        SpecConfig(beam_width=2, beam_depth=4, adaptive=True,
+                   draft="early_exit", draft_layers=1),
+    )
+    # warm with the IDENTICAL workload: fresh requests repeat the same
+    # controller trajectory, so the timed run below must compile NOTHING
+    mgr_b.generate(prompts, max_new_tokens=n_new)
+    compiles_warm = sum(g.total_compiles for g in guards(mgr_b))
+    t0 = time.perf_counter()
+    outs_b = mgr_b.generate(prompts, max_new_tokens=n_new)
+    spec_dt = time.perf_counter() - t0
+    assert [o.output_tokens for o in outs_b] == [
+        o.output_tokens for o in ref_b
+    ], "early-exit speculation broke greedy parity"
+    steady_b = sum(g.total_compiles for g in guards(mgr_b)) - compiles_warm
+    assert steady_b == 0, steady_b
+    assert all(g.retraces == 0 for g in guards(mgr_b))
+    spec_tokens = sum(len(o.output_tokens) for o in outs_b)
+    spec_tps = spec_tokens / spec_dt
+    emit(
+        "spec_adaptive_tokens_per_sec_per_chip",
+        round(spec_tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=spec_tps / incr_tps,
+        incr_tokens_per_sec=round(incr_tps, 2),
+        drafted_accept_rate=round(mgr_b.stats.spec_accept_rate, 4),
+        tokens_per_verify_step=round(
+            spec_tokens / max(1, sum(
+                o.profile.llm_decoding_steps for o in outs_b
+            )), 3,
+        ),
+        draft="early_exit",
+        draft_layers=1,
+        mixed_steps=mgr_b.stats.mixed_steps,
+        spec_rounds=mgr_b.stats.spec_rounds,
+        output_parity=1,
+        steady_state_recompiles=steady_b,
+        caveat=(
+            "CPU smoke: XLA:CPU steps are width-flat so the wide verify "
+            "dispatch is underpriced vs the chip; deep residual branches "
+            "are damped to emulate the trained-checkpoint redundancy "
+            "early-exit drafting exploits (random weights have none)"
+        ) if not on_tpu else None,
+        kernels=kernels,
+        platform=_platform(),
+    )
+    assert spec_tps >= incr_tps, (
+        f"adaptive speculation ({spec_tps:.1f} tok/s) lost to the "
+        f"non-speculative continuous-batching scheduler ({incr_tps:.1f})"
     )
     return spec_tps
 
@@ -2634,6 +2912,8 @@ def serve_7b_bench(on_tpu, kernels):
     spec_dt = time.perf_counter() - t0
     spec_tokens = sum(len(o.output_tokens) for o in outs)
     spec_steps = sum(o.profile.llm_decoding_steps for o in outs)
+    accepted = sum(o.profile.accepted_tokens for o in outs)
+    speculated = sum(o.profile.speculated_tokens for o in outs)
     spec_tps = spec_tokens / spec_dt
     emit(
         "specinfer_tokens_per_sec_7b_int4",
@@ -2644,6 +2924,8 @@ def serve_7b_bench(on_tpu, kernels):
         quantization="int4",
         model="llama-7b-shape",
         spec_step_reduction=round(incr_steps / max(1, spec_steps), 3),
+        drafted_accept_rate=round(accepted / max(1, speculated), 3),
+        tokens_per_verify_step=round(spec_tokens / max(1, spec_steps), 3),
         incr_tokens_per_sec=round(incr_tps, 2),
         platform=_platform(),
     )
@@ -2693,6 +2975,8 @@ def child_main(phase, platform, kernels):
         serve_paged_q_bench(on_tpu, kernels)
     elif phase == "serve_kv_hierarchy":
         serve_kv_hierarchy_bench(on_tpu, kernels)
+    elif phase == "serve_spec_adaptive":
+        serve_spec_adaptive_bench(on_tpu, kernels)
     elif phase == "serve_fused":
         serve_fused_bench(on_tpu, kernels)
     elif phase == "serve_int8":

@@ -105,6 +105,16 @@ class SchedulerStats:
     readmits: int = 0
     host_hit_tokens: int = 0
     host_bytes: int = 0
+    # SpecInfer adaptive speculation (serve/specinfer.py): per-request
+    # verify rounds run, tree tokens DRAFTED by the SSM/early-exit
+    # draft, drafted tokens the verifier accepted (root/bonus tokens in
+    # neither — see ProfileInfo.speculated_tokens), and W×D ladder
+    # moves the acceptance-driven controllers made. FF_LOG=serve=debug
+    # reports them alongside the scheduler counters.
+    spec_rounds: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_resizes: int = 0
     # Retrace sentinel (analysis/retrace.py, wired when the engine runs
     # with ServingConfig.sanitizers=("retrace",)): XLA compiles of step
     # programs observed at the engine's jit chokepoint, and how many of
@@ -164,6 +174,15 @@ class SchedulerStats:
             return 0.0
         return self.host_hit_tokens / self.prefix_hit_tokens
 
+    @property
+    def spec_accept_rate(self) -> float:
+        """Drafted-accept rate: drafted tokens the verifier accepted
+        over drafted tokens — the honest speculation-efficiency figure
+        (free root/bonus tokens in neither side)."""
+        if not self.spec_drafted:
+            return 0.0
+        return self.spec_accepted / self.spec_drafted
+
     def snapshot(self) -> Dict[str, float]:
         return {
             "steps": self.steps,
@@ -191,6 +210,11 @@ class SchedulerStats:
             "host_hit_tokens": self.host_hit_tokens,
             "host_hit_rate": round(self.host_hit_rate, 4),
             "host_bytes": self.host_bytes,
+            "spec_rounds": self.spec_rounds,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
+            "spec_resizes": self.spec_resizes,
+            "spec_accept_rate": round(self.spec_accept_rate, 4),
             "compiles": self.compiles,
             "retraces": self.retraces,
         }
@@ -210,6 +234,8 @@ class SchedulerStats:
             f"pfx_evict={s['prefix_evictions']} pfx_cow={s['prefix_cows']} "
             f"spill={s['spills']} readmit={s['readmits']} "
             f"host_toks={s['host_hit_tokens']} host_B={s['host_bytes']} "
+            f"spec={s['spec_accepted']}/{s['spec_drafted']}"
+            f"@{s['spec_rounds']}r resize={s['spec_resizes']} "
             f"compiles={s['compiles']} retraces={s['retraces']}"
         )
 
@@ -287,6 +313,10 @@ class ClusterStats:
             agg["host_hit_rate"] = round(
                 agg.get("host_hit_tokens", 0) / hit_toks, 4
             ) if hit_toks else 0.0
+            drafted = agg.get("spec_drafted", 0)
+            agg["spec_accept_rate"] = round(
+                agg.get("spec_accepted", 0) / drafted, 4
+            ) if drafted else 0.0
             agg["mean_occupancy"] = round(
                 sum(s["mean_occupancy"] for s in per) / len(per), 4
             )

@@ -594,6 +594,7 @@ def serve_step(
     cfg: LLaMAConfig,
     all_logits: bool = False,
     kernels: str = "xla",
+    num_layers: Optional[int] = None,
     mesh=None,
 ):
     """One serving step over R request slots × C tokens each.
@@ -605,6 +606,15 @@ def serve_step(
     With a ``mesh`` whose pipe axis is >1, the layer stack (and the
     layer-major KV cache) is stage-sharded and activations flow through
     the pipeline (reference inference_manager.cc:91-133 stage mapping).
+
+    ``num_layers`` runs a LAYER-SLICED step: only the first
+    ``num_layers`` blocks execute (their K/V commit into the cache; the
+    deeper layers' cache buffers pass through untouched) before the
+    full model's final norm + head read the truncated hidden state —
+    the self-speculation "early-exit" draft (LayerSkip-style,
+    SpecConfig.draft="early_exit"): the target's own shallow prefix
+    drafts tokens the full-depth verify pass then re-checks. None
+    (default) = the full stack.
 
     Returns (logits, new_cache): logits (R, V) at ``logits_idx`` or
     (R, C, V) when ``all_logits`` (tree verification needs every token's
@@ -633,6 +643,13 @@ def serve_step(
         return h, (kc, vc)
 
     if mesh is not None and mesh.shape[PIPE_AXIS] > 1:
+        if num_layers is not None:
+            raise NotImplementedError(
+                "early-exit drafting (num_layers) is not composed with "
+                "pipeline parallelism — the sliced stack would idle the "
+                "deeper stages"
+            )
+
         from ..parallel.pipeline import make_pipelined_serve
 
         def stage_fn(stage_layers, caches, h, row):
@@ -663,6 +680,17 @@ def serve_step(
         x, (k_new, v_new) = piped(
             params["layers"], (cache["k"], cache["v"]), x, row
         )
+    elif num_layers is not None and num_layers < cfg.num_hidden_layers:
+        n = num_layers
+        x, (k_upd, v_upd) = lax.scan(
+            scan_body, x,
+            (jax.tree.map(lambda a: a[:n], params["layers"]),
+             cache["k"][:n], cache["v"][:n]),
+        )
+        # deeper layers never run: their cache rows pass through intact
+        # (the verify pass owns them)
+        k_new = jnp.concatenate([k_upd, cache["k"][n:]], axis=0)
+        v_new = jnp.concatenate([v_upd, cache["v"][n:]], axis=0)
     else:
         x, (k_new, v_new) = lax.scan(
             scan_body, x, (params["layers"], cache["k"], cache["v"])
@@ -937,6 +965,7 @@ def serve_step_paged(
     kernels: str = "xla",
     kv_quant: Optional[str] = None,
     fused_rope: bool = False,
+    num_layers: Optional[int] = None,
     mesh=None,
 ):
     """Paged twin of :func:`serve_step` — same contract plus the
@@ -946,7 +975,11 @@ def serve_step_paged(
     commit quantizes in-step and attention dequantizes at read time.
     ``fused_rope`` (megakernel decode step) folds RoPE and the KV page
     write into the Pallas kernel per block — a no-op on the XLA path,
-    which already is the fused variants' CPU-parity reference."""
+    which already is the fused variants' CPU-parity reference.
+    ``num_layers`` is the layer-sliced early-exit draft step (see
+    :func:`serve_step`): only the first ``num_layers`` blocks run and
+    commit K/V; deeper pool rows (and their quant scale rows) pass
+    through untouched for the verify pass to own."""
     if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
         raise NotImplementedError(
             "paged KV serving is not composed with pipeline parallelism "
@@ -960,6 +993,15 @@ def serve_step_paged(
     mask = _paged_mask(mask, positions, page_table, ps, cache_len)
     phys, off = _page_lookup(page_table, cache_positions, ps)
     logical = cache_positions // ps
+
+    n = cfg.num_hidden_layers
+    if num_layers is not None:
+        n = min(num_layers, n)
+    sliced = n < cfg.num_hidden_layers
+    layers = (
+        jax.tree.map(lambda a: a[:n], params["layers"])
+        if sliced else params["layers"]
+    )
 
     if kv_quant is not None:
         from ..serve.kv_quant import resolve_spec
@@ -977,9 +1019,14 @@ def serve_step_paged(
 
         x, (k_new, v_new, ks_new, vs_new) = lax.scan(
             scan_body_q, x,
-            (params["layers"], cache["k"], cache["v"],
-             cache["k_scale"], cache["v_scale"]),
+            (layers, cache["k"][:n], cache["v"][:n],
+             cache["k_scale"][:n], cache["v_scale"][:n]),
         )
+        if sliced:
+            k_new = jnp.concatenate([k_new, cache["k"][n:]], axis=0)
+            v_new = jnp.concatenate([v_new, cache["v"][n:]], axis=0)
+            ks_new = jnp.concatenate([ks_new, cache["k_scale"][n:]], axis=0)
+            vs_new = jnp.concatenate([vs_new, cache["v_scale"][n:]], axis=0)
         new_cache = {"k": k_new, "v": v_new,
                      "k_scale": ks_new, "v_scale": vs_new}
     else:
@@ -993,8 +1040,11 @@ def serve_step_paged(
             return h, (kc, vc)
 
         x, (k_new, v_new) = lax.scan(
-            scan_body, x, (params["layers"], cache["k"], cache["v"])
+            scan_body, x, (layers, cache["k"][:n], cache["v"][:n])
         )
+        if sliced:
+            k_new = jnp.concatenate([k_new, cache["k"][n:]], axis=0)
+            v_new = jnp.concatenate([v_new, cache["v"][n:]], axis=0)
         new_cache = {"k": k_new, "v": v_new}
     x = _rms(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]

@@ -708,11 +708,17 @@ def serve_step(
     *,
     cfg: DecoderConfig,
     all_logits: bool = False,
+    num_layers: Optional[int] = None,
     mesh=None,
 ):
     """One serving step over R request slots × C tokens; same contract as
     ``models.llama.serve_step`` (see engine protocol in serve/engine.py),
-    including the stage-sharded pipeline path when ``mesh`` has pipe>1."""
+    including the stage-sharded pipeline path when ``mesh`` has pipe>1.
+    ``num_layers`` is the layer-sliced early-exit draft step (see
+    models/llama.serve_step): only the first ``num_layers`` blocks run
+    and commit K/V; the deeper layers' cache buffers pass through for
+    the verify pass to own (the position buffer, written once per step
+    rather than per layer, updates in full either way)."""
     R, C = tokens.shape
     S1 = cache["k"].shape[2]
     if cache_positions is None:
@@ -757,6 +763,13 @@ def serve_step(
         return h, (kc, vc)
 
     if mesh is not None and mesh.shape[PIPE_AXIS] > 1:
+        if num_layers is not None:
+            raise NotImplementedError(
+                "early-exit drafting (num_layers) is not composed with "
+                "pipeline parallelism — the sliced stack would idle the "
+                "deeper stages"
+            )
+
         from ..parallel.pipeline import make_pipelined_serve
 
         # Row-sharded args go through explicit specs (closures would
@@ -795,6 +808,15 @@ def serve_step(
         x, (k_new, v_new) = piped(
             params["layers"], (cache["k"], cache["v"]), x, row
         )
+    elif num_layers is not None and num_layers < cfg.num_hidden_layers:
+        n = num_layers
+        x, (k_upd, v_upd) = lax.scan(
+            scan_body, x,
+            (jax.tree.map(lambda a: a[:n], params["layers"]),
+             cache["k"][:n], cache["v"][:n]),
+        )
+        k_new = jnp.concatenate([k_upd, cache["k"][n:]], axis=0)
+        v_new = jnp.concatenate([v_upd, cache["v"][n:]], axis=0)
     else:
         x, (k_new, v_new) = lax.scan(
             scan_body, x, (params["layers"], cache["k"], cache["v"])
@@ -1056,12 +1078,14 @@ def serve_step_paged(
     kernels: str = "xla",
     kv_quant: Optional[str] = None,
     fused_rope: bool = False,
+    num_layers: Optional[int] = None,
     mesh=None,
 ):
     """Paged twin of :func:`serve_step` — same contract plus the page
     table (see models/llama.py serve_step_paged; ``kv_quant`` selects
     the quantized pool layout, ``fused_rope`` the megakernel decode
-    step's in-kernel RoPE + KV-write prologue on the Pallas path)."""
+    step's in-kernel RoPE + KV-write prologue on the Pallas path,
+    ``num_layers`` the layer-sliced early-exit draft step)."""
     if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
         raise NotImplementedError(
             "paged KV serving is not composed with pipeline parallelism "
@@ -1075,6 +1099,15 @@ def serve_step_paged(
         cfg, cache, positions, cache_positions, mask, page_table, cache_len
     )
     logical = cache_positions // cache["k"].shape[2]
+
+    n = cfg.num_hidden_layers
+    if num_layers is not None:
+        n = min(num_layers, n)
+    sliced = n < cfg.num_hidden_layers
+    layers = (
+        jax.tree.map(lambda a: a[:n], params["layers"])
+        if sliced else params["layers"]
+    )
 
     if kv_quant is not None:
         from ..serve.kv_quant import resolve_spec
@@ -1092,9 +1125,14 @@ def serve_step_paged(
 
         x, (k_new, v_new, ks_new, vs_new) = lax.scan(
             scan_body_q, x,
-            (params["layers"], cache["k"], cache["v"],
-             cache["k_scale"], cache["v_scale"]),
+            (layers, cache["k"][:n], cache["v"][:n],
+             cache["k_scale"][:n], cache["v_scale"][:n]),
         )
+        if sliced:
+            k_new = jnp.concatenate([k_new, cache["k"][n:]], axis=0)
+            v_new = jnp.concatenate([v_new, cache["v"][n:]], axis=0)
+            ks_new = jnp.concatenate([ks_new, cache["k_scale"][n:]], axis=0)
+            vs_new = jnp.concatenate([vs_new, cache["v_scale"][n:]], axis=0)
         new_cache = {"k": k_new, "v": v_new,
                      "k_scale": ks_new, "v_scale": vs_new}
     else:
@@ -1108,8 +1146,11 @@ def serve_step_paged(
             return h, (kc, vc)
 
         x, (k_new, v_new) = lax.scan(
-            scan_body, x, (params["layers"], cache["k"], cache["v"])
+            scan_body, x, (layers, cache["k"][:n], cache["v"][:n])
         )
+        if sliced:
+            k_new = jnp.concatenate([k_new, cache["k"][n:]], axis=0)
+            v_new = jnp.concatenate([v_new, cache["v"][n:]], axis=0)
         new_cache = {"k": k_new, "v": v_new}
     x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
     if not all_logits:

@@ -64,6 +64,14 @@ class BatchConfig:
     # side (the kernels handle ragged rows unchanged); this field
     # carries it explicitly for telemetry and tests.
     prefill_offsets: Optional[np.ndarray] = None  # (R,) int32
+    # SpecInfer verify metadata: how many token-tree nodes (root
+    # included) this verify dispatch carries per slot. With adaptive
+    # tree shaping (serve/specinfer.py TreeController) slots in the
+    # same W×D bucket dispatch together and slots outside it carry 0 —
+    # the ragged truth of the padded (R, C) verify step, for telemetry
+    # and tests (the device side already ignores padding columns via
+    # the tree mask).
+    spec_nodes: Optional[np.ndarray] = None  # (R,) int32
 
     @property
     def num_slots(self) -> int:
@@ -121,8 +129,24 @@ class ProfileInfo:
     host_hit_tokens: int = 0
     llm_decoding_steps: int = 0
     ssm_decoding_steps: int = 0
+    # Speculation accounting (serve/specinfer.py). ``speculated_tokens``
+    # counts DRAFTED tree nodes (root excluded — the root is the
+    # previous round's committed token, never a drafted one) and
+    # ``accepted_tokens`` the drafted tokens the verifier accepted —
+    # the free root/bonus tokens appear in NEITHER, so
+    # accepted/speculated is the honest drafted-accept rate
+    # (``drafted_accept_rate``). Committed output per verify dispatch —
+    # accepted + the verifier's own bonus sample — is the separate
+    # tokens-per-verify-step figure (output tokens / llm_decoding_steps).
     speculated_tokens: int = 0
     accepted_tokens: int = 0
+    # Adaptive tree shaping (SpecConfig.adaptive): verify rounds this
+    # request ran, ladder moves its controller made, and the tree shape
+    # it ended on (the configured W×D when the controller is off).
+    spec_rounds: int = 0
+    tree_resizes: int = 0
+    tree_width: int = 0
+    tree_depth: int = 0
     # Cluster serving (serve/cluster/): which engine replica served the
     # request's decode phase (-1 outside a cluster), and the router's
     # queue-delay estimate for that replica at placement time — the

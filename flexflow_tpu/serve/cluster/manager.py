@@ -214,15 +214,27 @@ class ClusterManager:
         seed: int = 0,
         devices: Optional[Sequence[Any]] = None,
         health_config: Optional[HealthConfig] = None,
+        ssms: Sequence[Any] = (),
+        spec: Any = None,
     ) -> "ClusterManager":
         """Build ``serving.replicas`` in-process replicas — params
         shared by reference, each replica with its own mesh over a
         device picked round-robin from ``devices`` (all of them on a
         1-device host: independent engines on one chip is the
         in-process cluster this PR ships; per-host processes slot in
-        behind the same Replica surface later)."""
+        behind the same Replica surface later).
+
+        ``ssms`` ((model, cfg, params) triples) + ``spec`` turn every
+        replica into a SpecInfer pair: per-replica SSM MIRRORS — each
+        replica builds its own draft engines on its own mesh (draft
+        params shared by reference, like the target's), so speculation
+        scales out with the pool. Disaggregated prefill/decode pools
+        reject the combination at ``validate_cluster``."""
         serving = serving or ServingConfig()
-        serving.validate_cluster()
+        serving.validate_cluster(
+            specinfer=bool(ssms)
+            or getattr(spec, "draft", "ssm") == "early_exit"
+        )
         import jax
 
         devs = list(devices or jax.devices())
@@ -240,6 +252,8 @@ class ClusterManager:
                 tokenizer=tokenizer,
                 eos_token_id=eos_token_id,
                 seed=seed,
+                ssms=ssms,
+                spec=spec,
             )
             for i in range(serving.replicas)
         ]

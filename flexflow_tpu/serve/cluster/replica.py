@@ -80,11 +80,21 @@ class Replica:
         tokenizer: Any = None,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
+        ssms: Sequence[Any] = (),
+        spec: Any = None,
     ) -> "Replica":
         """Construct a replica with its OWN mesh (and so its own TP
         group) over ``devices``. Params are shared by reference across
         replicas — on one host that is free; per-host processes would
-        each load their own copy behind the same constructor."""
+        each load their own copy behind the same constructor.
+
+        ``ssms`` — (model, cfg, params) triples — are this replica's
+        OWN SpecInfer draft mirrors: each builds a fresh SSM engine on
+        the replica's mesh (draft params shared by reference like the
+        target's) and the replica runs a SpecInferManager instead of a
+        plain RequestManager. ``spec`` alone with
+        ``SpecConfig.draft="early_exit"`` self-speculates with no
+        mirror engines at all."""
         if mesh is None:
             import jax
 
@@ -93,10 +103,23 @@ class Replica:
             devices = list(devices or jax.devices()[:1])
             mesh = MachineSpec().make_mesh(devices)
         engine = InferenceEngine(model, cfg, params, serving, mesh)
-        rm = RequestManager(
-            engine, tokenizer=tokenizer, eos_token_id=eos_token_id,
-            seed=seed,
-        )
+        early_exit = getattr(spec, "draft", "ssm") == "early_exit"
+        if ssms or early_exit:
+            from ..specinfer import SpecInferManager
+
+            ssm_engines = [
+                InferenceEngine(m, c, p, serving, mesh)
+                for (m, c, p) in ssms
+            ]
+            rm: RequestManager = SpecInferManager(
+                engine, ssm_engines, spec, tokenizer=tokenizer,
+                eos_token_id=eos_token_id, seed=seed,
+            )
+        else:
+            rm = RequestManager(
+                engine, tokenizer=tokenizer, eos_token_id=eos_token_id,
+                seed=seed,
+            )
         return cls(index, rm, role=role)
 
     # ------------------------------------------------------------------

@@ -217,13 +217,18 @@ class ServingConfig:
         ClusterManager, the consumer (cluster/manager.py), mirroring
         how ``kv_quant``/``fused_decode`` fail at construction rather
         than mid-serve. ``specinfer=True`` (LLM.compile with ssms)
-        additionally rejects the SpecInfer × cluster combination."""
-        if specinfer and (self.replicas > 1 or self.prefill_replicas):
+        additionally rejects SpecInfer × DISAGGREGATED pools — the
+        page-migration hand-off does not carry draft caches; plain
+        replicated clusters compose (per-replica SSM mirror engines,
+        serve/cluster/replica.py)."""
+        if specinfer and self.prefill_replicas:
             raise ValueError(
-                "cluster serving (replicas > 1 / disaggregated pools) "
-                "is not composed with SpecInfer ssms yet — per-replica "
-                "SSM mirrors are an open ROADMAP item (item 1: "
-                "SpecInfer × cluster)"
+                "disaggregated prefill/decode pools are not composed "
+                "with SpecInfer ssms — the draft and verifier caches "
+                "advance together, which the prefill→decode page "
+                "migration hand-off does not carry; use replicas > 1 "
+                "WITHOUT prefill_replicas/decode_replicas (each replica "
+                "then runs its own SSM mirrors, serve/cluster/replica.py)"
             )
         if self.replicas < 1:
             raise ValueError(
@@ -617,12 +622,18 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
 
-    def _serve_step_fn(self, all_logits: bool) -> Callable:
+    def _serve_step_fn(self, all_logits: bool,
+                       num_layers: Optional[int] = None) -> Callable:
         """model.serve_step (or serve_step_paged) bound to this engine's
         static kwargs. The paged variant takes the page table as a
         trailing positional and needs cache_len for its scratch-line
-        mask cutoff."""
+        mask cutoff. ``num_layers`` binds the LAYER-SLICED early-exit
+        draft step (SpecConfig.draft="early_exit"): the model runs only
+        its first ``num_layers`` blocks and leaves the deeper cache
+        rows untouched."""
         kw = dict(cfg=self.cfg, all_logits=all_logits)
+        if num_layers is not None:
+            kw["num_layers"] = int(num_layers)
         if self.serving.kernels != "xla":
             kw["kernels"] = self.serving.kernels
         if self.pipelined:
@@ -883,7 +894,8 @@ class InferenceEngine:
         )
         return toks
 
-    def _get_speculate(self, W: int, D: int):
+    def _get_speculate(self, W: int, D: int,
+                       num_layers: Optional[int] = None):
         """Whole-tree SSM speculation as ONE compiled program: a scan
         over beam depths, each feeding the W-wide frontier through
         serve_step (tree-mask mode), expanding top-W-of-(W*V) children
@@ -891,10 +903,20 @@ class InferenceEngine:
         slack lines (prefix + 1 + d*W + w). Replaces the host round-trip
         per depth the reference pays once per beam step too
         (prepare_next_batch_beam); the host fetches the finished tree in
-        a single transfer."""
+        a single transfer.
+
+        One program per (W, D[, num_layers]) — adaptive tree shaping
+        moves requests along a BUCKETED W×D ladder (serve/specinfer.py
+        SpecConfig.bucket_ladder), so the key set stays bounded by the
+        ladder, never free-form. ``num_layers`` is the self-speculation
+        early-exit draft: the frontier expands through a layer-sliced
+        step over THIS engine's own params + cache."""
         key_id = ("speculate", W, D)
+        if num_layers is not None:
+            key_id = key_id + (int(num_layers),)
         if key_id not in self._steps:
-            fn = self._serve_step_fn(all_logits=True)
+            fn = self._serve_step_fn(all_logits=True,
+                                     num_layers=num_layers)
             from .sampling import log_softmax
 
             R = self.num_slots
@@ -966,17 +988,20 @@ class InferenceEngine:
             )
         return self._steps[key_id]
 
-    def run_speculate(self, root_tokens, prefix, active, W: int, D: int):
+    def run_speculate(self, root_tokens, prefix, active, W: int, D: int,
+                      num_layers: Optional[int] = None):
         """Dispatch one whole speculation round; returns device arrays
         (tokens, parents, logps) each (D, R, W). The cache advances in
-        place with every tree node's K/V at its slack line."""
+        place with every tree node's K/V at its slack line.
+        ``num_layers`` drafts through the layer-sliced early-exit step
+        (self-speculation: this engine doubles as its own SSM)."""
         kw = {}
         if self.paged:
             kw["page_table"] = self.page_table_device()
         donated = self.cache
         self.count_dispatch("speculate")
         with _set_mesh(self.mesh):
-            step = self._get_speculate(W, D)
+            step = self._get_speculate(W, D, num_layers)
             toks, parents, logps, self.cache = step(
                 self.params,
                 self.cache,
@@ -985,7 +1010,7 @@ class InferenceEngine:
                 jnp.asarray(active, dtype=jnp.bool_),
                 **kw,
             )
-        self._poison_donated(donated, ("speculate", W, D))
+        self._poison_donated(donated, ("speculate", W, D, num_layers))
         return toks, parents, logps
 
     def _dump_debug(self, bc: BatchConfig):

@@ -133,9 +133,11 @@ class LLM:
         zero-copy double buffering, config.h:155-157).
         """
         serving = serving or ServingConfig()
-        # SpecInfer × cluster fails HERE, with the other cluster-field
-        # validation, before any params are placed or engines built —
-        # per-replica SSM mirrors are an open ROADMAP item (item 1).
+        # Cluster-field validation fails HERE, before any params are
+        # placed or engines built. SpecInfer composes with replicated
+        # clusters (each replica gets its own SSM mirror engines,
+        # serve/cluster/replica.py); only the disaggregated
+        # prefill/decode pools still reject the combination.
         serving.validate_cluster(specinfer=bool(ssms))
         from ..core.mesh import PIPE_AXIS
         from ..config import get_config
@@ -155,21 +157,33 @@ class LLM:
         )
         if serving.replicas > 1 or serving.prefill_replicas:
             # Cluster serving (serve/cluster/): N engine replicas behind
-            # the prefix-aware router (the SpecInfer combination was
-            # rejected by validate_cluster above).
+            # the prefix-aware router. With ``ssms`` every replica runs
+            # a SpecInferManager over its OWN draft mirror engines —
+            # draft params are placed once here and shared by reference
+            # across replicas, exactly like the target's.
             from .cluster import ClusterManager
 
+            ssm_triples = []
+            for ssm in ssms:
+                ssm.params = self._place_params(
+                    ssm.family, ssm.cfg, ssm.params, pipelined,
+                    quantization, offload,
+                )
+                ssm_triples.append((ssm.family, ssm.cfg, ssm.params))
             self.rm = ClusterManager.build(
                 self.family, self.cfg, self.params, serving,
                 tokenizer=self.tokenizer, eos_token_id=eos_token_id,
-                seed=seed,
+                seed=seed, ssms=ssm_triples, spec=spec,
             )
             self.engine = self.rm.replicas[0].engine
             return
         self.engine = InferenceEngine(
             self.family, self.cfg, self.params, serving, self.mesh
         )
-        if ssms:
+        if ssms or getattr(spec, "draft", "ssm") == "early_exit":
+            # SpecInfer serving: external SSM drafts, or — with
+            # SpecConfig(draft="early_exit") and no ssms — the target
+            # self-speculating off its own truncated layer stack.
             for ssm in ssms:
                 ssm.params = self._place_params(
                     ssm.family, ssm.cfg, ssm.params, pipelined, quantization,

@@ -224,19 +224,24 @@ class PrefixCache:
     # lookup
 
     def _walk(
-        self, tokens: Sequence[int], *, peek: bool = False
+        self, tokens: Sequence[int], *, peek: bool = False,
+        limit: Optional[int] = None,
     ) -> Tuple[List[_Node], int]:
         """Longest cached prefix of ``tokens`` as tree NODES (device- or
         host-resident) plus the matched token count. Capped at
         ``len(tokens) - 1`` — the last prompt token is always
-        recomputed so its logit exists to sample the first output from.
-        Every matched node except possibly the last is a full
-        page-sized block; the last may be a partial overlap (the new
-        prompt diverges or ends inside it). ``peek`` leaves the LRU
+        recomputed so its logit exists to sample the first output from
+        — and additionally at ``limit`` when given (SpecInfer aligns
+        the LLM's and every SSM pool's matches to their common minimum,
+        serve/specinfer.py: the engines' caches must jump past the SAME
+        prefix or verification would desync). ``peek`` leaves the LRU
         ticks untouched — a read-only probe (the cluster router scores
         every replica's tree but places on at most one; a scoring walk
         must not make a losing replica's blocks look recently used)."""
-        limit = len(tokens) - 1
+        cap = len(tokens) - 1
+        if limit is not None:
+            cap = min(cap, int(limit))
+        limit = cap
         node, nodes, matched = self._root, [], 0
         tick = None if peek else next(self._tick)
         ps = self.page_size
@@ -278,12 +283,14 @@ class PrefixCache:
         nodes, matched = self._walk(tokens)
         return [n.page for n in nodes], matched
 
-    def match_len(self, tokens: Sequence[int]) -> int:
+    def match_len(self, tokens: Sequence[int],
+                  limit: Optional[int] = None) -> int:
         """Read-only probe: how many leading tokens a fresh admission
         of ``tokens`` would find cached (device OR host tier), WITHOUT
         touching LRU state. The cluster router's prefix-aware placement
-        score (serve/cluster/router.py)."""
-        _, matched = self._walk(tokens, peek=True)
+        score (serve/cluster/router.py) and SpecInfer's cross-pool
+        match alignment (serve/specinfer.py)."""
+        _, matched = self._walk(tokens, peek=True, limit=limit)
         return matched
 
     # ------------------------------------------------------------------
@@ -318,16 +325,18 @@ class PrefixCache:
         )
         return True
 
-    def attach(self, slot: int, tokens: Sequence[int]) -> int:
-        """Admission-time hit path: match ``tokens``, re-admit any
-        HOST-resident blocks on the matched path (host tier →
-        device, async upload), splice the matched pages into ``slot``'s
-        (empty) table, COW the tail page when the match ends mid-page,
-        and return the matched token count — the request's prefill
-        start offset. Falls back block-by-block when a page cannot be
-        had (truncates the match / drops the partial tail rather than
-        fail the admission); returns 0 on a miss."""
-        nodes, matched = self._walk(tokens)
+    def attach(self, slot: int, tokens: Sequence[int],
+               limit: Optional[int] = None) -> int:
+        """Admission-time hit path: match ``tokens`` (never past
+        ``limit`` when given — SpecInfer's cross-pool alignment),
+        re-admit any HOST-resident blocks on the matched path (host
+        tier → device, async upload), splice the matched pages into
+        ``slot``'s (empty) table, COW the tail page when the match ends
+        mid-page, and return the matched token count — the request's
+        prefill start offset. Falls back block-by-block when a page
+        cannot be had (truncates the match / drops the partial tail
+        rather than fail the admission); returns 0 on a miss."""
+        nodes, matched = self._walk(tokens, limit=limit)
         # Pin the whole matched path for the rest of the admission:
         # BOTH the re-admissions and the COW below may take free pages,
         # and a dry free list triggers reclaim — which must not spill,

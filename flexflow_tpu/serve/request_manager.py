@@ -95,10 +95,11 @@ class RequestManager:
     # Subclasses that keep a second engine's cache in sync (SpecInfer)
     # must not use the LLM-only fast decode pipeline.
     supports_fast_decode = True
-    # Automatic prefix caching (serve/prefix_cache.py) assumes ONE
-    # engine owns the page pool; managers that mirror slot state across
-    # engines (SpecInfer: the SSM pool pages independently, so a splice
-    # into the LLM table has no SSM counterpart) opt out.
+    # Automatic prefix caching (serve/prefix_cache.py). Managers that
+    # mirror slot state across engines (SpecInfer) maintain ONE radix
+    # tree per page pool and keep the matched lengths aligned through
+    # the _cache_attach/_cache_insert hooks — the SSM pools page
+    # independently but share the token offset math.
     supports_prefix_cache = True
     # The "sampling" decode fusion's sync path (engine.run_sampled)
     # bypasses the _run_batch hook; managers that override _run_batch
@@ -367,6 +368,37 @@ class RequestManager:
         (SpecInferManager adds its SSMs)."""
         return [self.engine]
 
+    def _prefix_caches(self):
+        """Every prefix cache this manager maintains (SpecInferManager
+        adds one radix tree per SSM pool)."""
+        return [] if self.prefix_cache is None else [self.prefix_cache]
+
+    def _cache_attach(self, slot: int, tokens: Sequence[int]) -> int:
+        """Hook: admission-time prefix-cache attach. SpecInferManager
+        overrides it to attach the SAME matched length on the LLM pool
+        and every SSM pool (or none at all) — a prefix the engines do
+        not jump past together would desync verification."""
+        return self.prefix_cache.attach(slot, tokens)
+
+    def _cache_insert(self, slot: int, tokens: Sequence[int],
+                      valid: int) -> None:
+        """Hook: publish a slot's blocks into every maintained radix
+        tree (SpecInferManager inserts into the SSM trees too — their
+        pools hold the same tokens' K/V at the same lines, paged
+        independently)."""
+        for cache in self._prefix_caches():
+            cache.insert(slot, tokens, valid)
+
+    def _mirror_dispatch(self, last, host_tokens, use_last, positions,
+                         logits_idx, key, greedy, temperature, topp,
+                         topk) -> None:
+        """Hook: managers that keep secondary engines' caches in sync
+        (SpecInfer SSM mirrors) dispatch the SAME pipelined mixed step
+        there — identical token selection (the LLM's previous sampled
+        tokens feed ``use_last`` rows), identical positions, so every
+        cache advances in lockstep without a host round-trip. The base
+        manager has no secondary engines: no-op."""
+
     def _ensure_pages(self, req: Request, num_lines: int) -> bool:
         """Cover cache lines [0, num_lines) for ``req`` on every engine.
         All-or-nothing per engine; a partial cross-engine success is
@@ -548,7 +580,7 @@ class RequestManager:
             matched = 0
             host_before = self.stats.host_hit_tokens
             if self.prefix_cache is not None:
-                matched = self.prefix_cache.attach(i, req.tokens)
+                matched = self._cache_attach(i, req.tokens)
             if self._paged and not self._ensure_pages(
                 req,
                 min(
@@ -621,9 +653,7 @@ class RequestManager:
             # Only lines written on device are valid: the final sampled
             # token's K/V never was (it would have been the next step's
             # input), so the insertable prefix ends one short.
-            self.prefix_cache.insert(
-                req.slot, req.tokens, len(req.tokens) - 1
-            )
+            self._cache_insert(req.slot, req.tokens, len(req.tokens) - 1)
         # With dispatches still in flight for this slot, defer the
         # release to the flush that drains the last of them: those
         # dispatches keep writing (garbage) K/V through the page table
@@ -832,6 +862,10 @@ class RequestManager:
             last, host_tokens, use_last, positions, sub, greedy, temp, topp,
             topk,
         )
+        self._mirror_dispatch(
+            last, host_tokens, use_last, positions,
+            np.zeros((R,), np.int32), sub, greedy, temp, topp, topk,
+        )
         self._inflight.append((toks, snapshot))
         self._prev_dispatch_slots = {s for _, s, _, _ in snapshot}
         self._step_counter += 1
@@ -911,7 +945,7 @@ class RequestManager:
                     # every prompt line's write is dispatched — publish
                     # the prompt now so concurrent same-prefix
                     # admissions hit before this request even finishes
-                    self.prefix_cache.insert(
+                    self._cache_insert(
                         s, req.tokens[: req.prompt_len], req.prompt_len
                     )
             snapshot.append((req.request_id, s, n, final))
@@ -919,6 +953,10 @@ class RequestManager:
             last = jnp.zeros((R,), jnp.int32)
         self._key, sub = jax.random.split(self._key)
         toks = eng.run_mixed(
+            last, bc.tokens, use_last, bc.positions, bc.logits_idx,
+            sub, greedy, temp, topp, topk,
+        )
+        self._mirror_dispatch(
             last, bc.tokens, use_last, bc.positions, bc.logits_idx,
             sub, greedy, temp, topp, topk,
         )
@@ -967,11 +1005,11 @@ class RequestManager:
                 and req.request_id not in self.hold_finished
             ):
                 self._release_slot(req)
-        if self.prefix_cache is not None:
-            # the flush just blocked on device_get — every async spill
-            # copy enqueued before it has landed; convert the handles
-            # to host buffers and release their device memory
-            self.prefix_cache.harvest()
+        # the flush just blocked on device_get — every async spill
+        # copy enqueued before it has landed; convert the handles
+        # to host buffers and release their device memory
+        for cache in self._prefix_caches():
+            cache.harvest()
 
     def _flush_all(self):
         if self._inflight:
@@ -1131,7 +1169,7 @@ class RequestManager:
                     self.prefix_cache is not None
                     and self.prefix_cache.policy == "prefill"
                 ):
-                    self.prefix_cache.insert(
+                    self._cache_insert(
                         req.slot, req.tokens[: req.prompt_len],
                         req.prompt_len,
                     )

@@ -22,6 +22,36 @@ attention.cu``, ``tree_inc_multihead_self_attention.cu``):
 Greedy verification: accepted output is token-identical to incremental
 greedy decoding (the property the reference's inference tests assert,
 ``tests/inference/python_inference_tests.sh:111-123``).
+
+Beyond the reference loop, speculation here is **adaptive and
+composable**:
+
+* **Acceptance-driven tree shaping** (``SpecConfig.adaptive``): a
+  per-request :class:`TreeController` tracks an EMA of the accepted
+  path length per verify round and moves the request along a BUCKETED
+  W×D ladder (``SpecConfig.bucket_ladder``) — toward narrow shallow
+  trees when the draft keeps missing (hard prompts: stop paying a wide
+  tree for one accepted token), toward the full tree when paths accept
+  at depth. Buckets — never free-form shapes — bound compilation: each
+  rung costs exactly one speculate program and one verify-chunk
+  program, proven by the retrace guard (tests/test_retrace_guard.py).
+  The controller reads acceptance from the greedy tokens the verify
+  round ALREADY fetched — no extra transfer (ffcheck FF107).
+* **Prefix caching** (``supports_prefix_cache=True``): a radix-tree
+  hit jumps the LLM *and every SSM* past the cached prefix — the
+  pools page independently but share the token offset math, so the
+  manager keeps one :class:`~.prefix_cache.PrefixCache` per pool and
+  aligns every admission's matched length across them
+  (:meth:`SpecInferManager._cache_attach`).
+* **Continuous batching**: while anyone is prefilling, requests ride
+  the PR-2 dispatch-ahead mixed step — dispatched on the LLM and
+  MIRRORED into every SSM (``_mirror_dispatch``) so all caches advance
+  in lockstep without a host round-trip; speculation rounds resume the
+  moment nobody is prefilling.
+* **Self-speculation** (``SpecConfig.draft="early_exit"``): the draft
+  is the target's own first ``draft_layers`` blocks (a layer-sliced
+  ``serve_step`` over the SAME params and paged KV — zero extra
+  model, zero extra cache), verified by the unchanged tree path.
 """
 from __future__ import annotations
 
@@ -127,6 +157,21 @@ class TokenTree:
             path.append(nxt)
             cur = nxt
 
+    def used_width(self, path: List[int]) -> bool:
+        """True when some accepted step took a child a WIDTH-1 tree
+        would not have drafted — i.e. the accepted child was not its
+        parent's highest-logprob candidate. The TreeController's
+        width-utility signal: rounds where every accepted step is the
+        draft's top pick would have committed identically from a
+        narrow tree at a fraction of the drafted tokens."""
+        for parent, node in zip(path, path[1:]):
+            kids = self._children[parent]
+            if len(kids) > 1 and node != max(
+                kids, key=lambda c: self.logprobs[c]
+            ):
+                return True
+        return False
+
 
 def merge_trees(trees: List["TokenTree"]) -> "TokenTree":
     """Merge per-SSM token trees into one deduplicated tree — the
@@ -150,33 +195,253 @@ def merge_trees(trees: List["TokenTree"]) -> "TokenTree":
     return merged
 
 
+def default_buckets(width: int, depth: int) -> Tuple[Tuple[int, int], ...]:
+    """Deterministic W×D ladder from (1, 1) up to (width, depth): depth
+    doubles first at width 1 (narrow deep chains are the cheap way to
+    keep multi-token commits when the draft is good), then width steps
+    up at full depth. Each rung costs exactly one speculate program and
+    one verify-chunk program — the bounded step-key set the retrace
+    guard asserts."""
+    ladder: List[Tuple[int, int]] = [(1, 1)]
+    d = 1
+    while d < depth:
+        d = min(depth, d * 2)
+        ladder.append((1, d))
+    w = 1
+    while w < width:
+        w = min(width, w * 2)
+        ladder.append((w, depth))
+    out: List[Tuple[int, int]] = []
+    for b in ladder:
+        if b not in out:
+            out.append(b)
+    return tuple(out)
+
+
 @dataclasses.dataclass
 class SpecConfig:
-    """Speculation shape (reference MAX_BEAM_WIDTH=3 / MAX_BEAM_DEPTH=8,
-    batch_config.h:157-161)."""
+    """Speculation shape + adaptivity (reference MAX_BEAM_WIDTH=3 /
+    MAX_BEAM_DEPTH=8, batch_config.h:157-161).
+
+    ``beam_width``/``beam_depth`` bound the token tree; with
+    ``adaptive=False`` (default) every round drafts that full shape.
+
+    ``adaptive=True`` turns on acceptance-driven tree shaping: each
+    request carries a :class:`TreeController` that EMA-tracks its
+    accepted path length and moves it along ``bucket_ladder`` — shrink
+    toward (1, 1) when acceptance is poor, grow back when paths accept
+    at full depth. ``buckets`` overrides the default ladder (must stay
+    within the configured bounds and end at the full shape — the cache
+    slack region is sized for it).
+
+    ``draft`` selects the draft source: ``"ssm"`` (external draft
+    engines, the reference's SSMs) or ``"early_exit"`` — self-
+    speculation from the target's own first ``draft_layers`` blocks
+    (LayerSkip-style): a layer-sliced ``serve_step`` over the SAME
+    params and KV cache drafts the tree, the full stack verifies it.
+    Zero extra model, zero extra cache — the verify pass re-writes
+    every tree line anyway, so the shallow draft's K/V never leaks
+    into committed state.
+    """
 
     beam_width: int = 2
     beam_depth: int = 4
+    # acceptance-driven tree shaping (TreeController)
+    adaptive: bool = False
+    buckets: Optional[Tuple[Tuple[int, int], ...]] = None
+    ema_alpha: float = 0.5
+    grow_threshold: float = 0.8
+    shrink_threshold: float = 0.3
+    # width-utility gate: the EMA of "did some accepted step take a
+    # non-top sibling" (TokenTree.used_width) must be at least this to
+    # grow into — or stay on — a wider-same-depth rung; below it the
+    # controller drops width a narrow tree would have matched for free
+    width_threshold: float = 0.1
+    # draft source: "ssm" | "early_exit"
+    draft: str = "ssm"
+    draft_layers: int = 0
+
+    def __post_init__(self):
+        if self.beam_width < 1 or self.beam_depth < 1:
+            raise ValueError(
+                f"beam_width/beam_depth must be >= 1 (got "
+                f"{self.beam_width}x{self.beam_depth})"
+            )
+        if self.draft not in ("ssm", "early_exit"):
+            raise ValueError(
+                f"unknown draft {self.draft!r} (expected 'ssm' or "
+                "'early_exit')"
+            )
+        if self.draft == "early_exit" and self.draft_layers < 1:
+            raise ValueError(
+                "draft='early_exit' needs draft_layers >= 1 — the layer "
+                "count of the target's truncated draft stack"
+            )
+        if not 0.0 < self.ema_alpha <= 1.0:
+            raise ValueError(
+                f"ema_alpha must be in (0, 1] (got {self.ema_alpha})"
+            )
+        if not 0.0 <= self.shrink_threshold < self.grow_threshold <= 1.0:
+            raise ValueError(
+                "thresholds must satisfy 0 <= shrink < grow <= 1 (got "
+                f"shrink={self.shrink_threshold}, "
+                f"grow={self.grow_threshold})"
+            )
+        if not 0.0 <= self.width_threshold <= 1.0:
+            raise ValueError(
+                f"width_threshold must be in [0, 1] (got "
+                f"{self.width_threshold})"
+            )
+        if self.buckets is not None:
+            ladder = tuple(
+                (int(w), int(d)) for w, d in self.buckets
+            )
+            if not ladder:
+                raise ValueError("buckets must be non-empty")
+            if len(set(ladder)) != len(ladder):
+                raise ValueError(f"duplicate buckets in {ladder}")
+            for w, d in ladder:
+                if not (1 <= w <= self.beam_width
+                        and 1 <= d <= self.beam_depth):
+                    raise ValueError(
+                        f"bucket {w}x{d} outside the configured bounds "
+                        f"{self.beam_width}x{self.beam_depth}"
+                    )
+            if ladder[-1] != (self.beam_width, self.beam_depth):
+                raise ValueError(
+                    "the bucket ladder must end at the configured "
+                    f"{self.beam_width}x{self.beam_depth} — the cache "
+                    "slack region is sized for the full tree"
+                )
+            if any(
+                ladder[i][0] * ladder[i][1]
+                >= ladder[i + 1][0] * ladder[i + 1][1]
+                for i in range(len(ladder) - 1)
+            ):
+                raise ValueError(
+                    f"buckets must grow strictly in tree tokens: {ladder}"
+                )
+            self.buckets = ladder
+
+    @property
+    def bucket_ladder(self) -> Tuple[Tuple[int, int], ...]:
+        """The W×D rungs adaptive shaping moves along (smallest first;
+        the single full shape when ``adaptive`` is off)."""
+        if self.buckets is not None:
+            return self.buckets
+        if not self.adaptive:
+            return ((self.beam_width, self.beam_depth),)
+        return default_buckets(self.beam_width, self.beam_depth)
 
     @property
     def max_tree_tokens(self) -> int:
         return 1 + self.beam_width * self.beam_depth
 
 
+class TreeController:
+    """Per-request acceptance-driven tree shaping.
+
+    Folds each verify round's accepted path length (drafted tokens the
+    verifier accepted) into an EMA and moves the request one rung along
+    the bucket ladder when the EMA leaves the hysteresis band: EMA ≤
+    ``shrink_threshold``·D shrinks, EMA ≥ ``grow_threshold``·D grows —
+    but only depth earns growth for free. WIDTH is gated on its own
+    utility EMA (``TokenTree.used_width``: did an accepted step take a
+    non-top sibling?): a request whose fully-accepted chains never
+    touch a second branch will not grow into a wider rung, and when it
+    is already sitting on one it steps DOWN to the narrow same-depth
+    rung — the narrow tree would have committed the identical path at
+    a fraction of the drafted tokens, which is exactly the drafted-
+    accept-rate waste this controller exists to cut.
+
+    On a resize the EMA is clamped INTO the new rung's band so one
+    stale average cannot chain resizes — the trajectory is a pure,
+    deterministic function of the acceptance sequence, and the
+    acceptance sequence itself comes from the greedy tokens the verify
+    round already fetched (no extra ``device_get``, ffcheck FF107).
+
+    Starts at the FULL tree (the fixed-shape baseline's behavior) and
+    earns its way down: a cold request speculates exactly like the
+    non-adaptive manager until its own acceptance says otherwise.
+    """
+
+    def __init__(self, spec: SpecConfig):
+        self.spec = spec
+        self.ladder = spec.bucket_ladder
+        self.idx = len(self.ladder) - 1
+        # mid-band prior: "good enough to stay" — not "perfect". A
+        # perfect-acceptance prior would make a hard prompt pay several
+        # full-size rounds just to walk the EMA down; mid-band keeps the
+        # cold request at the baseline shape yet lets ONE bad round
+        # start the descent.
+        depth = float(self.ladder[self.idx][1])
+        self.ema = 0.5 * (
+            spec.shrink_threshold + spec.grow_threshold
+        ) * depth
+        self.width_ema = 1.0                        # width presumed useful
+        self.resizes = 0
+
+    @property
+    def bucket(self) -> Tuple[int, int]:
+        return self.ladder[self.idx]
+
+    def observe(self, accepted_len: int, used_width: bool = False) -> bool:
+        """Record one round's accepted path length (and whether tree
+        width contributed to it); returns True when the bucket
+        changed."""
+        a = self.spec.ema_alpha
+        width, depth = self.bucket
+        self.ema = (1.0 - a) * self.ema + a * float(accepted_len)
+        self.width_ema = (1.0 - a) * self.width_ema + a * float(
+            bool(used_width)
+        )
+        frac = self.ema / depth
+        move = 0
+        if frac <= self.spec.shrink_threshold and self.idx > 0:
+            move = -1
+        elif frac >= self.spec.grow_threshold:
+            nxt = (
+                self.ladder[self.idx + 1]
+                if self.idx + 1 < len(self.ladder) else None
+            )
+            prv = self.ladder[self.idx - 1] if self.idx > 0 else None
+            if nxt is not None and (
+                nxt[1] > depth
+                or self.width_ema >= self.spec.width_threshold
+            ):
+                move = 1
+            elif (
+                prv is not None and prv[1] == depth and prv[0] < width
+                and self.width_ema < self.spec.width_threshold
+            ):
+                # fully-accepting chains that never used a sibling:
+                # drop the width, keep the depth
+                move = -1
+        if move == 0:
+            return False
+        self.idx += move
+        self.resizes += 1
+        _, new_depth = self.bucket
+        lo = self.spec.shrink_threshold * new_depth
+        hi = self.spec.grow_threshold * new_depth
+        self.ema = min(max(self.ema, lo), hi)
+        return True
+
+
 class SpecInferManager(RequestManager):
     """Request manager driving the SSM-speculate → LLM-verify loop.
 
-    The LLM engine and SSM engine share slot assignment and serving
-    limits; both caches always hold the same committed prefix per slot.
+    The LLM engine and SSM engines share slot assignment and serving
+    limits; all caches always hold the same committed prefix per slot.
+    With ``SpecConfig.draft="early_exit"`` there are no SSM engines at
+    all — the LLM drafts off its own truncated layer stack.
     """
 
-    # The fused decode pipeline bypasses _run_batch and would desync the
-    # SSM cache; spec rounds have their own device-side batching anyway.
+    # The LLM-only fast decode pipeline bypasses _run_batch and would
+    # desync the SSM caches; pure-decode iterations run speculation
+    # rounds instead, and prefill churn goes through the pipelined
+    # mixed step WITH the SSM mirror (_mirror_dispatch).
     supports_fast_decode = False
-    # Prefix caching splices pages in ONE engine's pool; the SSM pools
-    # page independently, so a spliced LLM prefix would leave the SSM
-    # cache cold and desync verification — opt out.
-    supports_prefix_cache = False
     # run_sampled bypasses the _run_batch hook that keeps the SSM cache
     # in step with the LLM's — the fused sampling sync path would
     # desync verification, so spec managers keep step + host sample.
@@ -185,19 +450,39 @@ class SpecInferManager(RequestManager):
     def __init__(
         self,
         llm_engine: InferenceEngine,
-        ssm_engines,  # one engine or a list (multi-SSM tree merge)
+        ssm_engines=None,  # engine | [engines] | None (early-exit draft)
         spec: Optional[SpecConfig] = None,
         tokenizer: Any = None,
         eos_token_id: Optional[int] = None,
         seed: int = 0,
         output_file: Optional[str] = None,
     ):
-        super().__init__(llm_engine, tokenizer, eos_token_id, seed, output_file)
         if isinstance(ssm_engines, InferenceEngine):
             ssm_engines = [ssm_engines]
-        self.ssms: List[InferenceEngine] = list(ssm_engines)
-        assert self.ssms, "SpecInferManager needs at least one SSM"
+        self.ssms: List[InferenceEngine] = list(ssm_engines or [])
         self.spec = spec or SpecConfig()
+        if self.spec.draft == "early_exit":
+            if self.ssms:
+                raise ValueError(
+                    "draft='early_exit' self-speculates off the target's "
+                    "own truncated layer stack — external SSM engines "
+                    "cannot be combined with it (drop ssms or use "
+                    "draft='ssm')"
+                )
+            L = llm_engine.cfg.num_hidden_layers
+            if not 1 <= self.spec.draft_layers < L:
+                raise ValueError(
+                    f"draft_layers={self.spec.draft_layers} must be in "
+                    f"[1, {L - 1}] for this target ({L} layers): the "
+                    "draft must be a strict prefix of the verifier's "
+                    "stack"
+                )
+        elif not self.ssms:
+            raise ValueError(
+                "SpecInferManager needs at least one SSM engine (or "
+                "SpecConfig(draft='early_exit') to self-speculate)"
+            )
+        super().__init__(llm_engine, tokenizer, eos_token_id, seed, output_file)
         for ssm_engine in self.ssms:
             assert (
                 ssm_engine.num_slots == llm_engine.num_slots
@@ -208,7 +493,7 @@ class SpecInferManager(RequestManager):
                 "clipped at the verifier's embedding"
             )
         # A merged multi-SSM tree is at worst the concatenation of the
-        # per-SSM trees (dedup only shrinks it).
+        # per-SSM trees (dedup only shrinks it) at the LADDER MAX shape.
         assert (
             self.max_merged_tokens <= llm_engine.serving.max_spec_tree_tokens
         ), "merged tree larger than the cache's speculative slack region"
@@ -216,10 +501,39 @@ class SpecInferManager(RequestManager):
             getattr(s, "paged", False) == getattr(llm_engine, "paged", False)
             for s in self.ssms
         ), "LLM and SSM engines must agree on kv_layout"
+        # per-request adaptive tree controllers (SpecConfig.adaptive)
+        self._controllers: Dict[int, TreeController] = {}
+        # Prefix caching: one radix tree per SSM pool, kept in lockstep
+        # with the LLM's through the _cache_attach/_cache_insert hooks
+        # (insert publishes the same blocks everywhere; attach aligns
+        # every pool to the common matched length). The SSM trees carry
+        # no stats sink (the LLM pool's counters are THE telemetry) and
+        # no host spill tier (the LLM tier is the capacity story; an
+        # SSM-side miss only shortens the common match).
+        self.ssm_prefix_caches: List[Any] = []
+        if self.prefix_cache is not None:
+            from .prefix_cache import PrefixCache
+
+            for ssm_engine in self.ssms:
+                pc = PrefixCache(
+                    ssm_engine.pager,
+                    copy_page=ssm_engine.copy_page,
+                    policy=llm_engine.serving.cache_policy,
+                )
+                ssm_engine.pager.reclaim_cb = pc.reclaim
+                self.ssm_prefix_caches.append(pc)
+
+    @property
+    def n_drafts(self) -> int:
+        """Independent draft trees per round: the SSM count, or one for
+        the early-exit self-draft."""
+        return max(1, len(self.ssms))
 
     @property
     def max_merged_tokens(self) -> int:
-        return 1 + len(self.ssms) * (self.spec.max_tree_tokens - 1)
+        return 1 + self.n_drafts * (
+            self.spec.beam_width * self.spec.beam_depth
+        )
 
     @property
     def ssm(self) -> InferenceEngine:
@@ -232,11 +546,62 @@ class SpecInferManager(RequestManager):
         engine)."""
         return [self.engine, *self.ssms]
 
+    def _prefix_caches(self):
+        return super()._prefix_caches() + self.ssm_prefix_caches
+
+    # ------------------------------------------------------------------
+    # adaptive tree shaping
+
+    def _ctrl(self, req: Request) -> TreeController:
+        ctrl = self._controllers.get(req.request_id)
+        if ctrl is None:
+            ctrl = self._controllers[req.request_id] = TreeController(
+                self.spec
+            )
+        return ctrl
+
+    def _bucket(self, req: Request) -> Tuple[int, int]:
+        """This request's CURRENT tree shape."""
+        if not self.spec.adaptive:
+            return (self.spec.beam_width, self.spec.beam_depth)
+        return self._ctrl(req).bucket
+
+    def _tree_tokens(self, req: Request) -> int:
+        W, D = self._bucket(req)
+        return 1 + self.n_drafts * W * D
+
     def _spec_lines(self, req: Request) -> int:
-        """Cache lines a speculate→verify→commit round touches: the
-        committed prefix plus the merged tree's slack lines (node i
-        writes line prefix + i)."""
-        return req.n_cached + self.max_merged_tokens + 1
+        """Cache lines a speculate→verify→commit round touches for THIS
+        request: the committed prefix plus its CURRENT tree's slack
+        lines (node i writes line prefix + i) — a controller-shrunk
+        tree reserves proportionally fewer pages."""
+        return req.n_cached + self._tree_tokens(req) + 1
+
+    # ------------------------------------------------------------------
+    # prefix-cache composition
+
+    def _cache_attach(self, slot: int, tokens) -> int:
+        """Attach the SAME matched prefix on the LLM pool and every SSM
+        pool, or none at all: the engines must jump past an identical
+        prefix or the SSM would draft over cold cache lines the
+        verifier trusts. The common match is the MINIMUM of the
+        per-pool probes; if any pool then fails to materialize it
+        (page shortage mid-splice), every pool rolls back to a cold
+        admission."""
+        caches = [self.prefix_cache, *self.ssm_prefix_caches]
+        m = min(pc.match_len(tokens) for pc in caches)
+        if m <= 0:
+            return 0
+        got = self.prefix_cache.attach(slot, tokens, limit=m)
+        ok = got > 0
+        for pc in self.ssm_prefix_caches:
+            if not ok:
+                break
+            ok = pc.attach(slot, tokens, limit=got) == got
+        if not ok:
+            self._release_pages(slot)
+            return 0
+        return got
 
     # ------------------------------------------------------------------
     # batch builders
@@ -252,12 +617,15 @@ class SpecInferManager(RequestManager):
         """Batch feeding, per request, the tree nodes in ``node_lists``
         (new frontier for SSM expansion; all nodes for LLM verify).
         RoPE position = prefix + depth; cache line = prefix + node index;
-        mask = committed prefix + ancestors-or-self."""
+        mask = committed prefix + ancestors-or-self. ``spec_nodes``
+        records the per-slot node count — with adaptive shaping the
+        rows of a (bucketed) verify dispatch are ragged in tree size."""
         S1 = engine.serving.cache_len + 1
         R = engine.num_slots
         bc = BatchConfig.empty(R, chunk, engine.scratch_pos)
         bc.cache_positions = np.full((R, chunk), engine.scratch_pos, np.int32)
         bc.mask = np.zeros((R, chunk, S1), bool)
+        bc.spec_nodes = np.zeros((R,), np.int32)
         for req in reqs:
             tree = trees[req.request_id]
             nodes = node_lists[req.request_id]
@@ -269,6 +637,7 @@ class SpecInferManager(RequestManager):
                 bc.cache_positions[req.slot, c] = prefix + node
                 bc.mask[req.slot, c, :prefix] = True
                 bc.mask[req.slot, c, prefix : prefix + len(tree)] = anc[node]
+            bc.spec_nodes[req.slot] = len(nodes)
             bc.active[req.slot] = True
         if getattr(engine, "paged", False):
             bc.page_table = engine.pager.table.copy()
@@ -278,19 +647,22 @@ class SpecInferManager(RequestManager):
     # the SpecInfer round
 
     def _grow_trees_one_ssm(
-        self, ssm: InferenceEngine, reqs: List[Request]
+        self, ssm: InferenceEngine, reqs: List[Request], W: int, D: int,
+        num_layers: Optional[int] = None,
     ) -> Dict[int, TokenTree]:
-        """One SSM's beam expansion (reference prepare_next_batch_beam
+        """One draft's beam expansion (reference prepare_next_batch_beam
         loop, request_manager.cc:2397-2407), executed as a single
         device-side program: the whole depth × top-W expansion runs in
         one compiled scan (engine.run_speculate) and the host fetches
         the finished tree in one transfer — no per-depth round trips.
+        ``num_layers`` routes the expansion through the layer-sliced
+        early-exit step (self-speculation: ``ssm`` is then the LLM
+        engine itself).
 
         Trees are built WITHOUT (parent, token) dedup so node index i
         stays identical to the cache slack line prefix+i the device
         wrote (duplicates merely occupy verify slots the tree budget
         already reserves)."""
-        W, D = self.spec.beam_width, self.spec.beam_depth
         R = self.engine.num_slots
         root = np.zeros((R,), np.int32)
         prefix = np.full((R,), self.engine.scratch_pos, np.int32)
@@ -301,7 +673,8 @@ class SpecInferManager(RequestManager):
             active[req.slot] = True
         # ffcheck: disable=FF107 -- SpecInfer fetches the finished speculation tree in ONE transfer per round by design (the host builds the verify batch from it)
         toks, parents, logps = jax.device_get(
-            ssm.run_speculate(root, prefix, active, W, D)
+            ssm.run_speculate(root, prefix, active, W, D,
+                              num_layers=num_layers)
         )  # one transfer; each (D, R, W)
         toks, parents, logps = (
             np.asarray(toks), np.asarray(parents), np.asarray(logps)
@@ -323,11 +696,22 @@ class SpecInferManager(RequestManager):
             req.profile.ssm_decoding_steps += D
         return trees
 
-    def _grow_trees(self, reqs: List[Request]) -> Dict[int, TokenTree]:
-        """All SSMs speculate independently; their trees merge with
-        dedup (reference generate_spec_infer's per-SSM loop +
-        merge_dfs_trees, request_manager.cc:2397-2410)."""
-        per_ssm = [self._grow_trees_one_ssm(ssm, reqs) for ssm in self.ssms]
+    def _grow_trees(
+        self, reqs: List[Request], W: int, D: int
+    ) -> Dict[int, TokenTree]:
+        """All drafts speculate independently at this round's W×D; their
+        trees merge with dedup (reference generate_spec_infer's per-SSM
+        loop + merge_dfs_trees, request_manager.cc:2397-2410). The
+        early-exit draft is the LLM engine itself through the
+        layer-sliced step — one tree, nothing to merge."""
+        if self.spec.draft == "early_exit":
+            return self._grow_trees_one_ssm(
+                self.engine, reqs, W, D,
+                num_layers=self.spec.draft_layers,
+            )
+        per_ssm = [
+            self._grow_trees_one_ssm(ssm, reqs, W, D) for ssm in self.ssms
+        ]
         if len(per_ssm) == 1:
             return per_ssm[0]
         return {
@@ -338,12 +722,16 @@ class SpecInferManager(RequestManager):
         }
 
     def _verify_and_commit(
-        self, reqs: List[Request], trees: Dict[int, TokenTree]
+        self, reqs: List[Request], trees: Dict[int, TokenTree],
+        W: int, D: int,
     ):
         """LLM tree-verify step + greedy acceptance + KV commit on all
         caches (reference prepare_next_batch_verify + tree attention +
-        commit_tokens)."""
-        C = self.max_merged_tokens
+        commit_tokens). The verify chunk is the ROUND's bucket size —
+        one compiled program per ladder rung; the commit src/dst keep
+        the LADDER-MAX path shape so every bucket shares one commit
+        program."""
+        C = 1 + self.n_drafts * (W * D)
         node_lists = {
             r.request_id: list(range(len(trees[r.request_id]))) for r in reqs
         }
@@ -354,7 +742,7 @@ class SpecInferManager(RequestManager):
         accepted: Dict[int, Tuple[int, List[int]]] = {}  # rid -> (slot, path tokens)
 
         R = self.engine.num_slots
-        K = self.spec.beam_depth + 1  # deepest acceptable path (any SSM)
+        K = self.spec.beam_depth + 1  # ladder-max acceptable path
         scratch = self.engine.scratch_pos
         src = np.full((R, K), scratch, np.int32)
         dst = np.full((R, K), scratch, np.int32)
@@ -365,9 +753,31 @@ class SpecInferManager(RequestManager):
             for k, node in enumerate(path):
                 src[req.slot, k] = prefix + node
                 dst[req.slot, k] = prefix + k
-            req.profile.speculated_tokens += len(tree) - 1
-            req.profile.accepted_tokens += len(path) - 1
+            drafted = len(tree) - 1
+            n_accepted = len(path) - 1
+            req.profile.speculated_tokens += drafted
+            req.profile.accepted_tokens += n_accepted
             req.profile.llm_decoding_steps += 1
+            req.profile.spec_rounds += 1
+            self.stats.spec_rounds += 1
+            self.stats.spec_drafted += drafted
+            self.stats.spec_accepted += n_accepted
+            if self.spec.adaptive:
+                # the controller reads acceptance from the ALREADY
+                # fetched greedy walk — no extra transfer (FF107)
+                ctrl = self._ctrl(req)
+                if ctrl.observe(n_accepted, tree.used_width(path)):
+                    self.stats.spec_resizes += 1
+                    self._log.debug(
+                        "spec resize: request %d %dx%d -> %dx%d "
+                        "(ema %.2f, accepted %d)",
+                        req.request_id, W, D, ctrl.bucket[0],
+                        ctrl.bucket[1], ctrl.ema, n_accepted,
+                    )
+                req.profile.tree_resizes = ctrl.resizes
+                req.profile.tree_width, req.profile.tree_depth = ctrl.bucket
+            else:
+                req.profile.tree_width, req.profile.tree_depth = W, D
             # Tokens: path nodes beyond the root are newly committed
             # outputs; the bonus token is the LLM's own next sample.
             new_tokens = [tree.tokens[n] for n in path[1:]] + [bonus]
@@ -378,8 +788,14 @@ class SpecInferManager(RequestManager):
             for t in new_tokens:
                 if req.status is RequestStatus.DECODING:
                     self._append_token(req, t)
+            if req.status is not RequestStatus.DECODING:
+                self._controllers.pop(req.request_id, None)
         self.engine.commit(src, dst)
-        if len(self.ssms) == 1:
+        if self.spec.draft == "early_exit":
+            # self-draft: ONE cache — the engine commit above already
+            # moved the verifier's (and therefore the draft's) lines
+            pass
+        elif len(self.ssms) == 1:
             # Single SSM: the merged tree IS its own tree, so the
             # accepted nodes sit at the same slack lines — cheap line
             # move.
@@ -433,24 +849,64 @@ class SpecInferManager(RequestManager):
             ssm.run(bc)  # same tokens into every SSM cache
         return logits
 
+    def _mirror_dispatch(self, last, host_tokens, use_last, positions,
+                         logits_idx, key, greedy, temperature, topp,
+                         topk) -> None:
+        """Continuous-batching composition: dispatch the SAME pipelined
+        mixed step into every SSM. The LLM's previous sampled tokens
+        (``last``) feed the ``use_last`` rows of BOTH programs, so each
+        SSM writes K/V for exactly the token sequence the LLM is
+        decoding — the SSM's own sampled output is discarded. The
+        early-exit self-draft has no SSMs (one cache): nothing to
+        mirror."""
+        for ssm in self.ssms:
+            ssm.run_mixed(last, host_tokens, use_last, positions,
+                          logits_idx, key, greedy, temperature, topp, topk)
+
     def step(self) -> bool:
         """One SpecInfer scheduling step (reference generate_spec_infer
         loop body). While anyone is prefilling, the mixed batch (prefill
-        chunks + decode tokens) goes through BOTH engines (the
-        ``_run_batch`` hook) so decoding slots keep making one-token
-        progress with the caches in sync — no head-of-line blocking;
-        otherwise one full speculate→verify→commit round runs for all
-        decoding requests."""
+        chunks + decode tokens) runs through EVERY engine — pipelined
+        via the PR-2 mixed step with the SSM mirror under
+        ``continuous_batching`` (admissions and chunk progression never
+        drain the pipeline), or the blocking sync batch otherwise — so
+        decoding slots keep making one-token progress with the caches
+        in sync (no head-of-line blocking). Once nobody is prefilling,
+        the pipeline is drained and one full speculate→verify→commit
+        round runs per W×D bucket present among the decoding requests
+        (adaptive controllers group them; non-adaptive = one bucket)."""
         self._admit_pending()
+        sc = self.engine.serving
         if self._active(RequestStatus.PREFILLING):
-            return super().step()
+            if sc.continuous_batching and not sc.inference_debugging:
+                self._reclaim_slots_for_admission()
+                self._reserve_active_pages(
+                    lambda r: self._lines_needed(r, sc.mixed_chunk)
+                )
+                return self._step_pipelined(mixed=True)
+            return self._step_sync()
+        # speculation rounds read host-side roots (req.tokens[-1]) —
+        # drain whatever the pipelined prefill phase left in flight
+        self._flush_all()
         # paged KV: a spec round writes the whole tree's slack lines —
-        # reserve prefix + merged-tree pages on the LLM and every SSM
+        # reserve prefix + tree pages (per-request shapes) on the LLM
+        # and every SSM
         self._reserve_active_pages(self._spec_lines)
         decoding = self._active(RequestStatus.DECODING)
-        if decoding:
-            trees = self._grow_trees(decoding)
-            self._verify_and_commit(decoding, trees)
-            self._step_counter += 1
-            return True
-        return bool(self.pending)
+        if not decoding:
+            return bool(self.pending)
+        groups: Dict[Tuple[int, int], List[Request]] = {}
+        for req in decoding:
+            groups.setdefault(self._bucket(req), []).append(req)
+        for bucket in sorted(groups):
+            reqs = [
+                r for r in groups[bucket]
+                if r.status is RequestStatus.DECODING
+            ]
+            if not reqs:
+                continue  # an earlier bucket's round completed them
+            trees = self._grow_trees(reqs, *bucket)
+            self._verify_and_commit(reqs, trees, *bucket)
+        self._step_counter += 1
+        self._maybe_log_stats()
+        return True

@@ -28,33 +28,39 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== premerge 1/6: ffcheck (static hazard lint)" >&2
+echo "== premerge 1/7: ffcheck (static hazard lint)" >&2
 python scripts/ffcheck.py
 
-echo "== premerge 2/6: family serve-API re-exports" >&2
+echo "== premerge 2/7: family serve-API re-exports" >&2
 python scripts/check_family_reexports.py
 
-echo "== premerge 3/6: fused decode parity + retrace guard" >&2
+echo "== premerge 3/7: fused decode parity + retrace guard" >&2
 # unfiltered: runs the interpret-mode Pallas e2e tests that tier-1
 # slow-marks for time-budget reasons
 python -m pytest tests/test_fused_decode.py tests/test_retrace_guard.py \
     -q -p no:cacheprovider
 
-echo "== premerge 4/6: hierarchical KV cache (int4 + host spill)" >&2
+echo "== premerge 4/7: hierarchical KV cache (int4 + host spill)" >&2
 # Pallas/XLA nibble-unpack parity, bitwise cold/warm/spilled-readmit
 # generation parity over fp+int8+int4 pools, spill-tier bookkeeping
 python -m pytest tests/test_kv_hierarchy.py -q -p no:cacheprovider
 
-echo "== premerge 5/6: cluster serving (router + migration)" >&2
+echo "== premerge 5/7: cluster serving (router + migration)" >&2
 # router units, cluster-vs-bare-engine bitwise parity, disaggregated
 # prefill→decode migration over fp/int8/int4, shed-is-terminal
 python -m pytest tests/test_cluster.py -q -p no:cacheprovider
 
-echo "== premerge 6/6: fault-tolerant cluster serving" >&2
+echo "== premerge 6/7: fault-tolerant cluster serving" >&2
 # health state machine + circuit breaker, deterministic FaultPlan
 # injection, replica-death failover bitwise vs the fault-free run,
 # seeded chaos (every request terminal, zero leaks on survivors),
 # migration queue back-pressure, pool-death fallbacks
 python -m pytest tests/test_cluster_faults.py -q -p no:cacheprovider
+
+echo "== premerge 7/7: adaptive speculation" >&2
+# tree-shaping controller units, spec==incremental bitwise parity over
+# fp/int8/int4 pools + prefix-cache hits + continuous-batching churn,
+# early-exit self-draft, cluster SSM-mirror smoke
+python -m pytest tests/test_adaptive_spec.py -q -p no:cacheprovider
 
 echo "premerge: all gates passed" >&2

@@ -1,0 +1,497 @@
+"""Adaptive speculation — acceptance-driven tree shaping, the early-exit
+self-draft, and the SpecInfer composition walls this PR lifted.
+
+The defining invariant everywhere: speculation changes the SPEED, never
+the tokens — adaptive resizes, prefix-cache hits, continuous-batching
+churn, preemption and cluster placement must all produce output
+token-identical to plain incremental greedy decoding. On quantized
+pools the same model/seed discipline as tests/test_kv_quant.py applies
+(the spec==incremental equality is asserted on these models/seeds; the
+one documented exception is early-exit × int4, where the self-draft's
+extra slack-line writes perturb the int4 page-scale history — 16x
+coarser grid than int8 — and the assertion is run-to-run bitwise
+determinism + high greedy agreement instead, mirroring the PR-7 int4
+scale-history caveats).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.models import llama
+from flexflow_tpu.serve import (
+    ClusterManager,
+    InferenceEngine,
+    RequestManager,
+    ServingConfig,
+    SpecConfig,
+    SpecInferManager,
+)
+from flexflow_tpu.serve.specinfer import TreeController, default_buckets
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def tiny_ssm():
+    # a weak 1-layer layer-skip draft: partial acceptance -> resize churn
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32, num_hidden_layers=1)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def layer_skip(tiny, k=1):
+    cfg, params = tiny
+    import dataclasses
+
+    dcfg = dataclasses.replace(cfg, num_hidden_layers=k)
+    dparams = dict(params)
+    dparams["layers"] = {n: v[:k] for n, v in params["layers"].items()}
+    return dcfg, dparams
+
+
+def make_sc(**kw):
+    d = dict(
+        max_requests_per_batch=4,
+        max_sequence_length=96,
+        prefill_chunk=8,
+        max_spec_tree_tokens=16,
+        cache_dtype=jnp.float32,
+    )
+    d.update(kw)
+    return ServingConfig(**d)
+
+
+def make_engine(model_params, **kw):
+    cfg, params = model_params
+    return InferenceEngine(llama, cfg, params, make_sc(**kw))
+
+
+PROMPTS = [[3, 17, 91, 42, 7], [9, 8, 7], [42] * 9, [5, 9, 2, 11]]
+
+
+def incr_ref(tiny, prompts=PROMPTS, n_new=12, **sc_kw):
+    rm = RequestManager(make_engine(tiny, **sc_kw))
+    return [o.output_tokens for o in rm.generate(prompts, max_new_tokens=n_new)]
+
+
+# ---------------------------------------------------------------------------
+# controller units
+
+
+class TestController:
+    def test_default_ladder(self):
+        assert default_buckets(2, 4) == ((1, 1), (1, 2), (1, 4), (2, 4))
+        assert default_buckets(1, 1) == ((1, 1),)
+        assert default_buckets(3, 8) == (
+            (1, 1), (1, 2), (1, 4), (1, 8), (2, 8), (3, 8)
+        )
+        for w, d in ((2, 4), (3, 8), (1, 6)):
+            ladder = default_buckets(w, d)
+            assert ladder[-1] == (w, d)
+            toks = [a * b for a, b in ladder]
+            assert toks == sorted(set(toks))  # strictly increasing
+            assert all(1 <= a <= w and 1 <= b <= d for a, b in ladder)
+
+    def test_non_adaptive_ladder_is_the_fixed_shape(self):
+        assert SpecConfig(2, 4).bucket_ladder == ((2, 4),)
+        assert SpecConfig(2, 4, adaptive=True).bucket_ladder == \
+            default_buckets(2, 4)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SpecConfig(draft="nope")
+        with pytest.raises(ValueError):
+            SpecConfig(draft="early_exit")  # draft_layers missing
+        with pytest.raises(ValueError):
+            SpecConfig(2, 4, ema_alpha=0.0)
+        with pytest.raises(ValueError):
+            SpecConfig(2, 4, shrink_threshold=0.9, grow_threshold=0.8)
+        with pytest.raises(ValueError):
+            SpecConfig(2, 4, width_threshold=1.5)
+        with pytest.raises(ValueError):
+            SpecConfig(2, 4, buckets=((1, 1), (3, 4), (2, 4)))  # w > beam
+        with pytest.raises(ValueError):
+            SpecConfig(2, 4, buckets=((1, 1), (1, 2)))  # no full shape
+        with pytest.raises(ValueError):
+            SpecConfig(2, 4, buckets=((2, 4), (1, 1), (2, 4)))  # dup
+        with pytest.raises(ValueError):
+            SpecConfig(2, 4, buckets=((1, 4), (2, 2), (2, 4)))  # not incr.
+        # a valid custom ladder round-trips
+        assert SpecConfig(2, 4, buckets=((1, 2), (2, 4))).bucket_ladder == \
+            ((1, 2), (2, 4))
+
+    def test_shrink_then_grow_is_deterministic_and_bounded(self):
+        spec = SpecConfig(2, 4, adaptive=True)
+
+        def run(seq):
+            ctrl = TreeController(spec)
+            traj = []
+            for acc, uw in seq:
+                ctrl.observe(acc, uw)
+                traj.append((ctrl.idx, round(ctrl.ema, 6), ctrl.resizes))
+            return ctrl, traj
+
+        seq = [(0, False)] * 8 + [(1, False)] * 10 + [(0, False)] * 8
+        c1, t1 = run(seq)
+        c2, t2 = run(seq)
+        assert t1 == t2, "controller trajectory must be deterministic"
+        # sustained zero acceptance bottoms out at (1, 1) and stays
+        ctrl, _ = run([(0, False)] * 20)
+        assert ctrl.bucket == (1, 1)
+        assert 0 <= ctrl.idx < len(spec.bucket_ladder)
+        # sustained full-depth acceptance climbs the depth rungs
+        ctrl = TreeController(spec)
+        for _ in range(20):
+            ctrl.observe(ctrl.bucket[1], used_width=True)
+        assert ctrl.bucket == (2, 4)  # width kept: it is being used
+        assert ctrl.resizes >= 1 or ctrl.idx == len(spec.bucket_ladder) - 1
+
+    def test_width_drop_when_chains_never_use_it(self):
+        """Full-depth acceptance that never takes a second branch drops
+        the width rung (same committed tokens, half the drafted ones)
+        and does NOT climb back into it."""
+        spec = SpecConfig(2, 4, adaptive=True)
+        ctrl = TreeController(spec)
+        assert ctrl.bucket == (2, 4)
+        for _ in range(12):
+            ctrl.observe(4, used_width=False)
+        assert ctrl.bucket == (1, 4)
+        before = ctrl.resizes
+        for _ in range(12):
+            ctrl.observe(4, used_width=False)
+        assert ctrl.bucket == (1, 4) and ctrl.resizes == before
+
+    def test_used_width_signal(self):
+        from flexflow_tpu.serve import TokenTree
+
+        t = TokenTree(5)
+        a, _ = t.add(1, 0, -0.1)   # top child of root
+        b, _ = t.add(2, 0, -0.5)   # second branch
+        c, _ = t.add(3, a, -0.2)
+        assert not t.used_width([0, a, c])  # pure top-pick chain
+        assert t.used_width([0, b])         # second branch accepted
+        assert not t.used_width([0])        # nothing accepted
+
+
+# ---------------------------------------------------------------------------
+# greedy parity across resizes and pools
+
+
+class TestAdaptiveParity:
+    def test_adaptive_matches_incremental_dense(self, tiny, tiny_ssm):
+        ref = incr_ref(tiny)
+        mgr = SpecInferManager(
+            make_engine(tiny), make_engine(tiny_ssm),
+            SpecConfig(2, 4, adaptive=True),
+        )
+        outs = mgr.generate(PROMPTS, max_new_tokens=12)
+        assert [o.output_tokens for o in outs] == ref
+        assert mgr.stats.spec_resizes > 0, "no resize churn exercised"
+        assert all(
+            (o.profile.tree_width, o.profile.tree_depth)
+            in mgr.spec.bucket_ladder for o in outs
+        )
+
+    # the int4 variant is slow-marked for the tier-1 time budget; the
+    # premerge gate (scripts/premerge.sh 7/7) runs it unfiltered
+    @pytest.mark.parametrize("kv_quant", [
+        None,
+        pytest.param("int8", marks=pytest.mark.slow),
+        pytest.param("int4", marks=pytest.mark.slow),
+    ])
+    def test_adaptive_matches_incremental_paged(self, tiny, tiny_ssm,
+                                                kv_quant):
+        kw = dict(kv_layout="paged", page_size=16, kv_quant=kv_quant)
+        ref = incr_ref(tiny, n_new=8, **kw)
+        mgr = SpecInferManager(
+            make_engine(tiny, **kw), make_engine(tiny_ssm, **kw),
+            SpecConfig(2, 4, adaptive=True),
+        )
+        outs = mgr.generate(PROMPTS, max_new_tokens=8)
+        assert [o.output_tokens for o in outs] == ref, kv_quant
+        assert mgr.stats.spec_resizes > 0
+        for eng in (mgr.engine, mgr.ssm):
+            eng.pager.check_no_leaks()
+            assert eng.pager.free_pages == eng.pager.num_pages
+
+    def test_spec_telemetry(self, tiny, tiny_ssm):
+        mgr = SpecInferManager(
+            make_engine(tiny), make_engine(tiny_ssm),
+            SpecConfig(2, 4, adaptive=True),
+        )
+        outs = mgr.generate(PROMPTS[:2], max_new_tokens=8)
+        s = mgr.stats
+        assert s.spec_rounds > 0 and s.spec_drafted > 0
+        assert 0.0 <= s.spec_accept_rate <= 1.0
+        snap = s.snapshot()
+        for key in ("spec_rounds", "spec_drafted", "spec_accepted",
+                    "spec_resizes", "spec_accept_rate"):
+            assert key in snap
+        assert "spec=" in s.report()
+        for o in outs:
+            assert o.profile.spec_rounds > 0
+            assert o.profile.tree_width >= 1 and o.profile.tree_depth >= 1
+            # free root/bonus tokens in NEITHER side of the rate
+            assert o.profile.accepted_tokens <= o.profile.speculated_tokens
+
+
+# ---------------------------------------------------------------------------
+# early-exit self-speculation
+
+
+class TestEarlyExit:
+    def test_matches_incremental_dense_and_paged(self, tiny):
+        ref = incr_ref(tiny)
+        for kw in ({}, dict(kv_layout="paged", page_size=16)):
+            mgr = SpecInferManager(
+                make_engine(tiny, **kw), None,
+                SpecConfig(2, 3, draft="early_exit", draft_layers=1),
+            )
+            outs = mgr.generate(PROMPTS, max_new_tokens=12)
+            assert [o.output_tokens for o in outs] == ref, kw
+            assert mgr.ssms == []  # zero extra engines
+            assert sum(o.profile.ssm_decoding_steps for o in outs) > 0
+            assert sum(o.profile.speculated_tokens for o in outs) > 0
+
+    def test_redundant_target_accepts_deep(self, tiny):
+        """On a target whose deep layer refines little (the trained-
+        checkpoint regime LayerSkip exploits, emulated by damping the
+        layer-2 residual projections), the early-exit draft accepts
+        multi-token paths and the verifier takes fewer steps than
+        tokens."""
+        cfg, params = tiny
+        layers = dict(params["layers"])
+        for name in ("wo", "w2"):
+            w = layers[name]
+            layers[name] = jnp.concatenate([w[:1], w[1:] * 0.02], axis=0)
+        damped = dict(params, layers=layers)
+        rm = RequestManager(make_engine((cfg, damped)))
+        ref = [o.output_tokens
+               for o in rm.generate(PROMPTS, max_new_tokens=16)]
+        mgr = SpecInferManager(
+            make_engine((cfg, damped)), None,
+            SpecConfig(2, 4, adaptive=True, draft="early_exit",
+                       draft_layers=1),
+        )
+        outs = mgr.generate(PROMPTS, max_new_tokens=16)
+        assert [o.output_tokens for o in outs] == ref
+        total = sum(len(o.output_tokens) for o in outs)
+        steps = sum(o.profile.llm_decoding_steps for o in outs)
+        assert steps < total, (steps, total)
+        assert sum(o.profile.accepted_tokens for o in outs) > 0
+
+    def test_validation(self, tiny, tiny_ssm):
+        with pytest.raises(ValueError):
+            # external SSMs cannot combine with self-speculation
+            SpecInferManager(
+                make_engine(tiny), make_engine(tiny_ssm),
+                SpecConfig(2, 3, draft="early_exit", draft_layers=1),
+            )
+        with pytest.raises(ValueError):
+            # draft must be a strict prefix of the target's stack
+            SpecInferManager(
+                make_engine(tiny), None,
+                SpecConfig(2, 3, draft="early_exit", draft_layers=2),
+            )
+        with pytest.raises(ValueError):
+            # no draft source at all
+            SpecInferManager(make_engine(tiny), None, SpecConfig(2, 3))
+
+    @pytest.mark.slow  # 3 generations; premerge gate 7/7 runs it
+    def test_int4_run_to_run_bitwise_with_high_agreement(self, tiny):
+        """The documented early-exit × int4 exception: the self-draft's
+        extra slack-line writes perturb the int4 page-scale history
+        (rescale-on-growth sees more writes than incremental decoding
+        did), so spec==incremental is agreement-grade, not bitwise —
+        while identical runs stay bitwise-deterministic. SSM-mode
+        speculation (separate pools) keeps exact equality on int4
+        (test_adaptive_matches_incremental_paged above)."""
+        kw = dict(kv_layout="paged", page_size=16, kv_quant="int4")
+        ref = incr_ref(tiny, n_new=8, **kw)
+
+        def run():
+            mgr = SpecInferManager(
+                make_engine(tiny, **kw), None,
+                SpecConfig(2, 4, adaptive=True, draft="early_exit",
+                           draft_layers=1),
+            )
+            return [o.output_tokens
+                    for o in mgr.generate(PROMPTS, max_new_tokens=8)]
+
+        one, two = run(), run()
+        assert one == two, "early-exit int4 must be run-to-run bitwise"
+        flat_ref = [t for o in ref for t in o]
+        flat = [t for o in one for t in o]
+        agree = sum(a == b for a, b in zip(flat, flat_ref)) / len(flat_ref)
+        assert agree >= 0.6, agree
+
+
+# ---------------------------------------------------------------------------
+# composition: prefix cache × speculation
+
+
+class TestPrefixCacheComposition:
+    SC = dict(kv_layout="paged", page_size=8, prefix_caching=True)
+
+    def test_cold_vs_warm_bitwise(self, tiny, tiny_ssm):
+        """A prefix-cache hit jumps the LLM AND the SSM past the cached
+        prefix; warm generation is bitwise the cold one's (which is
+        bitwise incremental's)."""
+        prompt = [(i * 7 + 3) % 256 for i in range(20)]
+        ref = incr_ref(tiny, prompts=[prompt], n_new=10)
+        mgr = SpecInferManager(
+            make_engine(tiny, **self.SC), make_engine(tiny_ssm, **self.SC),
+            SpecConfig(2, 3, adaptive=True),
+        )
+        cold = mgr.generate([prompt], max_new_tokens=10)[0]
+        warm = mgr.generate([prompt], max_new_tokens=10)[0]
+        assert cold.output_tokens == ref[0]
+        assert warm.output_tokens == cold.output_tokens
+        assert warm.profile.cached_prefix_len > 0
+        assert mgr.stats.prefix_hits >= 1
+        mgr.drain()
+        mgr.engine.pager.check_no_leaks(
+            external=mgr.prefix_cache.page_refs()
+        )
+        mgr.ssm.pager.check_no_leaks(
+            external=mgr.ssm_prefix_caches[0].page_refs()
+        )
+
+    def test_pool_mismatch_falls_back_cold(self, tiny, tiny_ssm):
+        """If one pool's tree diverges (here: the SSM tree is cleared
+        behind the manager's back), the cross-pool match aligns to the
+        common minimum — a cold admission, never a half-spliced
+        prefix."""
+        prompt = [(i * 7 + 3) % 256 for i in range(20)]
+        mgr = SpecInferManager(
+            make_engine(tiny, **self.SC), make_engine(tiny_ssm, **self.SC),
+            SpecConfig(2, 3),
+        )
+        ref = [o.output_tokens
+               for o in mgr.generate([prompt], max_new_tokens=10)]
+        mgr.ssm_prefix_caches[0].clear()
+        warm = mgr.generate([prompt], max_new_tokens=10)[0]
+        assert warm.output_tokens == ref[0]
+        assert warm.profile.cached_prefix_len == 0  # aligned to the miss
+        mgr.drain()
+        mgr.engine.pager.check_no_leaks(
+            external=mgr.prefix_cache.page_refs()
+        )
+        mgr.ssm.pager.check_no_leaks(
+            external=mgr.ssm_prefix_caches[0].page_refs()
+        )
+
+
+# ---------------------------------------------------------------------------
+# composition: continuous batching × speculation
+
+
+class TestContinuousBatchingComposition:
+    def test_parity_under_churn_and_preemption(self, tiny, tiny_ssm):
+        """More requests than slots on a TIGHT paged pool: admissions
+        ride the pipelined mixed step (SSM-mirrored), pool pressure
+        preempts, speculation rounds run the pure-decode phases — and
+        the outputs stay exactly incremental-greedy's under the same
+        config."""
+        prompts = [
+            [(i * 37 + j * 11 + 3) % 256 for j in range(8 + i % 3)]
+            for i in range(6)
+        ]
+        kw = dict(
+            max_requests_per_batch=2, kv_layout="paged", page_size=8,
+            max_cached_tokens=96, max_sequence_length=48,
+        )
+        rm = RequestManager(make_engine(tiny, **kw))
+        ref = [o.output_tokens
+               for o in rm.generate(prompts, max_new_tokens=10)]
+        mgr = SpecInferManager(
+            make_engine(tiny, **kw), make_engine(tiny_ssm, **kw),
+            SpecConfig(2, 3, adaptive=True),
+        )
+        outs = mgr.generate(prompts, max_new_tokens=10)
+        assert [o.output_tokens for o in outs] == ref
+        assert mgr.stats.mixed_steps > 0, "pipelined mixed path not hit"
+        assert mgr.stats.spec_rounds > 0, "speculation rounds not hit"
+        for eng in (mgr.engine, mgr.ssm):
+            eng.pager.check_no_leaks()
+
+    @pytest.mark.slow  # premerge gate 7/7 runs it unfiltered
+    def test_flush_on_admit_baseline_unchanged(self, tiny, tiny_ssm):
+        """continuous_batching=False keeps the blocking sync prefill
+        path (the PR-2 baseline scheduler) — and the same tokens."""
+        ref = incr_ref(tiny)
+        mgr = SpecInferManager(
+            make_engine(tiny, continuous_batching=False),
+            make_engine(tiny_ssm, continuous_batching=False),
+            SpecConfig(2, 3),
+        )
+        outs = mgr.generate(PROMPTS, max_new_tokens=12)
+        assert [o.output_tokens for o in outs] == ref
+        assert mgr.stats.mixed_steps == 0
+
+
+# ---------------------------------------------------------------------------
+# composition: cluster × speculation (per-replica SSM mirrors)
+
+
+class TestClusterComposition:
+    def test_validate_cluster_accepts_replicas_rejects_disagg(self):
+        make_sc(replicas=2).validate_cluster(specinfer=True)  # no raise
+        with pytest.raises(ValueError, match="disaggregated"):
+            make_sc(
+                replicas=2, prefill_replicas=1, decode_replicas=1,
+                kv_layout="paged",
+            ).validate_cluster(specinfer=True)
+
+    @pytest.mark.slow  # premerge gate 7/7 runs it unfiltered
+    def test_cluster_ssm_mirrors_match_greedy(self, tiny, tiny_ssm):
+        ref = incr_ref(tiny)
+        cm = ClusterManager.build(
+            llama, tiny[0], tiny[1],
+            make_sc(replicas=2, router_policy="round_robin"),
+            ssms=[(llama, tiny_ssm[0], tiny_ssm[1])],
+            spec=SpecConfig(2, 3, adaptive=True),
+        )
+        outs = cm.generate(PROMPTS, max_new_tokens=12)
+        assert [o.output_tokens for o in outs] == ref
+        for rep in cm.replicas:
+            assert isinstance(rep.rm, SpecInferManager)
+        agg = cm.stats.snapshot([r.stats for r in cm.replicas])["replicas"]
+        assert agg["spec_rounds"] > 0
+        assert 0.0 <= agg["spec_accept_rate"] <= 1.0
+
+    @pytest.mark.slow  # premerge gate 7/7 runs it unfiltered
+    def test_llm_compile_cluster_with_ssms(self, tiny, tiny_ssm):
+        from flexflow_tpu.core.mesh import MachineSpec
+        from flexflow_tpu.serve.llm import LLM, SSM
+
+        cfg, params = tiny
+        mesh = MachineSpec().make_mesh(jax.devices()[:1])
+        m = LLM(llama, cfg, params, mesh=mesh)
+        ssm = SSM(llama, tiny_ssm[0], tiny_ssm[1], mesh=mesh)
+        m.compile(make_sc(replicas=2), ssms=[ssm], spec=SpecConfig(2, 3))
+        out = m.generate([PROMPTS[0]], max_new_tokens=8)[0]
+        assert out.output_tokens == incr_ref(tiny, prompts=[PROMPTS[0]],
+                                             n_new=8)[0]
+
+    def test_llm_compile_early_exit_no_ssms(self, tiny):
+        from flexflow_tpu.core.mesh import MachineSpec
+        from flexflow_tpu.serve.llm import LLM
+
+        cfg, params = tiny
+        mesh = MachineSpec().make_mesh(jax.devices()[:1])
+        m = LLM(llama, cfg, params, mesh=mesh)
+        m.compile(
+            make_sc(),
+            spec=SpecConfig(2, 3, draft="early_exit", draft_layers=1),
+        )
+        assert isinstance(m.rm, SpecInferManager)
+        out = m.generate([PROMPTS[0]], max_new_tokens=8)[0]
+        assert out.output_tokens == incr_ref(tiny, prompts=[PROMPTS[0]],
+                                             n_new=8)[0]

@@ -618,16 +618,17 @@ def test_queue_delay_guards_cold_and_reset_rate(tiny):
     rep.rm.drain()
 
 
-def test_validate_cluster_rejects_specinfer(tiny):
-    with pytest.raises(ValueError, match="SpecInfer"):
-        ServingConfig(**sc_kwargs(replicas=2)).validate_cluster(
-            specinfer=True
-        )
+def test_validate_cluster_specinfer_rejects_disagg_only(tiny):
+    # replicated clusters compose with SpecInfer now (per-replica SSM
+    # mirrors, serve/cluster/replica.py + tests/test_adaptive_spec.py);
+    # only the disaggregated prefill/decode pools still reject it —
+    # the page-migration hand-off does not carry the draft caches
+    ServingConfig(**sc_kwargs(replicas=2)).validate_cluster(specinfer=True)
     with pytest.raises(ValueError, match="SpecInfer"):
         ServingConfig(
             **sc_kwargs(replicas=2, prefill_replicas=1, decode_replicas=1)
         ).validate_cluster(specinfer=True)
-    # 1 replica + ssms is the supported SpecInfer path
+    # 1 replica + ssms remains fine
     ServingConfig(**sc_kwargs()).validate_cluster(specinfer=True)
     # the new failover/back-pressure fields validate too
     with pytest.raises(ValueError, match="failover_retries"):
@@ -638,12 +639,18 @@ def test_validate_cluster_rejects_specinfer(tiny):
         ).validate_cluster()
 
 
-def test_llm_compile_specinfer_cluster_fails_at_construction(tiny):
+def test_llm_compile_specinfer_disagg_fails_at_construction(tiny):
     from flexflow_tpu.serve.llm import LLM, SSM
 
     cfg, params = tiny
     llm = LLM(llama, cfg, params)
     ssm = SSM(llama, cfg, params)
     with pytest.raises(ValueError, match="SpecInfer"):
-        llm.compile(ServingConfig(**sc_kwargs(replicas=2)), ssms=[ssm])
+        llm.compile(
+            ServingConfig(**sc_kwargs(
+                replicas=2, prefill_replicas=1, decode_replicas=1,
+                kv_layout="paged",
+            )),
+            ssms=[ssm],
+        )
     assert llm.rm is None  # nothing was built before the raise

@@ -234,6 +234,82 @@ def test_sanitizers_do_not_change_outputs(tiny, kv_layout):
     assert outs_on == outs_off
 
 
+@pytest.mark.slow  # ~20s; premerge gate 3/7 runs this file unfiltered
+def test_adaptive_spec_one_program_per_bucket(tiny):
+    """Adaptive speculation churn: per-request tree resizing compiles
+    exactly ONE speculate program per W×D bucket visited and one
+    tree-verify step per bucket chunk — the BUCKETED ladder, never
+    free-form shapes — with zero retraces, nothing new compiling on a
+    repeat of the identical workload (steady state), and
+    sanitizers-on == sanitizers-off generations bitwise."""
+    from flexflow_tpu.serve import SpecConfig, SpecInferManager
+
+    cfg, params = tiny
+    dcfg = llama.LLaMAConfig.tiny(dtype=jnp.float32, num_hidden_layers=1)
+    dparams = dict(params)
+    dparams["layers"] = {k: v[:1] for k, v in params["layers"].items()}
+    prompts = [[3, 17, 91, 42, 7], [9, 8, 7], [42] * 9, [5, 9, 2, 11]]
+
+    def build(sans):
+        def sc():
+            return ServingConfig(
+                max_requests_per_batch=4, max_sequence_length=96,
+                prefill_chunk=8, max_spec_tree_tokens=16,
+                cache_dtype=jnp.float32, kv_layout="paged", page_size=16,
+                sanitizers=sans,
+            )
+
+        return SpecInferManager(
+            InferenceEngine(llama, cfg, params, sc()),
+            InferenceEngine(llama, dcfg, dparams, sc()),
+            SpecConfig(2, 4, adaptive=True),
+        )
+
+    mgr = build(("retrace", "donation"))
+    first = [
+        o.output_tokens for o in mgr.generate(prompts, max_new_tokens=16)
+    ]
+    assert mgr.stats.spec_resizes > 0, "no resize churn exercised"
+
+    ladder = set(mgr.spec.bucket_ladder)
+    llm_g, ssm_g = mgr.engine.retrace_guard, mgr.ssm.retrace_guard
+    # the draft engine compiled one speculate program per bucket VISITED
+    spec_counts = {
+        k: v for k, v in ssm_g.compile_counts().items()
+        if isinstance(k, tuple) and k and k[0] == "speculate"
+    }
+    visited = {(k[1], k[2]) for k in spec_counts}
+    assert visited <= ladder, (visited, ladder)
+    assert len(visited) >= 2, "resize churn never changed the bucket"
+    assert all(v == 1 for v in spec_counts.values()), spec_counts
+    # the verifier compiled one tree-verify step per bucket chunk
+    verify_counts = {
+        k: v for k, v in llm_g.compile_counts().items()
+        if isinstance(k, tuple) and len(k) == 3 and k[1] is True
+    }
+    assert {k[0] for k in verify_counts} <= {
+        1 + w * d for w, d in ladder
+    }, verify_counts
+    assert all(v == 1 for v in verify_counts.values()), verify_counts
+    assert llm_g.retraces == 0 and ssm_g.retraces == 0
+
+    # steady state: fresh requests repeat the controller trajectory —
+    # the identical workload may compile NOTHING new
+    total = llm_g.total_compiles + ssm_g.total_compiles
+    again = [
+        o.output_tokens for o in mgr.generate(prompts, max_new_tokens=16)
+    ]
+    assert again == first
+    assert llm_g.total_compiles + ssm_g.total_compiles == total
+
+    # sanitizers are observers: bitwise-identical without them
+    outs_off = [
+        o.output_tokens
+        for o in build(()).generate(prompts, max_new_tokens=16)
+    ]
+    assert outs_off == first
+
+
 # ---------------------------------------------------------------------------
 # RetraceGuard unit behavior
 
