@@ -197,6 +197,12 @@ _PHASES = [
     # on a 64-slot shared-prefix Poisson workload: TTFT p50/p99,
     # spill/readmit counters, host hit rate, bitwise output parity)
     ("serve_kv_hierarchy", 900, 600, True, True),
+    # context-parallel long-context serving: prompt-length ladder
+    # (8k/32k/synthetic-100k; CPU runs scale-model lengths) CP-on vs
+    # CP-off at the same per-shard budget — bitwise output parity +
+    # zero steady-state recompiles asserted, plus the top rung served
+    # ONLY under CP (unservable-without-CP asserted)
+    ("serve_long_context", 900, 600, True, True),
     # cluster serving: 2 engine replicas behind the front-end router on
     # a shared-prefix Poisson workload — prefix-aware vs round-robin
     # placement (tokens/sec + TTFT p50/p99, hit-rate split, affinity/
@@ -338,6 +344,28 @@ def orchestrate(which):
                 spills=d.get("spills"),
                 readmits=d.get("readmits"),
                 host_hit_tokens=d.get("host_hit_tokens"),
+                platform=d.get("platform"),
+            )
+
+    # Derived: long-context TTFT — time to first token of the ladder's
+    # TOP rung (the prompt only context parallelism can serve at the
+    # configured per-shard budget), in seconds. The CP-off baseline has
+    # no figure for this rung by construction (it is asserted
+    # unservable there), so the derived metric tracks the latency of
+    # the capability itself across rounds.
+    rec = _RESULTS.get("long_context_serve_tokens_per_sec_per_chip")
+    if rec:
+        d = rec.get("detail") or {}
+        if d.get("ttft_top_s") is not None:
+            emit(
+                "long_context_ttft_s",
+                d["ttft_top_s"],
+                "seconds",
+                source=rec["metric"],
+                ladder=d.get("ladder"),
+                context_shards=d.get("context_shards"),
+                per_shard_budget_tokens=d.get("per_shard_budget_tokens"),
+                output_parity=d.get("output_parity"),
                 platform=d.get("platform"),
             )
 
@@ -2132,6 +2160,166 @@ def serve_kv_hierarchy_bench(on_tpu, kernels):
     return spill["tps"]
 
 
+def serve_long_context_bench(on_tpu, kernels):
+    """Context-parallel long-context serving (ServingConfig.kv_shard=
+    "context", PR 11): one request's KV pages stripe across sequence
+    shards, ``max_cached_tokens`` prices ONE shard, and prompts beyond
+    a single shard's pool serve at the aggregate capacity.
+
+    Prompt-length ladder (8k / 32k / synthetic-100k on TPU; the CPU
+    smoke runs the same three-rung SHAPE at scale-model lengths —
+    detail records the actual token counts), CP-on vs CP-off at the
+    SAME per-shard budget:
+
+      * the two lower rungs fit one shard's budget: both modes serve
+        them and their greedy outputs are asserted BITWISE identical
+        (on a seq-degree-1 mesh CP attention is the table-gather XLA
+        fallback — bit-for-bit the CP-off math, serve/kernels.py);
+      * the TOP rung strictly exceeds one shard's budget: CP-off is
+        asserted to fail with a terminal GenerationResult.error (the
+        PR-2 unservable contract) while CP-on serves it — the
+        capability this mode exists for;
+      * both arms run under the strict retrace sentinel and assert
+        zero steady-state recompiles (the churn variant lives in
+        tests/test_long_context.py::TestCpRetrace).
+
+    Reports tokens/sec over the ladder plus per-rung TTFT p50 — the
+    top rung's TTFT feeds the summary's ``long_context_ttft_s``.
+
+    Measurement caveat (CPU): XLA:CPU is compute-bound and single-
+    device, so CP-on vs CP-off throughput here is a parity/capability
+    smoke, NOT the bandwidth claim — on a real seq-sharded TPU mesh
+    each shard reads only its resident pages (ring ragged paged
+    attention) and the aggregate-HBM-bandwidth win is what the chip
+    measures.
+    """
+    import jax
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import (
+        InferenceEngine, RequestManager, ServingConfig,
+    )
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    if not on_tpu and kernels == "pallas":
+        _log("serve_long_context: forcing kernels=xla off-TPU")
+        kernels = "xla"
+
+    cp = 4
+    n_new = 24 if on_tpu else 16
+    if on_tpu:
+        ladder = [("8k", 8192), ("32k", 32768), ("synthetic-100k", 102400)]
+        page_size = 128
+        prefill_chunk = 256
+    else:
+        # scale-model rungs: same three-rung ladder shape, sized so the
+        # top rung still strictly exceeds one shard's budget
+        ladder = [("8k", 256), ("32k", 512), ("synthetic-100k", 1536)]
+        page_size = 32
+        prefill_chunk = 128
+    top_len = ladder[-1][1]
+    # per-shard budget: covers the MID rung with decode headroom,
+    # strictly below the TOP rung — the aggregate (x cp) covers it
+    budget = ladder[1][1] + n_new + 4 * page_size
+    assert budget < top_len and cp * budget > top_len + n_new
+
+    import jax.numpy as jnp
+
+    def make_rm(**kw):
+        sc = ServingConfig(
+            max_requests_per_batch=2,
+            max_sequence_length=top_len + n_new + 8,
+            prefill_chunk=prefill_chunk,
+            max_spec_tree_tokens=16,
+            cache_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=page_size,
+            max_cached_tokens=budget,
+            sanitizers=("retrace",),
+            **kw,
+        )
+        return RequestManager(InferenceEngine(llama, cfg, params, sc))
+
+    def rung_prompt(n, seed):
+        return [(seed + 11 * j) % cfg.vocab_size for j in range(n)]
+
+    def run_ladder(rm, servable_only):
+        outs, ttft = {}, {}
+        tokens = 0
+        t0 = time.perf_counter()
+        for i, (name, n) in enumerate(ladder):
+            if servable_only and n + 1 > budget:
+                continue
+            r = rm.generate([rung_prompt(n, 7 + i)],
+                            max_new_tokens=n_new)[0]
+            assert r.error is None, f"{name}: {r.error}"
+            outs[name] = list(r.output_tokens)
+            ttft[name] = r.profile.ttft_s
+            tokens += len(r.output_tokens)
+        wall = time.perf_counter() - t0
+        return outs, ttft, tokens / max(1e-9, wall), rm.stats.snapshot()
+
+    # CP-off arm: same per-shard budget, single pool
+    rm_off = make_rm()
+    off_outs, off_ttft, off_tps, off_stats = run_ladder(
+        rm_off, servable_only=True
+    )
+    # the top rung is UNSERVABLE without CP: terminal error, not a hang
+    r = rm_off.generate([rung_prompt(top_len, 9)], max_new_tokens=4)[0]
+    assert r.error is not None and "budget" in r.error, (
+        f"top rung should be unservable CP-off (got error={r.error!r})"
+    )
+    del rm_off
+
+    # CP-on arm: the same budget PER SHARD, striped over cp shards
+    rm_cp = make_rm(kv_shard="context", context_shards=cp)
+    cp_outs, cp_ttft, cp_tps, cp_stats = run_ladder(
+        rm_cp, servable_only=False
+    )
+    rm_cp.drain()
+    rm_cp.engine.pager.check_no_leaks()
+    del rm_cp
+
+    for name in off_outs:
+        assert cp_outs[name] == off_outs[name], (
+            f"CP-on vs CP-off outputs diverged on the {name} rung"
+        )
+    assert ladder[-1][0] in cp_outs, "CP-on failed to serve the top rung"
+    assert cp_stats["retraces"] == 0 and off_stats["retraces"] == 0, (
+        f"steady-state recompiles: cp={cp_stats['retraces']} "
+        f"off={off_stats['retraces']}"
+    )
+
+    emit(
+        "long_context_serve_tokens_per_sec_per_chip",
+        round(cp_tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=cp_tps / max(1e-9, off_tps),
+        kernels=kernels,
+        context_shards=cp,
+        ladder={name: n for name, n in ladder},
+        per_shard_budget_tokens=budget,
+        aggregate_budget_tokens=cp * budget,
+        page_size=page_size,
+        new_tokens_per_request=n_new,
+        ttft_s={k: round(v, 4) for k, v in cp_ttft.items()},
+        ttft_top_s=round(cp_ttft[ladder[-1][0]], 4),
+        baseline_ttft_s={k: round(v, 4) for k, v in off_ttft.items()},
+        baseline_tokens_per_sec=round(off_tps, 2),
+        top_rung_unservable_without_cp=1,
+        output_parity=1,
+        ring_steps=cp_stats["ring_steps"],
+        shard_balance=cp_stats["shard_balance"],
+        jit_compiles_measured=cp_stats["compiles"],
+        steady_state_recompiles=cp_stats["retraces"],
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return cp_tps
+
+
 def serve_cluster_bench(on_tpu, kernels):
     """Cluster serving (serve/cluster/): N engine replicas behind the
     front-end router on a shared-system-prompt Poisson workload with
@@ -2975,6 +3163,8 @@ def child_main(phase, platform, kernels):
         serve_paged_q_bench(on_tpu, kernels)
     elif phase == "serve_kv_hierarchy":
         serve_kv_hierarchy_bench(on_tpu, kernels)
+    elif phase == "serve_long_context":
+        serve_long_context_bench(on_tpu, kernels)
     elif phase == "serve_spec_adaptive":
         serve_spec_adaptive_bench(on_tpu, kernels)
     elif phase == "serve_fused":
@@ -3000,7 +3190,8 @@ def main():
         default="all",
         choices=["all", "train", "searched", "parity", "serve",
                  "serve_paged", "serve_continuous", "serve_prefix",
-                 "serve_paged_q", "serve_kv_hierarchy", "serve_cluster",
+                 "serve_paged_q", "serve_kv_hierarchy",
+                 "serve_long_context", "serve_cluster",
                  "serve_faults", "serve_fused", "serve_int8",
                  "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",

@@ -98,6 +98,8 @@ def cmd_serve(args):
         page_size=args.page_size,
         max_cached_tokens=args.max_cached_tokens,
         kv_quant=args.kv_quant,
+        kv_shard=args.kv_shard,
+        context_shards=args.context_shards,
         prefix_caching=args.prefix_caching,
         host_cache_bytes=args.host_cache_bytes,
         cache_policy=args.cache_policy,
@@ -229,6 +231,23 @@ def main(argv=None):
                         "after scale rows. int4 generation stays "
                         "bitwise run-to-run; its logit tolerance is "
                         "wider than int8's (see README)")
+    s.add_argument("--kv-shard", choices=["none", "context"],
+                   default="none",
+                   help="context-parallel long-context serving "
+                        "(requires --kv-layout paged): shard ONE "
+                        "request's KV pages across sequence shards — "
+                        "logical page j stripes to shard j%%n, "
+                        "--max-cached-tokens becomes a PER-SHARD HBM "
+                        "budget, and prompts beyond one shard's pool "
+                        "serve at the aggregate capacity via ring "
+                        "ragged paged attention "
+                        "(--sequence-parallelism-degree > 1 runs the "
+                        "ppermute ring; a seq-degree-1 mesh uses the "
+                        "bitwise table-gather layout)")
+    s.add_argument("--context-shards", type=int, default=0,
+                   help="context-parallel shard degree (0 = derive "
+                        "from the mesh --sequence-parallelism-degree; "
+                        "must match it when both are set)")
     s.add_argument("--prefix-caching", action="store_true",
                    help="automatic prefix caching (paged layout only): "
                         "reuse cached KV pages for shared prompt "

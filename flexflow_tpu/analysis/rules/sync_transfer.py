@@ -79,6 +79,23 @@ HOT_ROOTS = {
     "record_failure",
     "record_success",
     "maybe_probe",
+    # context-parallel long-context serving (kv_shard="context"): the
+    # per-shard admission/allocation path (striped ensure/COW/readmit
+    # run inside admissions and page reservation) and the ring ragged
+    # paged attention entry points — a blocking transfer anywhere here
+    # would stall every decode step on a 100k-token request's critical
+    # path
+    "ensure",
+    "take_free_page",
+    "cow",
+    "splice",
+    "release",
+    "_readmit",
+    "_admission_error",
+    "_ensure_pages",
+    "shard_balance",
+    "ring_ragged_paged_attention",
+    "ring_ragged_paged_attention_xla",
 }
 
 # Calls that force a synchronous transfer / device round-trip.

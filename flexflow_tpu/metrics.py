@@ -115,6 +115,16 @@ class SchedulerStats:
     spec_drafted: int = 0
     spec_accepted: int = 0
     spec_resizes: int = 0
+    # Context-parallel long-context serving (ServingConfig.kv_shard=
+    # "context", serve/paging.py + serve/kernels.py): the shard degree
+    # one request's KV pages stripe over (0 = CP off), ring hops a
+    # sequence-sharded mesh pays per dispatched attention step
+    # ((shards-1) per step — the ppermute stat rotations of ring
+    # ragged paged attention), and the pool's striping balance gauge
+    # (min/max used pages across shards; 1.0 = perfectly balanced).
+    cp_shards: int = 0
+    ring_steps: int = 0
+    shard_balance: float = 1.0
     # Retrace sentinel (analysis/retrace.py, wired when the engine runs
     # with ServingConfig.sanitizers=("retrace",)): XLA compiles of step
     # programs observed at the engine's jit chokepoint, and how many of
@@ -215,6 +225,9 @@ class SchedulerStats:
             "spec_accepted": self.spec_accepted,
             "spec_resizes": self.spec_resizes,
             "spec_accept_rate": round(self.spec_accept_rate, 4),
+            "cp_shards": self.cp_shards,
+            "ring_steps": self.ring_steps,
+            "shard_balance": round(self.shard_balance, 4),
             "compiles": self.compiles,
             "retraces": self.retraces,
         }
@@ -236,6 +249,8 @@ class SchedulerStats:
             f"host_toks={s['host_hit_tokens']} host_B={s['host_bytes']} "
             f"spec={s['spec_accepted']}/{s['spec_drafted']}"
             f"@{s['spec_rounds']}r resize={s['spec_resizes']} "
+            f"cp={s['cp_shards']} ring={s['ring_steps']} "
+            f"bal={s['shard_balance']:.2f} "
             f"compiles={s['compiles']} retraces={s['retraces']}"
         )
 

@@ -792,7 +792,7 @@ FUSED_DECODE = ("rope_kv_write",)
 
 def init_paged_kv_cache(
     cfg: LLaMAConfig, num_pages: int, page_size: int, dtype=None,
-    kv_quant: Optional[str] = None,
+    kv_quant: Optional[str] = None, extra_rows: int = 0,
 ) -> Dict[str, jnp.ndarray]:
     """Paged pool: (L, num_pages+1, page_size, KV, dk). Pool row
     ``num_pages`` is the shared scratch page — unallocated page-table
@@ -805,7 +805,13 @@ def init_paged_kv_cache(
     so the trailing dim is ``head_dim // 2``) — and the cache gains
     ``k_scale``/``v_scale``: (L, num_pages+1, KV) f32
     per-page-per-KV-head amax scales, zero-initialised (a zero scale
-    marks a page with no committed lines)."""
+    marks a page with no committed lines).
+
+    ``extra_rows`` appends never-referenced pad rows AFTER the scratch
+    row — context-parallel serving (ServingConfig.kv_shard="context")
+    shards pool rows over the mesh ``seq`` axis and pads the row count
+    to a multiple of the shard degree; no table entry ever points past
+    the scratch row, so the pads are pure alignment."""
     L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
     dt = dtype or cfg.dtype
     spec = None
@@ -821,10 +827,11 @@ def init_paged_kv_cache(
                 f"({dk}) divisible by {spec.pack}"
             )
         dk = dk // spec.pack
-    shape = (L, num_pages + 1, page_size, KV, dk)
+    rows = num_pages + 1 + int(extra_rows)
+    shape = (L, rows, page_size, KV, dk)
     cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
     if spec is not None:
-        sshape = (L, num_pages + 1, KV)
+        sshape = (L, rows, KV)
         cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
         cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
     return cache
@@ -832,25 +839,30 @@ def init_paged_kv_cache(
 
 def paged_kv_cache_pspecs(
     cfg: Optional[LLaMAConfig] = None, *, pipeline: bool = False,
-    kv_quant: Optional[str] = None,
+    kv_quant: Optional[str] = None, kv_shard: Optional[str] = None,
 ) -> Dict[str, P]:
     """Pages shard over DP on the pool dim, KV heads over TP on the
     model axis (same head axis the attention shards on) — tensor-
     parallel serving keeps working; MQA (KV=1) replicates as in the
     dense layout. Quantized pools shard their per-page scale rows the
-    same way (pages on data, KV heads on model)."""
+    same way (pages on data, KV heads on model). With
+    ``kv_shard="context"`` pool rows shard over the SEQ axis instead —
+    each sequence shard holds its own slice of one request's pages
+    (ring ragged paged attention reads them locally;
+    serve/kernels.ring_ragged_paged_attention)."""
     kv_axis = (
         None if (cfg is not None and cfg.num_key_value_heads == 1)
         else MODEL_AXIS
     )
+    page_axis = SEQ_AXIS if kv_shard == "context" else DATA_AXIS
     pp = PIPE_AXIS if pipeline else None
     specs = {
-        "k": P(pp, DATA_AXIS, None, kv_axis, None),
-        "v": P(pp, DATA_AXIS, None, kv_axis, None),
+        "k": P(pp, page_axis, None, kv_axis, None),
+        "v": P(pp, page_axis, None, kv_axis, None),
     }
     if kv_quant is not None:
-        specs["k_scale"] = P(pp, DATA_AXIS, kv_axis)
-        specs["v_scale"] = P(pp, DATA_AXIS, kv_axis)
+        specs["k_scale"] = P(pp, page_axis, kv_axis)
+        specs["v_scale"] = P(pp, page_axis, kv_axis)
     return specs
 
 
@@ -867,7 +879,8 @@ def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
                       k_pool, v_pool, phys, off, page_table,
                       kernels: str = "xla",
                       k_scale=None, v_scale=None, qmax=None,
-                      *, fused_rope: bool = False, logical=None):
+                      *, fused_rope: bool = False, logical=None,
+                      cp_mesh=None):
     """One block on a paged serving step: scatter new K/V at the
     table-resolved (physical page, offset), attend over the virtual
     cache read through the page table. With ``qmax`` (quantized pool,
@@ -917,7 +930,18 @@ def serve_block_paged(cfg: LLaMAConfig, p, x, cos, sin, mask,
     else:
         k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
         v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
-    if kernels == "pallas":
+    if cp_mesh is not None:
+        # context-parallel attention over the sequence-sharded pool:
+        # each seq shard attends its resident pages, partial softmax
+        # stats rotate via ppermute (the chunked-prefill KV write above
+        # already landed on the owning shard — GSPMD routes the
+        # replicated-index scatter to the sharded rows)
+        attn = _pk.ring_ragged_paged_attention(
+            q, k_pool, v_pool, page_table, mask, cp_mesh,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+        attn = attn.reshape(R, C, H * dk)
+    elif kernels == "pallas":
         attn = _pk.ragged_paged_attention(
             q, k_pool, v_pool, page_table, mask,
             k_scale=k_scale, v_scale=v_scale,
@@ -967,6 +991,7 @@ def serve_step_paged(
     fused_rope: bool = False,
     num_layers: Optional[int] = None,
     mesh=None,
+    cp_mesh=None,
 ):
     """Paged twin of :func:`serve_step` — same contract plus the
     per-slot page table; prefill chunks, single-token decode and
@@ -979,7 +1004,11 @@ def serve_step_paged(
     ``num_layers`` is the layer-sliced early-exit draft step (see
     :func:`serve_step`): only the first ``num_layers`` blocks run and
     commit K/V; deeper pool rows (and their quant scale rows) pass
-    through untouched for the verify pass to own."""
+    through untouched for the verify pass to own. ``cp_mesh`` (context
+    parallelism, ServingConfig.kv_shard="context" on a sequence-
+    sharded mesh) routes every block's attention through ring ragged
+    paged attention over the seq-sharded pool
+    (serve/kernels.ring_ragged_paged_attention)."""
     if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
         raise NotImplementedError(
             "paged KV serving is not composed with pipeline parallelism "
@@ -1013,7 +1042,7 @@ def serve_step_paged(
             h, kc, vc, ks, vs = serve_block_paged(
                 cfg, p_l, h, cos, sin, mask, kc, vc, phys, off,
                 page_table, kernels, ks, vs, qmax,
-                fused_rope=fused_rope, logical=logical,
+                fused_rope=fused_rope, logical=logical, cp_mesh=cp_mesh,
             )
             return h, (kc, vc, ks, vs)
 
@@ -1035,7 +1064,7 @@ def serve_step_paged(
             h, kc, vc, _, _ = serve_block_paged(
                 cfg, p_l, h, cos, sin, mask, kc, vc, phys, off,
                 page_table, kernels,
-                fused_rope=fused_rope, logical=logical,
+                fused_rope=fused_rope, logical=logical, cp_mesh=cp_mesh,
             )
             return h, (kc, vc)
 

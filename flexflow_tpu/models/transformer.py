@@ -879,7 +879,7 @@ FUSED_DECODE = ("rope_kv_write",)
 
 def init_paged_kv_cache(
     cfg: DecoderConfig, num_pages: int, page_size: int, dtype=None,
-    kv_quant: Optional[str] = None,
+    kv_quant: Optional[str] = None, extra_rows: int = 0,
 ):
     """Pool (L, num_pages+1, page_size, KV, dk); pool row ``num_pages``
     is the shared scratch page. ALiBi/sliding-window configs also page
@@ -888,7 +888,9 @@ def init_paged_kv_cache(
     along dk, trailing dim ``head_dim // 2``) — plus per-page-per-KV-
     head f32 ``k_scale``/``v_scale`` rows (serve/kv_quant.py; the
     position buffer stays int32 — it is exact metadata, not tensor
-    payload)."""
+    payload). ``extra_rows`` appends never-referenced pad rows after
+    the scratch row (context-parallel row-shard alignment — see
+    models/llama.py init_paged_kv_cache)."""
     L, KV, dk = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
     dt = dtype or cfg.dtype
     spec = None
@@ -904,36 +906,42 @@ def init_paged_kv_cache(
                 f"({dk}) divisible by {spec.pack}"
             )
         dk = dk // spec.pack
-    shape = (L, num_pages + 1, page_size, KV, dk)
+    rows = num_pages + 1 + int(extra_rows)
+    shape = (L, rows, page_size, KV, dk)
     cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
     if spec is not None:
-        sshape = (L, num_pages + 1, KV)
+        sshape = (L, rows, KV)
         cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
         cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
     if needs_pos_cache(cfg):
-        cache["pos"] = jnp.zeros((num_pages + 1, page_size), jnp.int32)
+        cache["pos"] = jnp.zeros((rows, page_size), jnp.int32)
     return cache
 
 
 def paged_kv_cache_pspecs(cfg: DecoderConfig = None, *, pipeline: bool = False,
-                          kv_quant: Optional[str] = None):
+                          kv_quant: Optional[str] = None,
+                          kv_shard: Optional[str] = None):
     """Pages shard over DP, KV heads over TP (MQA replicates, as in the
     dense layout); quantized scale rows shard like their pools (pages
-    on data, KV heads on model)."""
+    on data, KV heads on model). ``kv_shard="context"`` shards pool
+    rows (and the position buffer's) over the SEQ axis instead — the
+    sequence-sharded layout of context-parallel serving (see
+    models/llama.py paged_kv_cache_pspecs)."""
     kv_axis = (
         None if (cfg is not None and cfg.num_key_value_heads == 1)
         else MODEL_AXIS
     )
+    page_axis = SEQ_AXIS if kv_shard == "context" else DATA_AXIS
     pp = PIPE_AXIS if pipeline else None
     specs = {
-        "k": P(pp, DATA_AXIS, None, kv_axis, None),
-        "v": P(pp, DATA_AXIS, None, kv_axis, None),
+        "k": P(pp, page_axis, None, kv_axis, None),
+        "v": P(pp, page_axis, None, kv_axis, None),
     }
     if kv_quant is not None:
-        specs["k_scale"] = P(pp, DATA_AXIS, kv_axis)
-        specs["v_scale"] = P(pp, DATA_AXIS, kv_axis)
+        specs["k_scale"] = P(pp, page_axis, kv_axis)
+        specs["v_scale"] = P(pp, page_axis, kv_axis)
     if cfg is not None and needs_pos_cache(cfg):
-        specs["pos"] = P(DATA_AXIS, None)
+        specs["pos"] = P(page_axis, None)
     return specs
 
 
@@ -946,7 +954,8 @@ def _page_lookup(page_table, cache_positions, page_size):
 def serve_block_paged(cfg, p, x, rope, bias, mask, k_pool, v_pool,
                       phys, off, page_table, kernels: str = "xla",
                       k_scale=None, v_scale=None, qmax=None,
-                      *, fused_rope: bool = False, logical=None):
+                      *, fused_rope: bool = False, logical=None,
+                      cp_mesh=None):
     """Paged twin of :func:`serve_block`: scatter new K/V at the
     table-resolved (page, offset); attend over the virtual cache read
     through the table (``jnp.take`` gather, or the fused ragged paged
@@ -1003,7 +1012,24 @@ def serve_block_paged(cfg, p, x, rope, bias, mask, k_pool, v_pool,
     else:
         k_pool = k_pool.at[phys, off].set(k.astype(k_pool.dtype))
         v_pool = v_pool.at[phys, off].set(v.astype(v_pool.dtype))
-    if kernels == "pallas" and bias is None:
+    if cp_mesh is not None:
+        if bias is not None:
+            # ALiBi's additive bias needs per-key-position terms the
+            # ring program does not carry yet (same exclusion as the
+            # Pallas kernel); sliding-window masks are fine — they are
+            # mask refinements, already folded in before this call.
+            raise NotImplementedError(
+                "ring context parallelism is not composed with ALiBi "
+                "position bias — serve this family with "
+                "kv_shard='context' on a seq-degree-1 mesh (the table-"
+                "gather layout), or use a RoPE/learned-position family"
+            )
+        attn = _pk.ring_ragged_paged_attention(
+            q, k_pool, v_pool, page_table, mask, cp_mesh,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+        attn = attn.reshape(R, C, -1)
+    elif kernels == "pallas" and bias is None:
         attn = _pk.ragged_paged_attention(
             q, k_pool, v_pool, page_table, mask,
             k_scale=k_scale, v_scale=v_scale,
@@ -1080,12 +1106,15 @@ def serve_step_paged(
     fused_rope: bool = False,
     num_layers: Optional[int] = None,
     mesh=None,
+    cp_mesh=None,
 ):
     """Paged twin of :func:`serve_step` — same contract plus the page
     table (see models/llama.py serve_step_paged; ``kv_quant`` selects
     the quantized pool layout, ``fused_rope`` the megakernel decode
     step's in-kernel RoPE + KV-write prologue on the Pallas path,
-    ``num_layers`` the layer-sliced early-exit draft step)."""
+    ``num_layers`` the layer-sliced early-exit draft step, ``cp_mesh``
+    the ring context-parallel attention over a sequence-sharded pool —
+    ALiBi-bias families reject it, see serve_block_paged)."""
     if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
         raise NotImplementedError(
             "paged KV serving is not composed with pipeline parallelism "
@@ -1119,7 +1148,7 @@ def serve_step_paged(
             h, kc, vc, ks, vs = serve_block_paged(
                 cfg, p_l, h, rope, bias, mask, kc, vc, phys, off,
                 page_table, kernels, ks, vs, qmax,
-                fused_rope=fused_rope, logical=logical,
+                fused_rope=fused_rope, logical=logical, cp_mesh=cp_mesh,
             )
             return h, (kc, vc, ks, vs)
 
@@ -1141,7 +1170,7 @@ def serve_step_paged(
             h, kc, vc, _, _ = serve_block_paged(
                 cfg, p_l, h, rope, bias, mask, kc, vc, phys, off,
                 page_table, kernels,
-                fused_rope=fused_rope, logical=logical,
+                fused_rope=fused_rope, logical=logical, cp_mesh=cp_mesh,
             )
             return h, (kc, vc)
 

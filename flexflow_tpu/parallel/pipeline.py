@@ -24,30 +24,19 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-try:
-    from jax import shard_map
-except ImportError:  # older jax (0.4.x) — same fallback as parallel/sequence
-    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.mesh import DATA_AXIS, PIPE_AXIS, shard_map_unchecked
 
 
 def _partial_shard_map(fn, mesh, in_specs, out_specs, manual_axes):
-    """Partial-manual shard_map across jax versions: new jax names the
-    MANUAL axes (``axis_names`` + ``check_vma``); 0.4.x names the
-    complement (``auto`` + ``check_rep``)."""
-    try:
-        return shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            axis_names=frozenset(manual_axes), check_vma=False,
-        )
-    except TypeError:
-        auto = frozenset(mesh.axis_names) - frozenset(manual_axes)
-        return shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False, auto=auto,
-        )
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from ..core.mesh import DATA_AXIS, PIPE_AXIS
+    """Partial-manual shard_map (only ``manual_axes`` run manually) —
+    the jax-version compat handling lives in the ONE shared shim,
+    core.mesh.shard_map_unchecked (previously copy-pasted here and in
+    parallel/sequence.py)."""
+    return shard_map_unchecked(
+        fn, mesh, in_specs, out_specs, manual_axes=manual_axes
+    )
 
 
 def pipeline_forward(

@@ -30,35 +30,19 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..core.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..core.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    shard_map_unchecked as _shard_map_unchecked,
+)
 
-
-def _shard_map_unchecked(fn, mesh, in_specs, out_specs):
-    """shard_map with replication checking OFF, across jax versions
-    (``check_vma`` on new jax, ``check_rep`` on 0.4.x — same compat
-    shim as parallel/pipeline._partial_shard_map). The checker in jax
-    0.4.37 mis-types the scan carry when these collectives run inside a
-    layer scan over a mesh with unrelated (expert/pipe) axes: the carry
-    enters untyped (None) and leaves typed replicated-over-the-unused-
-    axes, which the scan fixpoint rejects. The attention math is an
-    exact layout transform (tested against the dense reference), so
-    disabling the static replication check is sound."""
-    try:
-        return shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
-        )
-    except TypeError:
-        return shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
-        )
+# The check_rep/check_vma compat shim previously copy-pasted here and in
+# parallel/pipeline.py lives in core.mesh.shard_map_unchecked now — ONE
+# shim for every collective primitive (see its docstring for why the
+# static replication checker is off on jax 0.4.x).
 
 
 def _online_block(q, k, v, o, m, l, qpos, kpos, scale, causal, kv_len=None):
@@ -144,11 +128,23 @@ def ring_attention(
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     h_axis = MODEL_AXIS if shard_heads else None
     if shard_heads and mesh.shape[MODEL_AXIS] > 1:
-        assert k.shape[2] % mesh.shape[MODEL_AXIS] == 0, (
-            f"GQA ring attention needs KV heads ({k.shape[2]}) divisible by "
-            f"the model-axis degree ({mesh.shape[MODEL_AXIS]}); repeat K/V "
-            f"to full heads or drop head sharding"
-        )
+        if k.shape[2] % mesh.shape[MODEL_AXIS]:
+            # K/V rotate COMPACT around the ring (GQA heads expand only
+            # inside each block), so the KV-head dim itself must split
+            # over the model axis. Name the fixes that actually resolve
+            # it: expand K/V to the full head count BEFORE calling
+            # (jnp.repeat — trades the compact-rotation bandwidth win
+            # for shardability), lower the tensor-parallel (model)
+            # degree to a divisor of the KV head count, or pass
+            # shard_heads=False and take the seq-only sharding.
+            raise ValueError(
+                f"GQA ring attention shards KV heads over the model "
+                f"axis, but {k.shape[2]} KV heads do not divide by the "
+                f"model degree ({mesh.shape[MODEL_AXIS]}). Fix: repeat "
+                f"K/V to the full {q.shape[2]} heads before the call, "
+                f"lower the tensor-parallel degree to a divisor of "
+                f"{k.shape[2]}, or pass shard_heads=False"
+            )
     n_seq = mesh.shape[SEQ_AXIS]
     S = q.shape[1]
     pad = (-S) % n_seq  # shard_map needs S % n_seq == 0: right-pad + mask

@@ -147,6 +147,10 @@ class ProfileInfo:
     tree_resizes: int = 0
     tree_width: int = 0
     tree_depth: int = 0
+    # Context-parallel long-context serving (ServingConfig.kv_shard=
+    # "context"): how many sequence shards this request's KV pages
+    # striped over (1 = the single-pool layout).
+    context_shards: int = 1
     # Cluster serving (serve/cluster/): which engine replica served the
     # request's decode phase (-1 outside a cluster), and the router's
     # queue-delay estimate for that replica at placement time — the

@@ -118,6 +118,28 @@ class ServingConfig:
     # re-admitted prefix is BITWISE the never-evicted warm path's
     # (tests/test_kv_hierarchy.py).
     host_cache_bytes: Optional[int] = None
+    # Context-parallel long-context serving (ROADMAP item 5a; paged
+    # layout only). "context": ONE request's KV pages are sharded
+    # across ``context_shards`` sequence shards — logical page j lives
+    # on shard j % n (striped, so decode reads and long prompts
+    # load-balance) and each shard owns its own slice of the pool, so
+    # a prompt far beyond one shard's HBM budget serves at the
+    # aggregate capacity n × max_cached_tokens. ``max_cached_tokens``
+    # becomes a PER-SHARD budget and admission accounting goes
+    # per-shard (a request is servable iff every shard can cover its
+    # striped share). Attention over the sharded pool is ring ragged
+    # paged attention (serve/kernels.ring_ragged_paged_attention): on
+    # a mesh whose ``seq`` degree matches, each shard attends its
+    # resident pages and partial softmax stats rotate via ppermute;
+    # on a single-device mesh (this box) every "shard" is locally
+    # addressable and the standard table gather IS the ring result —
+    # bitwise the CP-off step, which is what keeps CP-on vs CP-off
+    # generation BITWISE (tests/test_long_context.py). "none"
+    # (default) = the single-pool layout, byte-for-byte unchanged.
+    kv_shard: str = "none"
+    # Number of context shards; 0 derives it from the mesh's ``seq``
+    # axis degree. On a mesh with seq > 1 the two must agree.
+    context_shards: int = 0
     # What gets published into the prefix tree: "complete" (default) —
     # the whole sequence, prompt + generated, at request completion (the
     # multi-turn case: the next turn's prompt extends this turn's
@@ -287,6 +309,79 @@ class ServingConfig:
                 f"{self.migration_queue_budget})"
             )
 
+    def resolved_context_shards(self, mesh_seq_degree: int = 1) -> int:
+        """The context-parallel degree this config resolves to on a mesh
+        with ``mesh_seq_degree`` sequence shards (1 when kv_shard is
+        off)."""
+        if self.kv_shard != "context":
+            return 1
+        return self.context_shards or max(1, int(mesh_seq_degree))
+
+    def validate_long_context(self, *, mesh_seq_degree: int = 1) -> None:
+        """Fail-fast validation of the context-parallel fields — called
+        from engine construction (like :meth:`validate_cluster`), so a
+        bad combination dies before any pool is allocated, naming the
+        fix instead of failing mid-serve."""
+        if self.kv_shard not in ("none", "context"):
+            raise ValueError(
+                f"unknown kv_shard {self.kv_shard!r} (expected 'none' "
+                "or 'context')"
+            )
+        if self.context_shards < 0:
+            raise ValueError(
+                f"context_shards must be >= 0 (got {self.context_shards})"
+            )
+        if self.kv_shard == "none":
+            if self.context_shards > 1:
+                raise ValueError(
+                    f"context_shards={self.context_shards} has no effect "
+                    "without kv_shard='context' — set kv_shard, or drop "
+                    "context_shards"
+                )
+            return
+        if self.kv_layout != "paged":
+            raise ValueError(
+                "kv_shard='context' requires kv_layout='paged' — context "
+                "parallelism shards KV PAGES across sequence shards, "
+                "which the dense per-slot layout does not have"
+            )
+        n = self.resolved_context_shards(mesh_seq_degree)
+        if n < 2:
+            raise ValueError(
+                "kv_shard='context' needs at least 2 shards: set "
+                f"context_shards >= 2 (got {self.context_shards}) or "
+                "serve on a mesh with a seq-axis degree > 1 "
+                f"(mesh seq degree is {mesh_seq_degree})"
+            )
+        if mesh_seq_degree > 1 and n != mesh_seq_degree:
+            raise ValueError(
+                f"context_shards ({n}) must equal the mesh seq-axis "
+                f"degree ({mesh_seq_degree}) when the mesh is sequence-"
+                "sharded — each shard owns one slice of the pool; set "
+                "context_shards=0 to derive the degree from the mesh"
+            )
+        if (
+            self.max_cached_tokens is not None
+            and self.max_cached_tokens < self.page_size
+        ):
+            raise ValueError(
+                f"kv_shard='context' prices max_cached_tokens "
+                f"({self.max_cached_tokens}) PER SHARD, and each shard "
+                f"needs at least one whole page (page_size="
+                f"{self.page_size}) — raise the budget or shrink "
+                "page_size"
+            )
+        if "rope_kv_write" in (self.fused_decode or ()) and (
+            mesh_seq_degree > 1
+        ):
+            raise ValueError(
+                "fused_decode='rope_kv_write' is not composed with ring "
+                "context parallelism on a sequence-sharded mesh — the "
+                "fused prologue commits K/V inside the single-shard "
+                "ragged kernel; drop the fusion or serve with "
+                "context_shards on a seq-degree-1 mesh"
+            )
+
     @property
     def cache_len(self) -> int:
         # Committed tokens + in-flight speculative tree slack
@@ -310,11 +405,14 @@ class ServingConfig:
 
     @property
     def num_pages(self) -> int:
-        """Physical pages in the pool (excluding the scratch page)."""
+        """Physical pages in the pool (excluding the scratch page).
+        Under ``kv_shard='context'`` this is the PER-SHARD page count
+        (``max_cached_tokens`` is a per-shard HBM budget); the engine
+        sizes the total pool at ``num_pages × context_shards``."""
         if self.max_cached_tokens is None:
             return self.max_requests_per_batch * self.pages_per_slot
         return max(
-            self.pages_per_slot,
+            self.pages_per_slot if self.kv_shard != "context" else 1,
             -(-self.max_cached_tokens // self.page_size),
         )
 
@@ -392,6 +490,22 @@ class InferenceEngine:
                 f"unknown kv_layout {self.serving.kv_layout!r} "
                 "(expected 'dense' or 'paged')"
             )
+        # Context-parallel long-context serving (kv_shard="context"):
+        # resolve the shard degree against this engine's mesh and fail
+        # bad combinations here, not mid-serve.
+        from ..core.mesh import SEQ_AXIS
+
+        seq_deg = self.mesh.shape.get(SEQ_AXIS, 1)
+        self.serving.validate_long_context(mesh_seq_degree=seq_deg)
+        self.cp_shards = self.serving.resolved_context_shards(seq_deg)
+        # per-shard BUDGET pages (quant-converted) the admission check
+        # enforces; set by _alloc_cache when max_cached_tokens is given
+        self.cp_budget_pages_per_shard = None
+        # the ring shard_map program only engages on a mesh that is
+        # actually sequence-sharded; on a seq-degree-1 mesh every shard
+        # is locally addressable and the plain table gather IS the ring
+        # result (bitwise the CP-off step — serve/kernels.py)
+        self.cp_ring = self.cp_shards > 1 and seq_deg > 1
         # Megakernel decode step: validate the fusion set up front so a
         # bad toggle fails at engine construction, not mid-serve.
         fused = self.serving.fused_decode
@@ -504,26 +618,56 @@ class InferenceEngine:
                     jnp.dtype(sc.cache_dtype).itemsize,
                     self.kv_quant_spec,
                 )
-            data = self.mesh.shape.get(DATA_AXIS, 1)
-            if data > 1:
-                # pool rows (num_pages + scratch) shard over data —
-                # round up so the leading dim divides evenly
-                num_pages += (-(num_pages + 1)) % data
+            extra_rows = 0
+            if self.cp_shards > 1:
+                # context parallelism: num_pages is the PER-SHARD
+                # budget; the pool holds every shard's slice. Like the
+                # single-pool layout (whose num_pages property clamps
+                # up to pages_per_slot), the ALLOCATOR is clamped to
+                # one slot's striped worst case so construction always
+                # succeeds — the admission check enforces the BUDGET
+                # (request_manager reads cp_budget_pages_per_shard, so
+                # an over-budget prompt is a terminal ERROR, the PR-2
+                # live-lock contract, never a constructor crash).
+                self.cp_budget_pages_per_shard = (
+                    num_pages if sc.max_cached_tokens is not None else None
+                )
+                per_shard = max(
+                    num_pages, -(-sc.pages_per_slot // self.cp_shards)
+                )
+                num_pages = per_shard * self.cp_shards
+                # The ring layout shards pool ROWS over the seq axis:
+                # pad with unreferenced rows until (total + scratch)
+                # divides the degree — the allocator never hands a pad
+                # row out (its num_pages excludes them) and the scratch
+                # row keeps index num_pages.
+                if self.cp_ring:
+                    extra_rows = (-(num_pages + 1)) % self.cp_shards
+            else:
+                data = self.mesh.shape.get(DATA_AXIS, 1)
+                if data > 1:
+                    # pool rows (num_pages + scratch) shard over data —
+                    # round up so the leading dim divides evenly
+                    num_pages += (-(num_pages + 1)) % data
             self.pager = PageAllocator(
                 num_pages, sc.pages_per_slot, sc.max_requests_per_batch,
-                sc.page_size,
+                sc.page_size, cp_shards=self.cp_shards,
             )
             self._table_cache = None  # fresh pager → stale device copy
+            init_kw = dict(kv_quant=sc.kv_quant)
+            if extra_rows:
+                init_kw["extra_rows"] = extra_rows
             init = functools.partial(
                 self.model.init_paged_kv_cache,
                 self.cfg,
                 num_pages,
                 sc.page_size,
                 sc.cache_dtype,
-                kv_quant=sc.kv_quant,
+                **init_kw,
             )
             pspec_fn = functools.partial(
-                self.model.paged_kv_cache_pspecs, kv_quant=sc.kv_quant
+                self.model.paged_kv_cache_pspecs, kv_quant=sc.kv_quant,
+                kv_shard=sc.kv_shard if self.cp_ring else None,
             )
         else:
             init = functools.partial(
@@ -644,6 +788,11 @@ class InferenceEngine:
                 kw["kv_quant"] = self.serving.kv_quant
             if "rope_kv_write" in self.serving.fused_decode:
                 kw["fused_rope"] = True
+            if self.cp_ring:
+                # sequence-sharded pool: attention reads go through the
+                # ring ragged paged program (partial shard_map over the
+                # seq axis; serve/kernels.ring_ragged_paged_attention)
+                kw["cp_mesh"] = self.mesh
             return functools.partial(self.model.serve_step_paged, **kw)
         return functools.partial(self.model.serve_step, **kw)
 

@@ -786,6 +786,202 @@ def ragged_paged_attention(
     return out.reshape(R, C, H, dk)
 
 
+# ---------------------------------------------------------------------------
+# Ring ragged paged attention (context-parallel serving,
+# ServingConfig.kv_shard="context"): one request's KV pages are
+# sequence-sharded over the mesh ``seq`` axis — shard d owns the
+# contiguous pool-row slice [d*rows_local, (d+1)*rows_local) and logical
+# pages stripe over shards (serve/paging.py PageAllocator cp_shards) —
+# and attention runs as a shard_map program: every shard computes
+# UNNORMALIZED online-softmax partials (o, m, l) over its RESIDENT pages
+# only (reads stay local — each shard touches its own HBM slice at full
+# bandwidth), the partial stats rotate around the ring via ``ppermute``,
+# and each shard merges them with the same m/l/o online-softmax carry
+# ``parallel/sequence._online_block`` uses for training ring attention.
+# The merge runs in ABSOLUTE shard order (0..n-1) on every shard, so the
+# result is deterministic and identical across shards — run-to-run
+# bitwise, though not bitwise vs the single-shard kernel (the per-shard
+# partial sums reassociate the softmax reduction; tests bound the drift
+# and assert greedy-token agreement instead).
+#
+# :func:`ring_ragged_paged_attention_xla` is the CPU-parity fallback
+# with a stronger contract: on a single-device (or replicated) layout
+# every shard's pages are locally addressable, so the full-table gather
+# IS the ring result — BITWISE the CP-off ``ragged_paged_attention_xla``
+# math. That is what makes CP-on vs CP-off generation bitwise on this
+# box (tests/test_long_context.py) and is the reference the shard_map
+# program is checked against.
+
+
+def ring_ragged_paged_attention_xla(
+    q: jnp.ndarray,           # (R, C, H, dk)
+    k_pool: jnp.ndarray,      # (rows, ps, KV, dk/pack)
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,  # (R, NP) int32
+    mask: jnp.ndarray,        # (R, C, NP*ps) bool
+    *,
+    scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    cp_shards: int = 1,
+) -> jnp.ndarray:
+    """``jnp.take``-based fallback of the ring kernel: gather the
+    virtual cache through the FULL page table and run the standard
+    masked softmax — bit-for-bit :func:`ragged_paged_attention_xla`
+    regardless of which shard's row slice each page lives in (the
+    gather is layout-blind), which is exactly the CP-on == CP-off
+    bitwise contract the engine's context-parallel mode serves under
+    on CPU. ``cp_shards`` documents the layout; the math ignores it."""
+    del cp_shards
+    return ragged_paged_attention_xla(
+        q, k_pool, v_pool, page_table, mask,
+        scale=scale, k_scale=k_scale, v_scale=v_scale,
+    )
+
+
+def _online_merge(o_a, m_a, l_a, o_b, m_b, l_b):
+    """Merge two unnormalized online-softmax partials — the carry
+    combine of ``parallel/sequence._online_block``, applied across
+    shards instead of across K/V blocks. Fully-masked partials carry
+    m = -inf and contribute nothing (the isfinite guards mirror the
+    training ring's padded-block handling)."""
+    m_new = jnp.maximum(m_a, m_b)
+    safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    ca = jnp.where(jnp.isfinite(m_a), jnp.exp(m_a - safe), 0.0)
+    cb = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - safe), 0.0)
+    l_new = l_a * ca + l_b * cb
+    o_new = o_a * ca[..., None] + o_b * cb[..., None]
+    return o_new, m_new, l_new
+
+
+def ring_ragged_paged_attention(
+    q: jnp.ndarray,           # (R, C, H, dk)
+    k_pool: jnp.ndarray,      # (rows, ps, KV, dk/pack) — rows % n == 0
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,  # (R, NP) int32 GLOBAL physical pages
+    mask: jnp.ndarray,        # (R, C, NP*ps) bool
+    mesh,
+    *,
+    scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # (rows, KV) f32 (quant pool)
+    v_scale: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Context-parallel ragged paged attention over a sequence-sharded
+    page pool (see the section comment above): per-shard resident-page
+    partials + ``ppermute`` stat rotation + online-softmax merge in
+    absolute shard order. The ``seq`` axis runs manually (partial
+    shard_map — other mesh axes stay under GSPMD); pool rows (and the
+    quant scale rows) shard over ``seq``, q/table/mask replicate.
+    Returns (R, C, H, dk). ``mesh.shape[seq] == 1`` degenerates to the
+    XLA fallback (nothing to rotate)."""
+    from jax import lax
+
+    from ..core.mesh import SEQ_AXIS, shard_map_unchecked
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[SEQ_AXIS]
+    if n <= 1:
+        return ring_ragged_paged_attention_xla(
+            q, k_pool, v_pool, page_table, mask,
+            scale=scale, k_scale=k_scale, v_scale=v_scale,
+        )
+    R, C, H, dk = q.shape
+    rows, ps, KV, dkp = k_pool.shape
+    if rows % n:
+        raise ValueError(
+            f"ring ragged paged attention needs pool rows ({rows}) "
+            f"divisible by the seq degree ({n}) — the engine pads the "
+            "pool with unreferenced rows to align the shard slices"
+        )
+    rows_local = rows // n
+    G = H // KV
+    quant = k_scale is not None
+    scale_f = scale if scale is not None else 1.0 / math.sqrt(dk)
+
+    def body(q_, kp, vp, pt, mk, *scales):
+        i = lax.axis_index(SEQ_AXIS)
+        # translate the GLOBAL table to this shard's rows: resident
+        # pages keep their local row, everything else reads local row 0
+        # and is masked out of the partial (the caller's mask already
+        # excludes scratch-backed positions; the residency mask
+        # additionally excludes pages another shard owns)
+        resident = (pt // rows_local) == i          # (R, NP)
+        lpt = jnp.where(resident, pt % rows_local, 0)
+        if quant:
+            ks_, vs_ = scales
+            k_virt = dequant_pages(kp, ks_, lpt, q_.dtype)
+            v_virt = dequant_pages(vp, vs_, lpt, q_.dtype)
+        else:
+            k_virt = gather_pages(kp, lpt)          # (R, S, KV, dk)
+            v_virt = gather_pages(vp, lpt)
+        res_cols = jnp.repeat(resident, ps, axis=1)  # (R, NP*ps)
+        mk_loc = mk & res_cols[:, None, :]           # (R, C, S)
+        qg = q_.reshape(R, C, KV, G, dk)
+        scores = jnp.einsum(
+            "rckgd,rskd->rckgs", qg, k_virt,
+            preferred_element_type=jnp.float32,
+        ) * scale_f                                  # (R, C, KV, G, S)
+        mm = mk_loc[:, :, None, None, :]
+        scores = jnp.where(mm, scores, -jnp.inf)
+        m_loc = scores.max(axis=-1)                  # (R, C, KV, G)
+        safe_m = jnp.where(jnp.isfinite(m_loc), m_loc, 0.0)
+        p = jnp.where(mm, jnp.exp(scores - safe_m[..., None]), 0.0)
+        l_loc = p.sum(axis=-1)
+        o_loc = jnp.einsum(
+            "rckgs,rskd->rckgd", p, v_virt.astype(jnp.float32)
+        )
+        # ring: rotate the (o, m, l) partials n-1 hops; parts[s] on
+        # shard i originated at shard (i - s) % n
+        perm = [(s, (s + 1) % n) for s in range(n)]
+        cur = (o_loc, m_loc, l_loc)
+        parts = [cur]
+        for _ in range(n - 1):
+            cur = tuple(
+                lax.ppermute(x, SEQ_AXIS, perm) for x in cur
+            )
+            parts.append(cur)
+        stk = tuple(
+            jnp.stack([p_[t] for p_ in parts]) for t in range(3)
+        )
+        # merge in ABSOLUTE shard order 0..n-1 — every shard applies
+        # the identical association, so the output replicates exactly
+        def merge_j(j, carry):
+            s = (i - j) % n  # which rotation slot holds shard j's part
+            o_b = jnp.take(stk[0], s, axis=0)
+            m_b = jnp.take(stk[1], s, axis=0)
+            l_b = jnp.take(stk[2], s, axis=0)
+            return _online_merge(*carry, o_b, m_b, l_b)
+        o0 = jnp.zeros_like(o_loc)
+        m0 = jnp.full_like(m_loc, -jnp.inf)
+        l0 = jnp.zeros_like(l_loc)
+        o, m, l = lax.fori_loop(0, n, merge_j, (o0, m0, l0))
+        out = o / jnp.maximum(l, 1e-20)[..., None]
+        return out.astype(q_.dtype).reshape(R, C, H, dk)
+
+    rep = P(None, None, None, None)
+    in_specs = [
+        rep,                                  # q
+        P(SEQ_AXIS, None, None, None),        # k_pool rows
+        P(SEQ_AXIS, None, None, None),        # v_pool rows
+        P(None, None),                        # page table (global)
+        P(None, None, None),                  # mask
+    ]
+    operands = [q, k_pool, v_pool, page_table.astype(jnp.int32), mask]
+    if quant:
+        in_specs += [P(SEQ_AXIS, None), P(SEQ_AXIS, None)]
+        operands += [
+            k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)
+        ]
+    fn = shard_map_unchecked(
+        body, mesh, tuple(in_specs), rep, manual_axes={SEQ_AXIS}
+    )
+    # partial-manual shard_map has no eager impl on jax 0.4.x — jit the
+    # call (a no-op inside the engine's already-jitted step programs,
+    # where this runs in production; standalone/test callers get the
+    # same compiled path)
+    return jax.jit(fn)(*operands)
+
+
 def fused_rope_paged_attention(
     q: jnp.ndarray,           # (R, C, H, dk) — PRE-RoPE query projection
     k_new: jnp.ndarray,       # (R, C, KV, dk) — PRE-RoPE key projection

@@ -53,6 +53,21 @@ Physical page ``num_pages`` (one past the pool) is the shared
 tokens' K/V writes and gathers through unallocated entries land on a
 real buffer that no mask ever exposes (the paged analog of the dense
 layout's per-slot scratch row, models/llama.py init_kv_cache).
+
+Context parallelism (``ServingConfig.kv_shard="context"``): with
+``cp_shards`` > 1 the pool is partitioned into per-shard slices —
+shard ``d`` owns physical pages ``[d*pages_per_shard,
+(d+1)*pages_per_shard)`` (the contiguous row range that shards over
+the mesh ``seq`` axis) — and LOGICAL page ``j`` of every request is
+STRIPED to shard ``j % cp_shards``, so one long request's pages (and
+its decode-time reads) spread evenly over the shards instead of
+filling one shard's slice while the others idle. All allocation is
+per-shard: ``ensure`` covers each shard's share of the growth
+all-or-nothing, ``cow``/``take_free_page`` draw from the logical
+page's owning shard, and ``check_no_leaks`` additionally audits the
+striping invariant (every mapped logical page lives on its owning
+shard) and the per-shard free-list partition. ``cp_shards=1``
+(default) is byte-for-byte the single-pool allocator.
 """
 from __future__ import annotations
 
@@ -80,19 +95,46 @@ class PageAllocator:
     """
 
     def __init__(self, num_pages: int, pages_per_slot: int, num_slots: int,
-                 page_size: int):
-        if num_pages < pages_per_slot:
+                 page_size: int, cp_shards: int = 1):
+        if num_pages < pages_per_slot and cp_shards == 1:
             raise ValueError(
                 f"page pool ({num_pages} pages) smaller than one request's "
                 f"worst case ({pages_per_slot} pages) — no request could "
                 "ever run to max_sequence_length"
             )
+        if cp_shards < 1:
+            raise ValueError(f"cp_shards must be >= 1 (got {cp_shards})")
+        if num_pages % cp_shards:
+            raise ValueError(
+                f"context-parallel pool needs num_pages ({num_pages}) "
+                f"divisible by cp_shards ({cp_shards}) — the engine sizes "
+                "per-shard slices of equal page count"
+            )
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.pages_per_slot = int(pages_per_slot)
+        self.cp_shards = int(cp_shards)
+        self.pages_per_shard = self.num_pages // self.cp_shards
+        if cp_shards > 1 and -(-int(pages_per_slot) // cp_shards) > (
+            self.pages_per_shard
+        ):
+            raise ValueError(
+                f"context-parallel pool ({num_pages} pages over "
+                f"{cp_shards} shards, {self.pages_per_shard}/shard) "
+                f"smaller than one request's worst case "
+                f"({pages_per_slot} striped logical pages = "
+                f"{-(-pages_per_slot // cp_shards)}/shard) — no request "
+                "could ever run to max_sequence_length"
+            )
         self.scratch_page = int(num_pages)  # pool row num_pages is scratch
-        # pop() takes from the end: keep ascending ids there
-        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        # per-shard free lists (one list when cp_shards == 1 — the
+        # single-pool allocator, unchanged); pop() takes from the end:
+        # keep ascending ids there
+        self._free_by_shard: List[List[int]] = [
+            list(range((d + 1) * self.pages_per_shard - 1,
+                       d * self.pages_per_shard - 1, -1))
+            for d in range(self.cp_shards)
+        ]
         self.refcount = np.zeros((num_pages,), np.int32)
         self.table = np.full(
             (num_slots, pages_per_slot), self.scratch_page, np.int32
@@ -104,18 +146,66 @@ class PageAllocator:
         # Last-resort page supplier: called with the shortfall (pages)
         # when the free list cannot cover a request; expected to free
         # reclaimable pages (the prefix cache evicts idle cached pages)
-        # and return how many it freed. None = allocation just fails.
+        # and return how many it freed. Under context parallelism the
+        # call carries ``shard=`` so reclaim frees pages on the shard
+        # that is actually short. None = allocation just fails.
         self.reclaim_cb: Optional[Callable[[int], int]] = None
+
+    # ------------------------------------------------------------------
+    # context-parallel partition (no-ops collapsing to shard 0 when
+    # cp_shards == 1)
+
+    def shard_of_logical(self, logical: int) -> int:
+        """Owning shard of a LOGICAL page index — striped so consecutive
+        logical pages land on consecutive shards (decode reads and long
+        prompts load-balance)."""
+        return int(logical) % self.cp_shards
+
+    def shard_of_page(self, page: int) -> int:
+        """Owning shard of a PHYSICAL page (contiguous row slices)."""
+        return int(page) // self.pages_per_shard
+
+    def shard_page_need(self, num_lines: int) -> List[int]:
+        """Pages each shard must supply to cover lines [0, num_lines)
+        under the striped ownership."""
+        need = self.pages_for(num_lines)
+        base, rem = divmod(need, self.cp_shards)
+        return [base + (1 if d < rem else 0) for d in range(self.cp_shards)]
+
+    def can_ever_fit(self, num_lines: int) -> bool:
+        """Whether a request needing ``num_lines`` cache lines could ever
+        be admitted into an EMPTY pool — the per-shard admission bound
+        (each shard must cover its striped share)."""
+        return all(
+            n <= self.pages_per_shard
+            for n in self.shard_page_need(num_lines)
+        )
+
+    def free_pages_by_shard(self) -> List[int]:
+        return [len(f) for f in self._free_by_shard]
+
+    def used_pages_by_shard(self) -> List[int]:
+        return [
+            self.pages_per_shard - len(f) for f in self._free_by_shard
+        ]
+
+    def shard_balance(self) -> float:
+        """Occupancy balance gauge: min/max used pages across shards
+        (1.0 = perfectly balanced or idle) — the striping telemetry
+        SchedulerStats surfaces."""
+        used = self.used_pages_by_shard()
+        hi = max(used)
+        return 1.0 if hi == 0 else min(used) / hi
 
     # ------------------------------------------------------------------
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free_by_shard)
 
     @property
     def used_pages(self) -> int:
-        return self.num_pages - len(self._free)
+        return self.num_pages - self.free_pages
 
     def slot_pages(self, slot: int) -> int:
         """Physical pages currently mapped by ``slot``'s table."""
@@ -138,32 +228,41 @@ class PageAllocator:
 
     def release_ref(self, page: int) -> bool:
         """Drop one reference; when the count drains to zero the page
-        returns to the free list. Returns True iff the page was freed.
-        Decrementing a zero refcount is a double-free (asserted)."""
+        returns to its owning shard's free list. Returns True iff the
+        page was freed. Decrementing a zero refcount is a double-free
+        (asserted)."""
         assert self.refcount[page] > 0, f"double free of physical page {page}"
         self.refcount[page] -= 1
         if self.refcount[page] == 0:
-            self._free.append(page)
+            self._free_by_shard[self.shard_of_page(page)].append(int(page))
             return True
         return False
 
-    def _reclaim(self, shortfall: int) -> None:
-        """Ask the reclaim hook (prefix-cache LRU eviction) to free at
-        least ``shortfall`` pages. Best-effort: the free list after the
-        call is the only truth."""
+    def _reclaim(self, shortfall: int, shard: int = 0) -> None:
+        """Ask the reclaim hook (prefix-cache LRU eviction/spill) to free
+        at least ``shortfall`` pages — on ``shard`` under context
+        parallelism (freeing another shard's pages cannot satisfy a
+        striped allocation). Best-effort: the free list after the call
+        is the only truth."""
         if shortfall > 0 and self.reclaim_cb is not None:
-            self.reclaim_cb(shortfall)
+            if self.cp_shards > 1:
+                self.reclaim_cb(shortfall, shard=shard)
+            else:
+                self.reclaim_cb(shortfall)
 
-    def take_free_page(self) -> Optional[int]:
-        """Pop one page off the free list (evicting idle cached pages
-        first if it is dry), with refcount still ZERO — the caller must
-        follow up with :meth:`acquire`/:meth:`splice` before control
-        returns to the scheduler. None when nothing can be freed."""
-        if not self._free:
-            self._reclaim(1)
-        if not self._free:
+    def take_free_page(self, shard: int = 0) -> Optional[int]:
+        """Pop one page off ``shard``'s free list (evicting idle cached
+        pages first if it is dry), with refcount still ZERO — the caller
+        must follow up with :meth:`acquire`/:meth:`splice` before
+        control returns to the scheduler. None when nothing can be
+        freed. Callers allocating for a specific LOGICAL page pass
+        ``shard_of_logical(logical)`` so the striping invariant holds."""
+        free = self._free_by_shard[shard]
+        if not free:
+            self._reclaim(1, shard)
+        if not free:
             return None
-        return self._free.pop()
+        return free.pop()
 
     # ------------------------------------------------------------------
 
@@ -173,25 +272,35 @@ class PageAllocator:
         Contract: already-covered prefixes are kept (idempotent —
         calling again with the same or a smaller bound changes nothing);
         growth pages are freshly allocated with refcount 1 owned by this
-        slot. When the free list cannot cover the growth even after
-        ``reclaim_cb`` eviction, returns False with NOTHING allocated —
-        the caller preempts a victim and retries. Returns True once the
-        lines are covered."""
+        slot — each logical page from its OWNING shard's free list
+        (striped, ``shard_of_logical``). When the free lists cannot
+        cover the growth even after ``reclaim_cb`` eviction, returns
+        False with NOTHING allocated — the caller preempts a victim and
+        retries. Returns True once the lines are covered."""
         need = min(self.pages_for(num_lines), self.pages_per_slot)
         row = self.table[slot]
         have = int((row[:need] != self.scratch_page).sum())
-        grow = need - have
-        if grow <= 0:
+        if need - have <= 0:
             return True
-        if grow > len(self._free):
-            self._reclaim(grow - len(self._free))
-        if grow > len(self._free):
+        # all-or-nothing across shards: reclaim each short shard first,
+        # allocate only once every shard can cover its striped share
+        grow_by_shard = [0] * self.cp_shards
+        for j in range(have, need):
+            grow_by_shard[self.shard_of_logical(j)] += 1
+        for d, grow in enumerate(grow_by_shard):
+            short = grow - len(self._free_by_shard[d])
+            if short > 0:
+                self._reclaim(short, d)
+        if any(
+            grow > len(self._free_by_shard[d])
+            for d, grow in enumerate(grow_by_shard)
+        ):
             return False
         for j in range(have, need):
             assert row[j] == self.scratch_page, (
                 f"slot {slot} page table has a hole before logical page {j}"
             )
-            page = self._free.pop()
+            page = self._free_by_shard[self.shard_of_logical(j)].pop()
             assert self.refcount[page] == 0, (
                 f"free list held referenced page {page}"
             )
@@ -205,13 +314,24 @@ class PageAllocator:
         prompt prefix), acquiring one reference per entry. The slot's
         table must be empty (fresh admission) — splicing is only ever
         the FIRST thing that happens to a slot's table, before
-        :meth:`ensure` grows the uncached suffix behind it."""
+        :meth:`ensure` grows the uncached suffix behind it. Cached
+        blocks are logical-page-aligned from the root, so under context
+        parallelism a spliced page is on its logical index's owning
+        shard by construction (asserted)."""
         row = self.table[slot]
         assert int((row != self.scratch_page).sum()) == 0, (
             f"splice into non-empty slot {slot}"
         )
         assert len(pages) <= self.pages_per_slot
         for j, page in enumerate(pages):
+            if self.cp_shards > 1:
+                assert self.shard_of_page(int(page)) == (
+                    self.shard_of_logical(j)
+                ), (
+                    f"splice breaks striping: logical page {j} (shard "
+                    f"{self.shard_of_logical(j)}) mapped to physical "
+                    f"{int(page)} (shard {self.shard_of_page(int(page))})"
+                )
             self.acquire(int(page))
             row[j] = int(page)
         if len(pages):
@@ -219,15 +339,16 @@ class PageAllocator:
 
     def cow(self, slot: int, logical: int) -> Optional[int]:
         """Copy-on-write bookkeeping for ``slot``'s logical page
-        ``logical``: allocate a private page (refcount 1), swap it into
-        the table, and drop this slot's reference on the shared page.
-        Returns the new physical page (the caller copies the page
-        CONTENT device-side, engine.copy_page), or None when no page
-        could be allocated even after reclaim — the table is unchanged."""
+        ``logical``: allocate a private page (refcount 1, from the
+        logical page's owning shard), swap it into the table, and drop
+        this slot's reference on the shared page. Returns the new
+        physical page (the caller copies the page CONTENT device-side,
+        engine.copy_page), or None when no page could be allocated even
+        after reclaim — the table is unchanged."""
         row = self.table[slot]
         old = int(row[logical])
         assert old != self.scratch_page, "COW of an unmapped logical page"
-        fresh = self.take_free_page()
+        fresh = self.take_free_page(self.shard_of_logical(logical))
         if fresh is None:
             return None
         self.refcount[fresh] = 1
@@ -267,14 +388,33 @@ class PageAllocator:
         ``page_refs()``), and a page is free iff that count is zero."""
         external = external or {}
         counts = np.zeros((self.num_pages,), np.int64)
-        for row in self.table:
-            for page in row:
-                if int(page) != self.scratch_page:
-                    counts[int(page)] += 1
+        for slot, row in enumerate(self.table):
+            for j, page in enumerate(row):
+                if int(page) == self.scratch_page:
+                    continue
+                counts[int(page)] += 1
+                if self.cp_shards > 1:
+                    # striping invariant: every mapped logical page
+                    # lives on its owning shard
+                    assert self.shard_of_page(int(page)) == (
+                        self.shard_of_logical(j)
+                    ), (
+                        f"slot {slot} logical page {j} (shard "
+                        f"{self.shard_of_logical(j)}) maps to physical "
+                        f"{int(page)} on shard "
+                        f"{self.shard_of_page(int(page))}"
+                    )
         for page, n in external.items():
             counts[int(page)] += int(n)
-        free = set(self._free)
-        assert len(free) == len(self._free), "free list holds duplicates"
+        all_free = [p for f in self._free_by_shard for p in f]
+        free = set(all_free)
+        assert len(free) == len(all_free), "free list holds duplicates"
+        for d, flist in enumerate(self._free_by_shard):
+            for p in flist:
+                assert self.shard_of_page(p) == d, (
+                    f"page {p} (shard {self.shard_of_page(p)}) on shard "
+                    f"{d}'s free list"
+                )
         for page in range(self.num_pages):
             rc = int(self.refcount[page])
             assert rc == int(counts[page]), (

@@ -296,15 +296,20 @@ class PrefixCache:
     # ------------------------------------------------------------------
     # admission: splice + COW
 
-    def _readmit(self, node: _Node) -> bool:
+    def _readmit(self, node: _Node, logical: int = 0) -> bool:
         """Bring one HOST-resident node back to the device: take a free
-        page, upload the spilled content into it (async host→device,
-        ordered before any step that reads it) and hand the tree's
-        reference over to the new page. Byte-exact — codes and scales
-        land exactly as spilled, so generation over the re-admitted
-        prefix is bitwise the warm path's. False when no page could be
-        freed even by further spilling (the match truncates there)."""
-        fresh = self.pager.take_free_page()
+        page — from logical page ``logical``'s owning shard under
+        context parallelism, so re-admitted pages land back on the
+        striped layout — upload the spilled content into it (async
+        host→device, ordered before any step that reads it) and hand
+        the tree's reference over to the new page. Byte-exact — codes
+        and scales land exactly as spilled, so generation over the
+        re-admitted prefix is bitwise the warm path's. False when no
+        page could be freed even by further spilling (the match
+        truncates there)."""
+        fresh = self.pager.take_free_page(
+            self.pager.shard_of_logical(logical)
+        )
         if fresh is None:
             return False
         self.pager.refcount[fresh] = 1  # the tree's reference
@@ -347,7 +352,7 @@ class PrefixCache:
         self._pinned = set(map(id, nodes))
         try:
             for i, n in enumerate(nodes):
-                if n.host is not None and not self._readmit(n):
+                if n.host is not None and not self._readmit(n, logical=i):
                     # nodes[:-1] are full blocks: i full blocks match
                     nodes = nodes[:i]
                     matched = i * self.page_size
@@ -356,7 +361,11 @@ class PrefixCache:
             cow_src = None
             if matched % self.page_size:
                 # request appends K/V into the tail page → private copy
-                fresh = self.pager.take_free_page()
+                # (from the tail's owning shard — logical page index
+                # len(pages)-1 — so the striping invariant holds)
+                fresh = self.pager.take_free_page(
+                    self.pager.shard_of_logical(len(pages) - 1)
+                )
                 if fresh is None:
                     matched -= matched % self.page_size
                     pages = pages[:-1]
@@ -460,16 +469,22 @@ class PrefixCache:
         )
         del bucket[victim.tokens]
 
-    def _evict_one(self) -> bool:
+    def _evict_one(self, shard: Optional[int] = None) -> bool:
         """Free the least-recently-used idle leaf (refcount 1 — held
         only by the tree, no slot references, no children pinning it as
-        interior). Returns False when nothing is evictable."""
+        interior) — on ``shard`` when given (context parallelism:
+        reclaim for a striped allocation must free a page the SHORT
+        shard owns). Returns False when nothing is evictable."""
         victim = None
         for n in self._nodes():
             if not n.is_leaf or n.host is not None or id(n) in self._pinned:
                 continue
             if int(self.pager.refcount[n.page]) != 1:
                 continue  # spliced into a live slot — not idle
+            if shard is not None and (
+                self.pager.shard_of_page(n.page) != shard
+            ):
+                continue  # another shard's page cannot cover this need
             if victim is None or n.last_used < victim.last_used:
                 victim = n
         if victim is None:
@@ -484,19 +499,27 @@ class PrefixCache:
         )
         return True
 
-    def _spill_one(self) -> bool:
+    def _spill_one(self, shard: Optional[int] = None) -> bool:
         """Spill the LRU idle (refcount-1) DEVICE-resident node to the
         host tier: async device→host content copy, page freed, node
         kept in the tree as host-resident. Unlike :meth:`_evict_one`
         this needs no leaf restriction — the node stays in place, so
-        interior chains remain walkable. Returns False when nothing is
-        spillable."""
+        interior chains remain walkable. ``shard`` filters victims to
+        one shard's pages (context parallelism) — which is also what
+        keeps the HOT TAIL resident while cold MIDDLE pages spill: a
+        long request's tail pages are the recently-used ones on every
+        shard, so per-shard LRU never picks them first. Returns False
+        when nothing is spillable."""
         victim = None
         for n in self._nodes():
             if n.host is not None or id(n) in self._pinned:
                 continue
             if int(self.pager.refcount[n.page]) != 1:
                 continue  # spliced into a live slot — not idle
+            if shard is not None and (
+                self.pager.shard_of_page(n.page) != shard
+            ):
+                continue  # reclaim must free the SHORT shard's HBM
             if victim is None or n.last_used < victim.last_used:
                 victim = n
         if victim is None:
@@ -567,17 +590,20 @@ class PrefixCache:
                 }
         self._pending_spills.clear()
 
-    def reclaim(self, shortfall: int) -> int:
+    def reclaim(self, shortfall: int, shard: Optional[int] = None) -> int:
         """Free ``shortfall`` pages: spill LRU idle cached pages to the
         host tier when it is enabled (content survives, HBM frees),
         else evict LRU idle leaves outright. Evicting a leaf can expose
         its parent as the next leaf, so deep idle chains peel
-        bottom-up. Returns the number of pages freed."""
+        bottom-up. Under context parallelism the allocator passes the
+        SHORT shard — only that shard's pages are candidates (freeing
+        another shard's HBM cannot satisfy a striped allocation).
+        Returns the number of pages freed."""
         freed = 0
         while freed < shortfall:
             ok = (
-                self._spill_one() if self.spill_enabled
-                else self._evict_one()
+                self._spill_one(shard) if self.spill_enabled
+                else self._evict_one(shard)
             )
             if not ok:
                 break

@@ -328,6 +328,7 @@ class RequestManager:
         self._admit_counter += 1
         if profile is not None:
             req.profile = profile
+        req.profile.context_shards = getattr(self.engine, "cp_shards", 1)
         self.requests[rid] = req
         self.slots[slot] = rid
         self.stats.admitted += 1
@@ -533,12 +534,15 @@ class RequestManager:
             )
         if self._paged:
             # with kv_quant the max_cached_tokens budget is an HBM
-            # budget that buys ~2x the pages — the allocator's actual
+            # budget that buys ~2x the pages, and under kv_shard=
+            # "context" it is a PER-SHARD budget the striped layout
+            # multiplies — in both cases the allocator's actual
             # capacity (checked below) is the authoritative bound, and
             # the raw token figure would wrongly reject servable prompts
             if (
                 sc.max_cached_tokens is not None
                 and sc.kv_quant is None
+                and sc.kv_shard != "context"
                 and need > sc.max_cached_tokens
             ):
                 return (
@@ -553,6 +557,36 @@ class RequestManager:
                         f"prompt ({len(req.tokens)} tokens) exceeds the "
                         f"KV page pool ({cap} tokens)"
                     )
+                cp = getattr(eng, "cp_shards", 1)
+                if cp > 1:
+                    # context parallelism: admission goes PER SHARD —
+                    # logical page j lives on shard j % n, so every
+                    # shard must cover its striped share of the prompt
+                    # out of its own budget (max_cached_tokens prices
+                    # ONE shard; the allocator itself is clamped to the
+                    # worst case so the budget is enforced here, the
+                    # same split as the single-pool raw-token check)
+                    budget = getattr(eng, "cp_budget_pages_per_shard",
+                                     None)
+                    need_per_shard = -(-eng.pager.pages_for(need) // cp)
+                    if budget is not None and need_per_shard > budget:
+                        return (
+                            f"prompt ({len(req.tokens)} tokens) can "
+                            f"never fit the per-shard KV budget: its "
+                            f"striped share is {need_per_shard} pages/"
+                            f"shard vs a budget of {budget} "
+                            f"(max_cached_tokens="
+                            f"{sc.max_cached_tokens} per shard × "
+                            f"{cp} context shards) — raise the budget "
+                            "or context_shards"
+                        )
+                    if not eng.pager.can_ever_fit(need):
+                        per = eng.pager.pages_per_shard
+                        return (
+                            f"prompt ({len(req.tokens)} tokens) "
+                            f"exceeds the per-shard page pool ({per} "
+                            f"pages/shard × {cp} context shards)"
+                        )
         return None
 
     def _admit_pending(self):
@@ -603,6 +637,7 @@ class RequestManager:
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
             req.profile.cached_prefix_len = matched
+            req.profile.context_shards = getattr(self.engine, "cp_shards", 1)
             # tokens of this prefix that came back from the HOST tier
             # (the stats counter moved inside attach's re-admissions)
             req.profile.host_hit_tokens = (
@@ -1071,6 +1106,15 @@ class RequestManager:
         self._admit_pending()
 
     def _maybe_log_stats(self):
+        # context-parallel telemetry, refreshed per dispatched step so
+        # bench-style stat swaps (rm.stats = SchedulerStats()) keep the
+        # gauges: shard degree, ring hops a sequence-sharded mesh pays
+        # per attention read, and the striping balance of the pool.
+        cp = getattr(self.engine, "cp_shards", 1)
+        if cp > 1 and self._paged:
+            self.stats.cp_shards = cp
+            self.stats.ring_steps += cp - 1
+            self.stats.shard_balance = self.engine.pager.shard_balance()
         if self._step_counter % 200 == 0:
             self._log.debug("%s", self.stats.report())
 
