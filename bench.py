@@ -215,6 +215,14 @@ _PHASES = [
     # failed-over outputs vs the fault-free run, zero hung requests,
     # zero steady-state recompiles on survivors asserted
     ("serve_faults", 700, 500, True, True),
+    # multi-host cluster transport: loopback-transported replicas
+    # (every Replica call through the binary RPC wire codec) with a
+    # warm standby — kill the replica holding a set of prefix families
+    # and measure warm-standby adoption vs cold re-seed (post-failover
+    # prefix hit rate on the adopted families > 0 asserted), plus wire
+    # bytes / rpc-retry counters and zero steady-state recompiles on
+    # every untripped replica
+    ("serve_transport", 700, 500, True, True),
     # adaptive speculation: acceptance-driven W×D tree shaping vs the
     # fixed tree (drafted accept rate >=3x asserted) + the early-exit
     # self-draft's tokens/sec vs non-speculative continuous batching
@@ -447,6 +455,28 @@ def orchestrate(which):
                 output_parity=d.get("output_parity"),
                 platform=d.get("platform"),
             )
+
+    # Derived: warm-standby adoption value — the post-failover prefix
+    # hit rate on the dead replica's families (warm standby vs cold
+    # re-seed) plus the transport's wire accounting, so BENCH_r*.json
+    # tracks the multi-host failover envelope across rounds.
+    rec = _RESULTS.get("transport_standby_warm_hit_rate")
+    if rec:
+        d = rec.get("detail") or {}
+        emit(
+            "standby_warm_hit_rate",
+            rec["value"],
+            "fraction",
+            source=rec["metric"],
+            cold_reseed_hit_rate=d.get("cold_reseed_hit_rate"),
+            standby_adoptions=d.get("standby_adoptions"),
+            wire_bytes_sent=d.get("wire_bytes_sent"),
+            wire_bytes_received=d.get("wire_bytes_received"),
+            rpc_retries=d.get("rpc_retries"),
+            rpc_errors=d.get("rpc_errors"),
+            output_parity=d.get("output_parity"),
+            platform=d.get("platform"),
+        )
 
     # Derived: decode-step latency, so BENCH_r*.json tracks step time
     # across rounds. The serve_fused phase measures it fused AND
@@ -2832,6 +2862,200 @@ def serve_faults_bench(on_tpu, kernels):
     return faulted["tps"]
 
 
+def serve_transport_bench(on_tpu, kernels):
+    """Multi-host cluster transport (serve/cluster/transport.py +
+    remote.py): a LOOPBACK-transported cluster — every Replica call
+    round-trips the length-prefixed binary wire codec — with warm
+    standbys, under a replica death.
+
+    Two runs on the SAME prefix-family workload: (a) WARM — one
+    standby; on the DOWN transition it adopts the dead replica's radix
+    tree (block keys + page bytes over the transport) and its routing
+    position, so post-failover requests from the adopted families hit
+    the prefix cache immediately; (b) COLD — no standby; survivors
+    re-seed those families from scratch. ASSERTED: the warm arm's
+    post-failover hit rate on the dead replica's families is > 0 AND
+    strictly above the cold arm's, every request terminal with zero
+    errors in both arms, outputs bitwise across arms (placement moves,
+    greedy tokens must not), standby_adoptions == 1, and ZERO
+    steady-state recompiles on every replica that never tripped
+    (strict retrace sanitizer). Reported: post-failover hit rates,
+    tokens/sec both arms, wire bytes both ways, rpc error/retry/
+    heartbeat-gap counters and migrated tree size.
+
+    Measurement caveat (CPU): loopback replicas time-slice one device,
+    so tokens/sec measures transport + failover overhead at parity
+    scale, not multi-host capacity; the hit-rate A/B and the wire-byte
+    accounting are platform-independent signals."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import ClusterManager, ServingConfig
+    from flexflow_tpu.serve.cluster import Fault, FaultPlan, HealthState
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_slots = 16 if on_tpu else 8
+    n_new = 16 if on_tpu else 8
+    prompt_len = 48 if on_tpu else 20
+    page_size = 64 if on_tpu else 8
+    n_families = 5
+    wall_budget = 900.0 if on_tpu else 420.0
+    if not on_tpu and kernels == "pallas":
+        _log("serve_transport: forcing kernels=xla off-TPU")
+        kernels = "xla"
+
+    def family_prompt(fid, j):
+        head = [(fid * 101 + 5 + k) % cfg.vocab_size
+                for k in range(prompt_len - 6)]
+        return head + [(j * 13 + k) % cfg.vocab_size for k in range(6)]
+
+    # seed in TWO sequential waves: wave A misses everywhere and
+    # least-loaded spreads the families across the replicas (the
+    # partition), wave B prefix-routes each family to its seeding
+    # replica — one replica per family, so the cold arm's survivors
+    # genuinely do NOT hold the victim's families
+    seed_wave_a = [family_prompt(f, 0) for f in range(n_families)]
+    seed_wave_b = [family_prompt(f, 1) for f in range(n_families)]
+    main_wave = [family_prompt(f, 2) for f in range(n_families)]
+
+    def run(standby):
+        sc = ServingConfig(
+            max_requests_per_batch=n_slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=16 if on_tpu else 8,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=page_size,
+            prefix_caching=True,
+            replicas=2,
+            router_policy="prefix",
+            replica_transport="loopback",
+            standby_replicas=1 if standby else 0,
+            sanitizers=("retrace",),
+        )
+        cm = ClusterManager.build(llama, cfg, params, sc)
+        t0 = _time.perf_counter()
+        cm.generate(seed_wave_a, max_new_tokens=n_new)
+        cm.generate(seed_wave_b, max_new_tokens=n_new)
+        scores = [
+            sum(rep.prefix_score(family_prompt(f, 3))
+                for f in range(n_families))
+            for rep in cm.replicas
+        ]
+        victim = max(range(2), key=lambda i: scores[i])
+        victim_families = [
+            f for f in range(n_families)
+            if cm.replicas[victim].prefix_score(family_prompt(f, 3)) > 0
+        ]
+        cm.attach_faults(FaultPlan([Fault(
+            "crash", replica=victim,
+            step=cm.replicas[victim].steps_taken + 2,
+        )]))
+        cids = [cm.submit(p, max_new_tokens=n_new) for p in main_wave]
+        while not cm.stats.replica_down:
+            assert _time.perf_counter() - t0 < wall_budget, "fault never fired"
+            cm.step()
+        # POST-FAILOVER wave from the dead replica's families — the
+        # warm-vs-cold measurement: do these hit the prefix cache?
+        post = [
+            cm.submit(family_prompt(f, 4 + j), max_new_tokens=n_new)
+            for f in victim_families for j in range(2)
+        ]
+        cids += post
+        while any(not cm._terminal(c) for c in cids):
+            assert _time.perf_counter() - t0 < wall_budget, (
+                f"hung requests (health={cm.health_snapshot()})"
+            )
+            if not cm.step():
+                break
+        cm.drain()
+        wall = _time.perf_counter() - t0
+        results = [cm.result(c) for c in cids]
+        errors = sum(1 for r in results if r.error is not None)
+        tokens = sum(len(r.output_tokens) for r in results)
+        post_hits = [
+            cm.result(c).profile.cached_prefix_len > 0 for c in post
+        ]
+        for pos, rep in enumerate(cm.replicas):
+            if (
+                cm.health[pos].state is not HealthState.DOWN
+                and cm.health[pos].trips == 0
+            ):
+                assert rep.rm.stats.retraces == 0, (
+                    f"replica {pos}: {rep.rm.stats.retraces} steady-state "
+                    "recompiles"
+                )
+        if cm.fault_injector is not None:
+            cm.fault_injector.release_all()
+        cm.check_no_leaks()
+        return {
+            "outs": [list(r.output_tokens) for r in results],
+            "errors": errors,
+            "tps": tokens / wall,
+            "post_hit_rate": (
+                sum(post_hits) / len(post_hits) if post_hits else 0.0
+            ),
+            "victim_families": len(victim_families),
+            "stats": cm.cluster_stats(),
+        }
+
+    warm = run(standby=True)
+    cold = run(standby=False)
+
+    assert warm["errors"] == 0 and cold["errors"] == 0, (
+        f"failover must absorb the death (warm={warm['errors']}, "
+        f"cold={cold['errors']})"
+    )
+    assert warm["outs"] == cold["outs"], (
+        "greedy outputs must not depend on standby placement"
+    )
+    assert warm["stats"]["standby_adoptions"] == 1, warm["stats"]
+    assert warm["post_hit_rate"] > 0.0, (
+        "warm-standby adoption produced ZERO post-failover prefix hits "
+        "— the adopted families should be hot immediately"
+    )
+    assert warm["post_hit_rate"] > cold["post_hit_rate"], (
+        f"warm adoption ({warm['post_hit_rate']}) must beat cold "
+        f"re-seed ({cold['post_hit_rate']})"
+    )
+    ws = warm["stats"]
+    emit(
+        "transport_standby_warm_hit_rate",
+        round(warm["post_hit_rate"], 4),
+        "fraction",
+        vs_baseline=(
+            warm["post_hit_rate"] / cold["post_hit_rate"]
+            if cold["post_hit_rate"] else None
+        ),
+        kernels=kernels,
+        cold_reseed_hit_rate=round(cold["post_hit_rate"], 4),
+        victim_families=warm["victim_families"],
+        standby_adoptions=ws["standby_adoptions"],
+        warm_tokens_per_sec=round(warm["tps"], 2),
+        cold_tokens_per_sec=round(cold["tps"], 2),
+        wire_bytes_sent=ws["wire_bytes_sent"],
+        wire_bytes_received=ws["wire_bytes_received"],
+        rpc_errors=ws["rpc_errors"],
+        rpc_retries=ws["rpc_retries"],
+        heartbeat_gaps=ws["heartbeat_gaps"],
+        reconnects=ws["reconnects"],
+        replica_down=ws["replica_down"],
+        failovers=ws["failovers"],
+        output_parity=1,
+        errors=0,
+        steady_state_recompiles=0,
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return warm["post_hit_rate"]
+
+
 def serve_fused_bench(on_tpu, kernels):
     """Megakernel decode step (serve/kernels.py fused prologue +
     serve/sampling.py fused epilogue, ``ServingConfig.fused_decode``):
@@ -3177,6 +3401,8 @@ def child_main(phase, platform, kernels):
         serve_cluster_bench(on_tpu, kernels)
     elif phase == "serve_faults":
         serve_faults_bench(on_tpu, kernels)
+    elif phase == "serve_transport":
+        serve_transport_bench(on_tpu, kernels)
     elif phase == "serve_7b":
         serve_7b_bench(on_tpu, kernels)
     else:
@@ -3192,8 +3418,8 @@ def main():
                  "serve_paged", "serve_continuous", "serve_prefix",
                  "serve_paged_q", "serve_kv_hierarchy",
                  "serve_long_context", "serve_cluster",
-                 "serve_faults", "serve_fused", "serve_int8",
-                 "serve_int4", "serve_7b"],
+                 "serve_faults", "serve_transport", "serve_fused",
+                 "serve_int8", "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)

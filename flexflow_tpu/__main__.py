@@ -112,6 +112,11 @@ def cmd_serve(args):
         decode_replicas=args.decode_replicas,
         slo_queue_delay_s=args.slo_queue_delay_s,
         migration_queue_budget=args.migration_queue_budget,
+        replica_transport=args.replica_transport,
+        replica_endpoints=tuple(
+            s for s in (args.replica_endpoints or "").split(",") if s
+        ),
+        standby_replicas=args.standby_replicas,
     )
     ssms = []
     spec = None
@@ -311,11 +316,35 @@ def main(argv=None):
                         "(serve/cluster/faults.py; requires a cluster): "
                         "a JSON list of faults, e.g. "
                         "'[{\"kind\": \"crash\", \"replica\": 1, "
-                        "\"step\": 20}]' — kinds: crash, transient, "
-                        "latency, migration, oom. The same plan replays "
-                        "the same failure scenario bit-for-bit; failed "
-                        "replicas' requests fail over to survivors via "
-                        "recompute re-admission")
+                        "\"step\": 20}]' — replica kinds: crash, "
+                        "transient, latency, migration, oom; transport "
+                        "kinds (remote replicas only — rejected loudly "
+                        "against --replica-transport inproc): drop, "
+                        "delay, disconnect, partition. The same plan "
+                        "replays the same failure scenario bit-for-bit; "
+                        "failed replicas' requests fail over to "
+                        "survivors via recompute re-admission")
+    s.add_argument("--replica-transport", default="inproc",
+                   choices=("inproc", "loopback", "socket"),
+                   help="how the cluster drives its replicas: direct "
+                        "method calls (inproc, default), the binary "
+                        "RPC wire codec in-process (loopback — bitwise "
+                        "the inproc cluster, exercises deadlines/"
+                        "retries/heartbeats for real), or localhost TCP "
+                        "to subprocess replica servers (socket; see "
+                        "python -m flexflow_tpu.serve.cluster.server)")
+    s.add_argument("--replica-endpoints", default=None,
+                   help="comma-separated host:port per remote replica "
+                        "(then per standby) for --replica-transport "
+                        "socket")
+    s.add_argument("--standby-replicas", type=int, default=0,
+                   help="warm standbys: pre-built engines outside "
+                        "routing that ADOPT a circuit-broken replica's "
+                        "position — its prefix radix tree (block keys + "
+                        "page bytes) ships over the transport and "
+                        "re-admits on the standby before it joins "
+                        "routing, instead of survivors re-seeding the "
+                        "families cold")
     # reference -output-file (request_manager.cc:417-440): append each
     # finished request's latency/steps/token-ids
     s.add_argument("--output-file", "-output-file", default=None)

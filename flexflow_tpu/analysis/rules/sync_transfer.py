@@ -96,6 +96,35 @@ HOT_ROOTS = {
     "shard_balance",
     "ring_ragged_paged_attention",
     "ring_ragged_paged_attention_xla",
+    # replica RPC transport (serve/cluster/transport.py + remote.py +
+    # server.py): the RPC send/recv core, heartbeats and the server's
+    # dispatch table all run ON the cluster drive loop — a blocking
+    # device transfer anywhere here would stall every replica's decode
+    # behind one replica's PCIe round-trip. The two reviewed flush
+    # points (the wire migration harvest in _m_migrate_out and the
+    # standby tree-export harvest in export_tree) carry reasoned
+    # suppressions; the server's handlers are reached dynamically
+    # (getattr dispatch), so each one is its own root.
+    "call",
+    "_rpc",
+    "heartbeat",
+    "_heartbeat_remote",
+    "_check_gap",
+    "_observe_failure",
+    "dispatch",
+    "_m_step",
+    "_m_heartbeat",
+    "_m_submit",
+    "_m_migrate_out",
+    "_m_migrate_in",
+    "_m_export_tree",
+    "_m_import_tree",
+    "migrate_out",
+    "migrate_in",
+    "_migrate_remote",
+    "export_tree",
+    "import_tree",
+    "_adopt_standby",
 }
 
 # Calls that force a synchronous transfer / device round-trip.

@@ -299,6 +299,23 @@ class ClusterStats:
     migration_queue_depth: int = 0
     migration_queue_peak: int = 0
     migration_queue_overflows: int = 0
+    # Replica RPC transport (serve/cluster/transport.py + remote.py):
+    # RPCs that exhausted their retries (each one is also a health
+    # observation), retry attempts the deadline/backoff machinery
+    # spent (absorbed losses — no health impact), cluster steps on
+    # which a remote replica had had no successful exchange for
+    # heartbeat_gap_steps (each one a SUSPECT observation), transport
+    # reconnects after a disconnect, standby replicas that adopted a
+    # DOWN replica's routing position (+ prefix families), and raw
+    # frame bytes both ways (requests+responses; migrated page bytes
+    # and shipped radix trees dominate).
+    rpc_errors: int = 0
+    rpc_retries: int = 0
+    heartbeat_gaps: int = 0
+    reconnects: int = 0
+    standby_adoptions: int = 0
+    wire_bytes_sent: int = 0
+    wire_bytes_received: int = 0
 
     def record_placement(self, how: str) -> None:
         self.placements[how] = self.placements.get(how, 0) + 1
@@ -332,11 +349,14 @@ class ClusterStats:
             agg["spec_accept_rate"] = round(
                 agg.get("spec_accepted", 0) / drafted, 4
             ) if drafted else 0.0
+            # remote replicas mirror their stats from heartbeats — a
+            # snapshot taken before the first envelope is empty
             agg["mean_occupancy"] = round(
-                sum(s["mean_occupancy"] for s in per) / len(per), 4
+                sum(s.get("mean_occupancy", 0.0) for s in per) / len(per), 4
             )
             agg["mean_budget_fill"] = round(
-                sum(s["mean_budget_fill"] for s in per) / len(per), 4
+                sum(s.get("mean_budget_fill", 0.0) for s in per) / len(per),
+                4,
             )
         return {
             "submitted": self.submitted,
@@ -358,6 +378,13 @@ class ClusterStats:
             "migration_queue_depth": self.migration_queue_depth,
             "migration_queue_peak": self.migration_queue_peak,
             "migration_queue_overflows": self.migration_queue_overflows,
+            "rpc_errors": self.rpc_errors,
+            "rpc_retries": self.rpc_retries,
+            "heartbeat_gaps": self.heartbeat_gaps,
+            "reconnects": self.reconnects,
+            "standby_adoptions": self.standby_adoptions,
+            "wire_bytes_sent": self.wire_bytes_sent,
+            "wire_bytes_received": self.wire_bytes_received,
             "replicas": agg,
             "per_replica": per,
         }
@@ -375,6 +402,10 @@ class ClusterStats:
             f"migrB={s['migrated_bytes']} "
             f"faults={s['step_faults']} down={s['replica_down']} "
             f"failover={s['failovers']} migq={s['migration_queue_depth']} "
+            f"rpc_err={s['rpc_errors']} rpc_retry={s['rpc_retries']} "
+            f"hb_gaps={s['heartbeat_gaps']} reconn={s['reconnects']} "
+            f"standby={s['standby_adoptions']} "
+            f"wireB={s['wire_bytes_sent']}/{s['wire_bytes_received']} "
             f"pfx_hit_rate={agg.get('prefix_hit_rate', 0.0)} "
             f"adm={agg.get('admitted', 0)} "
             f"preempt={agg.get('preemptions', 0)} "

@@ -164,6 +164,12 @@ class ProfileInfo:
     # failover re-admission (-1 when the request never moved).
     retries: int = 0
     failover_replica_id: int = -1
+    # Replica RPC transport (serve/cluster/remote.py): transport-level
+    # retry attempts spent on RPCs that carried this request's work
+    # (its submit, plus every step/drain retried while it was live on
+    # a remote replica) — the per-request mirror of
+    # ClusterStats.rpc_retries. 0 outside a transported cluster.
+    transport_retries: int = 0
 
     @property
     def latency_s(self) -> float:

@@ -34,32 +34,81 @@ Failure scenarios are scripted deterministically with
 :class:`FaultPlan` / :class:`FaultInjector`
 (``ClusterManager.attach_faults``).
 
+Multi-host transport (:mod:`.transport` + :mod:`.remote` +
+:mod:`.server`, ``ServingConfig.replica_transport``): replicas can run
+behind a length-prefixed binary RPC protocol — in-process loopback
+(every call through the real wire codec; BITWISE the in-process
+cluster) or localhost TCP to subprocess replica servers (``python -m
+flexflow_tpu.serve.cluster.server``). Every RPC gets a deadline with
+bounded retries and exponential backoff; heartbeats carry the
+``SchedulerStats`` the queue-delay estimates read; RPC errors and
+heartbeat gaps (counted in deterministic cluster steps) feed the same
+health machine; ``FaultPlan`` grows transport kinds (drop/delay/
+disconnect/partition) injected at the transport; and warm standbys
+(``ServingConfig.standby_replicas``) adopt a DOWN replica's prefix
+families over the wire before taking its routing position.
+
 Telemetry: :class:`flexflow_tpu.metrics.ClusterStats` (router counters
-+ failover/health/migration-queue counters + per-replica SchedulerStats
-aggregation) via ``ClusterManager.cluster_stats()``, logged at
-``FF_LOG=serve=debug``; per-request ``ProfileInfo.replica_id`` /
-``router_queue_delay_s`` / ``retries`` / ``failover_replica_id``.
++ failover/health/migration-queue counters + rpc/heartbeat/wire-byte/
+standby counters + per-replica SchedulerStats aggregation) via
+``ClusterManager.cluster_stats()``, logged at ``FF_LOG=serve=debug``;
+per-request ``ProfileInfo.replica_id`` / ``router_queue_delay_s`` /
+``retries`` / ``failover_replica_id`` / ``transport_retries``.
 """
-from .faults import Fault, FaultInjector, FaultPlan, InjectedFault
+from .faults import (
+    KINDS,
+    REPLICA_KINDS,
+    TRANSPORT_KINDS,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    InjectedTransportFault,
+)
 from .health import HealthConfig, HealthMonitor, HealthState, ReplicaHealth
 from .manager import ClusterManager, ClusterRequest
 from .migration import migrate_request
+from .remote import HeartbeatGap, RemoteReplica
 from .replica import Replica
 from .router import POLICIES, Router
+from .server import ReplicaServerCore
+from .transport import (
+    ConnectionLost,
+    DeadlineExceeded,
+    FrameError,
+    LoopbackTransport,
+    RemoteError,
+    SocketTransport,
+    TransportError,
+)
 
 __all__ = [
     "ClusterManager",
     "ClusterRequest",
     "Replica",
+    "RemoteReplica",
+    "ReplicaServerCore",
     "Router",
     "POLICIES",
     "migrate_request",
     "HealthConfig",
     "HealthMonitor",
     "HealthState",
+    "HeartbeatGap",
     "ReplicaHealth",
     "Fault",
     "FaultPlan",
     "FaultInjector",
     "InjectedFault",
+    "InjectedTransportFault",
+    "KINDS",
+    "REPLICA_KINDS",
+    "TRANSPORT_KINDS",
+    "TransportError",
+    "FrameError",
+    "ConnectionLost",
+    "DeadlineExceeded",
+    "RemoteError",
+    "LoopbackTransport",
+    "SocketTransport",
 ]

@@ -36,9 +36,41 @@ kind           effect (at replica-local step ``step``, 1-based)
                release_all` before auditing pools.
 =============  ==========================================================
 
+**Transport kinds** (PR 12) are injected one level lower, AT the RPC
+transport (:meth:`FaultInjector.on_rpc`, consulted per RPC *attempt*
+by :class:`~.remote.RemoteReplica`) — they only exist for remote
+replicas (``ServingConfig.replica_transport`` "loopback"/"socket");
+``ClusterManager.attach_faults`` rejects a plan aiming them at
+in-process replicas with a loud error. ``step`` windows count the
+replica's client-side step counter, same as the replica kinds:
+
+=============  ==========================================================
+kind           effect (during steps ``[step, step+count)``)
+=============  ==========================================================
+``drop``       the FIRST attempt of each RPC is lost (raises
+               :class:`InjectedTransportFault`); retries succeed — a
+               lossy link the deadline/retry/backoff machinery must
+               absorb without a health observation (``rpc_retries``
+               counts the cost)
+``delay``      every RPC attempt carries ``seconds`` of reported extra
+               latency (no real sleep); under the deadline it feeds the
+               health monitor's latency-spike detector, at/over the
+               deadline each attempt fails as DeadlineExceeded — a slow
+               link degrades exactly like a stalled replica
+``disconnect`` the first attempt of each RPC fails AND tears the
+               connection down; the retry reconnects (``reconnects``
+               counted) and succeeds
+``partition``  EVERY attempt of every RPC fails — retries exhaust, the
+               manager's health machine sees consecutive failures /
+               heartbeat gaps and circuit-breaks the replica exactly
+               like a crash (failover re-admission, probes after
+               backoff)
+=============  ==========================================================
+
 ``FaultPlan.random(seed, n_replicas)`` draws a reproducible plan for
-chaos tests; ``from_json``/``to_json`` round-trip plans for the CLI's
-``--fault-plan`` flag and for bench scripts.
+chaos tests (replica kinds by default; pass ``kinds=TRANSPORT_KINDS``
+or a mix for wire chaos); ``from_json``/``to_json`` round-trip plans
+for the CLI's ``--fault-plan`` flag and for bench scripts.
 """
 from __future__ import annotations
 
@@ -48,8 +80,13 @@ import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ...logging_utils import get_logger
+from .transport import TransportError
 
-KINDS = ("crash", "transient", "latency", "migration", "oom")
+#: faults injected at the Replica surface (PR 9)
+REPLICA_KINDS = ("crash", "transient", "latency", "migration", "oom")
+#: faults injected at the RPC transport (PR 12, remote replicas only)
+TRANSPORT_KINDS = ("drop", "delay", "disconnect", "partition")
+KINDS = REPLICA_KINDS + TRANSPORT_KINDS
 
 
 class InjectedFault(RuntimeError):
@@ -58,6 +95,17 @@ class InjectedFault(RuntimeError):
 
 class InjectedMigrationFault(InjectedFault):
     """An injected prefill→decode migration failure."""
+
+
+class InjectedTransportFault(InjectedFault, TransportError):
+    """An injected TRANSPORT failure (drop/disconnect/partition) — a
+    :class:`TransportError`, so the RemoteReplica retry loop treats it
+    exactly like a real lost frame. ``kind`` lets the retry loop run
+    the disconnect's reconnect semantics."""
+
+    def __init__(self, message: str, kind: str):
+        super().__init__(message)
+        self.kind = kind
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,10 +169,13 @@ class FaultPlan:
         *,
         horizon: int = 120,
         n_faults: Optional[int] = None,
-        kinds: Sequence[str] = KINDS,
+        kinds: Sequence[str] = REPLICA_KINDS,
     ) -> "FaultPlan":
         """A reproducible random plan: same seed → same plan, always
-        (stdlib ``random.Random`` — no global RNG state touched)."""
+        (stdlib ``random.Random`` — no global RNG state touched).
+        Defaults to the replica kinds — the PR-9 contract; pass
+        ``kinds=TRANSPORT_KINDS`` (or a mix) to script wire chaos
+        against remote replicas."""
         rng = random.Random(seed)
         n = n_faults if n_faults is not None else rng.randint(1, 3)
         faults = []
@@ -204,6 +255,49 @@ class FaultInjector:
                 self._fire(fault, sn, seconds=fault.seconds)
             if fault.kind == "oom" and sn == fault.step:
                 self._grab_pages(replica, fault)
+
+    def on_rpc(self, replica_index: int, step_no: int, method: str,
+               attempt: int) -> float:
+        """Consulted by :meth:`RemoteReplica._rpc` before every RPC
+        *attempt* (``attempt`` 0 = the first try). May raise
+        :class:`InjectedTransportFault`; returns the injected extra
+        seconds of link delay (0.0 when none). ``step_no`` is the
+        replica's CLIENT-side step counter — the same replica-local
+        clock the replica kinds use, so mixed plans script one
+        deterministic timeline."""
+        delay = 0.0
+        for fault in self.plan:
+            if (
+                fault.kind not in TRANSPORT_KINDS
+                or fault.replica != replica_index
+                or not (fault.step <= step_no < fault.step + fault.count)
+            ):
+                continue
+            if fault.kind == "partition":
+                if attempt == 0:
+                    self._fire(fault, step_no, method=method)
+                raise InjectedTransportFault(
+                    f"injected partition (replica {replica_index}, step "
+                    f"{step_no}, rpc {method})", "partition",
+                )
+            if fault.kind == "drop" and attempt == 0:
+                self._fire(fault, step_no, method=method)
+                raise InjectedTransportFault(
+                    f"injected dropped frame (replica {replica_index}, "
+                    f"step {step_no}, rpc {method})", "drop",
+                )
+            if fault.kind == "disconnect" and attempt == 0:
+                self._fire(fault, step_no, method=method)
+                raise InjectedTransportFault(
+                    f"injected disconnect (replica {replica_index}, step "
+                    f"{step_no}, rpc {method})", "disconnect",
+                )
+            if fault.kind == "delay":
+                delay += fault.seconds
+                if attempt == 0:
+                    self._fire(fault, step_no, seconds=fault.seconds,
+                               method=method)
+        return delay
 
     def migration_fault(self, src) -> None:
         """Consulted at the top of ``migrate_request`` (before any

@@ -37,6 +37,17 @@ is counted in cluster steps (not wall time) and spike detection only
 compares latencies the manager reports — which is what lets the
 fault-injection harness (:mod:`.faults`) script exact failure scenarios
 and the chaos tests replay them bit-for-bit.
+
+The machine consumes an OBSERVATION STREAM, not a failure mechanism —
+which is why remote replicas (PR 12, :mod:`.remote`) plug in
+unchanged: a step RPC that exhausted its retries and a heartbeat GAP
+(no successful exchange for ``heartbeat_gap_steps`` cluster steps)
+both arrive as ``record_failure`` observations, deduplicated by the
+manager to at most ONE per replica per cluster step (a replica that is
+simultaneously gapped and RPC-erroring must not burn
+``failure_threshold`` twice as fast), and an injected transport
+``delay`` under the RPC deadline arrives as reported step latency the
+spike detector prices exactly like the in-process "latency" fault.
 """
 from __future__ import annotations
 

@@ -69,10 +69,12 @@ from ..batch_config import (
 )
 from ..engine import ServingConfig
 from ..request_manager import TERMINAL_STATUSES, RequestStatus
-from .health import HealthConfig, HealthMonitor, HealthState
+from .health import HealthConfig, HealthMonitor, HealthState, ReplicaHealth
 from .migration import migrate_request
+from .remote import HeartbeatGap, RemoteReplica
 from .replica import Replica
 from .router import Router
+from .transport import LoopbackTransport, SocketTransport
 
 
 @dataclasses.dataclass
@@ -150,6 +152,7 @@ class ClusterManager:
         tokenizer: Any = None,
         eos_token_id: Optional[int] = None,
         health_config: Optional[HealthConfig] = None,
+        standbys: Sequence[Replica] = (),
     ):
         serving.validate_cluster()
         if len(replicas) != serving.replicas:
@@ -157,15 +160,34 @@ class ClusterManager:
                 f"ServingConfig.replicas={serving.replicas} but "
                 f"{len(replicas)} replicas were built"
             )
+        if len(standbys) != serving.standby_replicas:
+            raise ValueError(
+                f"ServingConfig.standby_replicas="
+                f"{serving.standby_replicas} but {len(standbys)} "
+                "standbys were built"
+            )
         self.serving = serving
         self.replicas = list(replicas)
+        # warm standbys: pre-built engines OUTSIDE routing; on a DOWN
+        # transition one adopts the dead replica's position (+ its
+        # prefix families over the transport) — see _adopt_standby
+        self.standbys = list(standbys)
+        self._retired: List[Replica] = []   # replaced dead replicas
         self.tokenizer = tokenizer
         self.eos_token_id = eos_token_id
         if eos_token_id is None and tokenizer is not None:
             self.eos_token_id = getattr(tokenizer, "eos_token_id", None)
         self.stats = ClusterStats()
+        for rep in list(self.replicas) + self.standbys:
+            if getattr(rep, "is_remote", False):
+                rep.bind_stats(lambda: self.stats)
         self.health = HealthMonitor(len(self.replicas), health_config)
         self.fault_injector = None
+        # replica positions already observed failing THIS cluster step
+        # (the one-SUSPECT-observation-per-step guard: a replica that is
+        # simultaneously in a heartbeat gap and returning RPC errors is
+        # observed once, preserving the PR-9 threshold arithmetic)
+        self._failed_obs: Set[int] = set()
         self.prefill_pool = [r for r in self.replicas if r.role == "prefill"]
         self.decode_pool = [r for r in self.replicas if r.role == "decode"]
         self.disaggregated = bool(self.prefill_pool)
@@ -244,8 +266,21 @@ class ClusterManager:
                 ["prefill"] * serving.prefill_replicas
                 + ["decode"] * serving.decode_replicas
             )
-        replicas = [
-            Replica.build(
+        roles += ["mixed"] * serving.standby_replicas
+
+        def make(i):
+            """One replica (or standby) behind the configured
+            transport. "loopback" wraps the SAME in-process build in a
+            RemoteReplica whose every call round-trips the wire codec
+            against a ReplicaServerCore; "socket" dials a subprocess
+            replica server instead of building anything locally."""
+            if serving.replica_transport == "socket":
+                host, _, port = serving.replica_endpoints[i].rpartition(":")
+                return RemoteReplica(
+                    i, SocketTransport(host or "127.0.0.1", int(port)),
+                    serving, role=roles[i],
+                )
+            local = Replica.build(
                 i, model, cfg, params, serving,
                 role=roles[i],
                 devices=[devs[i % len(devs)]],
@@ -255,26 +290,63 @@ class ClusterManager:
                 ssms=ssms,
                 spec=spec,
             )
-            for i in range(serving.replicas)
+            if serving.replica_transport == "inproc":
+                return local
+            from .server import ReplicaServerCore
+
+            return RemoteReplica(
+                i, LoopbackTransport(ReplicaServerCore(local).dispatch),
+                serving, role=roles[i], local=local,
+            )
+
+        replicas = [make(i) for i in range(serving.replicas)]
+        standbys = [
+            make(serving.replicas + j)
+            for j in range(serving.standby_replicas)
         ]
         return cls(
             replicas, serving, tokenizer=tokenizer,
             eos_token_id=eos_token_id, health_config=health_config,
+            standbys=standbys,
         )
 
     def attach_faults(self, plan):
         """Wire a :class:`~.faults.FaultPlan` (or a prebuilt injector,
-        or its JSON) into every replica and the migration path. Returns
-        the :class:`~.faults.FaultInjector` for ``fired``/``release_all``."""
-        from .faults import FaultInjector, FaultPlan
+        or its JSON) into every replica (standbys included) and the
+        migration path. Transport fault kinds (drop/delay/disconnect/
+        partition) are injected AT the RPC transport, which in-process
+        replicas do not have — aiming them at an ``inproc`` cluster is
+        a loud error, not a silent no-op. Returns the
+        :class:`~.faults.FaultInjector` for ``fired``/``release_all``."""
+        from .faults import TRANSPORT_KINDS, FaultInjector, FaultPlan
 
         if isinstance(plan, str):
             plan = FaultPlan.from_json(plan)
         injector = plan if isinstance(plan, FaultInjector) else (
             FaultInjector(plan)
         )
+        transport_faults = [
+            f.kind for f in injector.plan if f.kind in TRANSPORT_KINDS
+        ]
+        if transport_faults and self.serving.replica_transport == "inproc":
+            raise ValueError(
+                f"fault plan contains transport kinds {transport_faults} "
+                "but this cluster drives IN-PROCESS replicas "
+                "(replica_transport='inproc') — transport faults are "
+                "injected at the RPC layer; run with "
+                "replica_transport='loopback' (or 'socket') to exercise "
+                "them"
+            )
+        if self.serving.replica_transport == "socket" and any(
+            f.kind == "oom" for f in injector.plan
+        ):
+            raise ValueError(
+                "the 'oom' fault kind squeezes the replica's page pool "
+                "in-process, which a socket-backed replica does not "
+                "expose — use loopback replicas for oom scenarios"
+            )
         self.fault_injector = injector
-        for rep in self.replicas:
+        for rep in list(self.replicas) + self.standbys:
             rep.fault_injector = injector
         return injector
 
@@ -515,6 +587,50 @@ class ClusterManager:
                 "excluded from audits until it recovers",
                 rep.index, abandon_exc,
             )
+        if self.standbys:
+            self._adopt_standby(pos)
+
+    def _adopt_standby(self, pos: int) -> None:
+        """A warm standby takes the dead replica's routing position:
+        the dead replica's prefix radix tree — block keys + page bytes,
+        host-spilled pages included — ships over the transport and
+        re-admits on the standby (best-effort: an unreachable process
+        means a COLD join, capacity is still replaced), then the
+        standby enters routing at ``pos``. The dead replica retires
+        permanently (its health record is replaced by the standby's
+        fresh one, so it never probes back) — failover re-admissions
+        and re-pinned sessions land on a warm tree instead of survivors
+        re-seeding the families cold."""
+        dead = self.replicas[pos]
+        standby = self.standbys.pop(0)
+        blocks = 0
+        try:
+            entries = dead.export_prefix_tree()
+            if entries:
+                blocks = standby.import_prefix_tree(entries)
+        except Exception as exc:
+            self._log.warning(
+                "standby adoption: prefix-tree export from dead replica "
+                "%d failed (%s) — standby %d joins COLD",
+                dead.index, exc, standby.index,
+            )
+        self.replicas[pos] = standby
+        try:
+            rpos = self._routing_pos.index(pos)
+        except ValueError:
+            rpos = None
+        if rpos is not None:
+            self.router.replicas[rpos] = standby
+        # a fresh health record: the standby starts HEALTHY and the
+        # retired replica can never probe back into this position
+        self.health.replicas[pos] = ReplicaHealth(pos, self.health.cfg)
+        self._retired.append(dead)
+        self.stats.standby_adoptions += 1
+        self._log.warning(
+            "standby replica %d adopted position %d (%d prefix blocks "
+            "warm; %d standbys remain)",
+            standby.index, pos, blocks, len(self.standbys),
+        )
 
     def _schedule_failover(self, cr: ClusterRequest) -> None:
         """Bounded retries with exponential (cluster-step) backoff; past
@@ -750,15 +866,65 @@ class ClusterManager:
     # ------------------------------------------------------------------
     # the drive loop
 
+    def _observe_failure(self, pos: int, exc: BaseException,
+                         step_no: int) -> None:
+        """ONE health failure observation per replica per cluster step
+        — an RPC-erroring replica that is also inside a heartbeat gap
+        must not burn through ``failure_threshold`` twice as fast as a
+        plain crashing one (the PR-9 arithmetic is the contract)."""
+        if pos in self._failed_obs:
+            return
+        self._failed_obs.add(pos)
+        self._note_transition(
+            pos, self.health[pos].record_failure(exc, step_no), exc
+        )
+
+    def _check_gap(self, pos: int, rep, step_no: int) -> None:
+        """Heartbeat-gap detection, in deterministic CLUSTER steps: no
+        successful exchange for ``heartbeat_gap_steps`` steps is a
+        SUSPECT observation each step until contact resumes (or the
+        breaker trips)."""
+        gap = step_no - rep.last_contact_step
+        if gap >= self.serving.heartbeat_gap_steps:
+            self.stats.heartbeat_gaps += 1
+            self._observe_failure(
+                pos,
+                HeartbeatGap(
+                    f"replica {rep.index}: no successful exchange for "
+                    f"{gap} cluster steps"
+                ),
+                step_no,
+            )
+
+    def _heartbeat_remote(self, pos: int, rep, step_no: int) -> None:
+        """Idle remote replicas stay observable: a heartbeat every
+        ``heartbeat_interval_steps`` refreshes the telemetry mirror
+        (SchedulerStats + the queue-delay inputs the router reads) and
+        stamps contact; a FAILED heartbeat is silent on its own (the
+        loss is retried/absorbed at the transport) — sustained loss
+        surfaces through :meth:`_check_gap`."""
+        due = (
+            step_no - rep.last_contact_step
+            >= self.serving.heartbeat_interval_steps
+        )
+        if due and rep.heartbeat():
+            rep.last_contact_step = step_no
+            return
+        self._check_gap(pos, rep, step_no)
+
     def step(self) -> bool:
         """One cluster step: advance every steppable replica under the
-        health monitor, settle prefill→decode migrations, then run any
-        due failover re-admissions. Returns False when no replica has
-        work left and nothing is pending recovery."""
+        health monitor (remote replicas additionally heartbeat when
+        idle, with gap detection in cluster steps), settle
+        prefill→decode migrations, then run any due failover
+        re-admissions. Returns False when no replica has work left and
+        nothing is pending recovery."""
         self._step_counter += 1
         step_no = self._step_counter
+        self._failed_obs = set()
         progressed = False
-        for pos, rep in enumerate(self.replicas):
+        for pos in range(len(self.replicas)):
+            rep = self.replicas[pos]
             h = self.health[pos]
             if h.state is HealthState.DOWN:
                 if h.maybe_probe(step_no):
@@ -770,18 +936,26 @@ class ClusterManager:
                     progressed = True
                 else:
                     continue
+            remote = getattr(rep, "is_remote", False)
             if not rep.has_work():
+                if remote:
+                    self._heartbeat_remote(pos, rep, step_no)
                 continue
             t0 = time.perf_counter()
             try:
                 stepped = rep.step()
             except Exception as exc:
                 self.stats.step_faults += 1
-                self._note_transition(
-                    pos, h.record_failure(exc, step_no), exc
-                )
+                self._observe_failure(pos, exc, step_no)
+                if (
+                    remote and rep is self.replicas[pos]
+                    and self.health[pos].state is not HealthState.DOWN
+                ):
+                    self._check_gap(pos, rep, step_no)
                 progressed = True
                 continue
+            if remote:
+                rep.last_contact_step = step_no
             latency = (time.perf_counter() - t0) + rep.injected_latency_s
             self._note_transition(
                 pos, h.record_success(latency, step_no, had_work=True)

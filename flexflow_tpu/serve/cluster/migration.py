@@ -78,6 +78,16 @@ def migrate_request(
     """
     if injector is not None:
         injector.migration_fault(src)  # may raise InjectedMigrationFault
+    src_remote = getattr(src, "is_remote", False)
+    dst_remote = getattr(dst, "is_remote", False)
+    if src_remote or dst_remote:
+        if not (src_remote and dst_remote):
+            raise ValueError(
+                "mixed in-process/remote migration — the cluster's "
+                "replica_transport is uniform, so both ends must be "
+                "RemoteReplica"
+            )
+        return _migrate_remote(src, dst, rid, gen, stats=stats)
     req = src.rm.requests[rid]
     assert req.status is RequestStatus.COMPLETED, (
         f"migrating request {rid} in state {req.status}"
@@ -123,5 +133,44 @@ def migrate_request(
         "migrate: request %d replica %d -> %d (%d pages, %d bytes, "
         "prompt %d tokens)",
         rid, src.index, dst.index, n_pages, bytes_moved, prompt_len,
+    )
+    return rid_dst
+
+
+def _migrate_remote(src, dst, rid: int, gen,
+                    *, stats: Optional[ClusterStats] = None
+                    ) -> Optional[int]:
+    """The over-the-wire hand-off: the SOURCE server gathers + harvests
+    the held prefill's pages (``migrate_out`` — codes, quant scale rows
+    and pos lines serialize byte-exact through the frame codec) and the
+    DESTINATION server adopts + uploads them transactionally
+    (``migrate_in`` rolls its adoption back server-side on any upload
+    failure before the error crosses the wire). Same contract as the
+    in-process path: None = no capacity on ``dst`` right now, nothing
+    moved, the source keeps holding; an exception (transport fault
+    mid-hand-off included) leaves the source holding too — the caller
+    retries with backoff or falls back to recompute re-admission."""
+    view = src.rm.requests[rid]
+    out = src.migrate_out(rid)
+    rid_dst = dst.migrate_in(out, gen)
+    if rid_dst is None:
+        return None
+    # the cluster-side profile object follows the request to its new
+    # home (the in-process path shares it by reference; the mirror
+    # binds it so the decode home's counters merge onto it)
+    dst.rm.bind_profile(rid_dst, view.profile)
+    n_pages = len(out["pages"])
+    bytes_moved = sum(
+        arr.nbytes for page in out["pages"] for arr in page.values()
+    )
+    if stats is not None:
+        stats.migrations += 1
+        stats.migrated_pages += n_pages
+        stats.migrated_bytes += bytes_moved
+    _log.debug(
+        "migrate (wire): request %d replica %d -> %d (%d pages, %d "
+        "bytes on the wire, prompt %d tokens)",
+        rid, src.index, dst.index, n_pages, bytes_moved,
+        out["prompt_len"],
     )
     return rid_dst

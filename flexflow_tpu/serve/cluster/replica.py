@@ -269,6 +269,30 @@ class Replica:
         return dropped
 
     # ------------------------------------------------------------------
+    # warm-standby adoption (serve/cluster/manager.py _adopt_standby)
+
+    def export_prefix_tree(self):
+        """Serialize this replica's prefix radix tree — block keys plus
+        page content bytes (``PrefixCache.export_tree``) — for a warm
+        standby to adopt. Empty without prefix caching. Works on a
+        circuit-broken replica: ``abandon`` keeps the tree (its pages
+        hold only flushed completed writes)."""
+        pc = self.rm.prefix_cache
+        if pc is None:
+            return []
+        return pc.export_tree(fetch_page=self.engine.fetch_page)
+
+    def import_prefix_tree(self, entries) -> int:
+        """Adopt an exported tree into this replica's prefix cache
+        (``PrefixCache.import_tree``); returns blocks adopted. 0
+        without prefix caching — the standby still replaces the dead
+        replica's capacity, just cold."""
+        pc = self.rm.prefix_cache
+        if pc is None:
+            return 0
+        return pc.import_tree(entries, upload_page=self.engine.upload_page)
+
+    # ------------------------------------------------------------------
     # audits
 
     def check_no_leaks(self) -> None:

@@ -49,7 +49,17 @@ POLICIES = ("prefix", "round_robin", "least_loaded")
 class Router:
     """Placement over ``replicas`` (Replica-shaped: ``prefix_score`` /
     ``queue_delay_s`` / ``load`` / ``index``). ``stats`` is a
-    ClusterStats or a zero-arg callable returning one."""
+    ClusterStats or a zero-arg callable returning one.
+
+    The entries may be in-process :class:`~.replica.Replica` or
+    :class:`~.remote.RemoteReplica` — for a remote one,
+    ``prefix_score`` is a read-only RPC (an unreachable replica scores
+    0 and the health machinery owns the outage) while
+    ``queue_delay_s``/``load`` read the heartbeat-fed client mirror,
+    so a scoring pass never blocks on a slow link. The list is LIVE:
+    the manager swaps a warm standby into a dead replica's position
+    (``ClusterManager._adopt_standby``), and the router scores
+    whatever currently occupies it."""
 
     def __init__(
         self,

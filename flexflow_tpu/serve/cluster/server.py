@@ -1,0 +1,448 @@
+"""Replica RPC server — the remote end of the Replica surface.
+
+Two layers:
+
+* :class:`ReplicaServerCore` — a transport-agnostic dispatch table over
+  ONE local :class:`~.replica.Replica`. Every RPC the cluster front-end
+  speaks (step/heartbeat/submit/migration/tree adoption/audits) is one
+  method here; both the in-process :class:`~.transport.LoopbackTransport`
+  and the TCP accept loop below dispatch into the same table, so the
+  loopback tier-1 tests exercise EXACTLY the code a subprocess replica
+  runs. Responses are cached by request ``seq`` (bounded LRU): a client
+  retrying a call whose RESPONSE was lost gets the cached answer
+  replayed instead of a re-execution — ``step``/``submit`` stay
+  at-most-once under at-least-once delivery.
+
+* ``python -m flexflow_tpu.serve.cluster.server`` — a subprocess
+  replica: builds a model + engine from a JSON spec (family, config
+  preset + overrides, init seed, ServingConfig), binds a localhost TCP
+  port (``--port 0`` picks one and prints it), and serves frames until
+  a ``shutdown`` RPC or SIGTERM. Each server is its own single-process
+  JAX runtime — which is exactly what sidesteps the CPU backend's
+  missing multiprocess collectives: the cluster is N cooperating
+  single-process engines, not one multi-process mesh. Determinism
+  across processes comes from seeded param init on a pinned-threefry
+  CPU backend (flexflow_tpu/__init__.py), so a subprocess replica's
+  generation is bitwise the in-process build's.
+
+**Envelope**: every state-bearing response carries ``telemetry`` (the
+heartbeat payload — ``SchedulerStats`` snapshot + the queue-delay
+inputs the router reads) and ``updates`` (per-request flushed state:
+status/tokens/error/profile). The client-side mirror in
+:mod:`.remote` is built ONLY from envelopes, so the front-end always
+holds the flushed truth it needs for failover re-admission even after
+the transport to this server dies.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import dataclasses
+import json
+import socket
+import sys
+from typing import Any, Dict
+
+from ...logging_utils import get_logger
+from ..batch_config import GenerationConfig
+from ..request_manager import RequestStatus
+from .replica import Replica
+from .transport import (
+    ConnectionLost,
+    FrameError,
+    TransportError,
+    encode_frame,
+    read_frame_from_socket,
+)
+
+_log = get_logger("serve")
+
+#: responses replayed for duplicate seqs (idempotent client retries)
+_SEQ_CACHE_SIZE = 32
+
+
+def gen_to_wire(gen: GenerationConfig) -> Dict[str, Any]:
+    d = dataclasses.asdict(gen)
+    d["stop_token_ids"] = list(d["stop_token_ids"])
+    return d
+
+
+def gen_from_wire(d: Dict[str, Any]) -> GenerationConfig:
+    d = dict(d)
+    d["stop_token_ids"] = tuple(d.get("stop_token_ids", ()))
+    return GenerationConfig(**d)
+
+
+def profile_to_wire(profile) -> Dict[str, Any]:
+    return dataclasses.asdict(profile)
+
+
+class ReplicaServerCore:
+    """Dispatch table over one local replica (see module docstring)."""
+
+    def __init__(self, replica: Replica):
+        self.replica = replica
+        self._responses: "collections.OrderedDict[int, Dict]" = (
+            collections.OrderedDict()
+        )
+        self.shutdown_requested = False
+
+    # ------------------------------------------------------------------
+    # envelope
+
+    def _telemetry(self) -> Dict[str, Any]:
+        rep = self.replica
+        return {
+            "steps_taken": rep.steps_taken,
+            "has_work": rep.has_work(),
+            "load": rep.load(),
+            "active": rep.active_requests(),
+            "backlog_tokens": rep.backlog_tokens(),
+            "token_rate": rep.token_rate(),
+            "rate_samples": rep._rate_samples,
+            "queue_delay_s": rep.queue_delay_s(),
+            "hold_finished": sorted(rep.rm.hold_finished),
+            "stats": rep.rm.stats.snapshot(),
+        }
+
+    def _request_state(self, req) -> Dict[str, Any]:
+        return {
+            "status": req.status.value,
+            "tokens": [int(t) for t in req.tokens],
+            "prompt_len": int(req.prompt_len),
+            "n_sched": int(req.n_sched),
+            "slot": int(req.slot),
+            "pipeline_refs": int(req.pipeline_refs),
+            "error": req.error,
+            "profile": profile_to_wire(req.profile),
+        }
+
+    def _envelope(self, **extra) -> Dict[str, Any]:
+        out = {
+            "telemetry": self._telemetry(),
+            "updates": {
+                int(rid): self._request_state(req)
+                for rid, req in self.replica.rm.requests.items()
+            },
+        }
+        out.update(extra)
+        return out
+
+    # ------------------------------------------------------------------
+    # methods
+
+    def _m_hello(self, args):
+        rep = self.replica
+        pager = getattr(rep.engine, "pager", None)
+        return self._envelope(
+            index=rep.index,
+            role=rep.role,
+            paged=pager is not None,
+            page_size=pager.page_size if pager is not None else 0,
+        )
+
+    def _m_heartbeat(self, args):
+        return self._envelope()
+
+    def _m_prefix_score(self, args):
+        return {"score": self.replica.prefix_score(args["tokens"])}
+
+    def _m_step(self, args):
+        return self._envelope(progressed=self.replica.step())
+
+    def _m_drain(self, args):
+        self.replica.drain()
+        return self._envelope()
+
+    def _m_abandon(self, args):
+        return self._envelope(dropped=self.replica.abandon())
+
+    def _m_reset_rate(self, args):
+        self.replica.reset_rate()
+        return {}
+
+    def _m_check_no_leaks(self, args):
+        self.replica.check_no_leaks()
+        return {"ok": True}
+
+    def _m_submit(self, args):
+        rid = self.replica.rm.submit(
+            [int(t) for t in args["tokens"]], gen_from_wire(args["gen"])
+        )
+        req = self.replica.rm.requests[rid]
+        return self._envelope(rid=rid, prompt_len=int(req.prompt_len))
+
+    def _m_hold_on_finish(self, args):
+        self.replica.rm.hold_on_finish(int(args["rid"]))
+        return {}
+
+    def _m_release_held(self, args):
+        self.replica.rm.release_held(int(args["rid"]))
+        return self._envelope()
+
+    def _m_migrate_out(self, args):
+        """Gather a held, completed prefill's KV pages for the wire:
+        every page's async device→host gather starts first, then ONE
+        blocking harvest — the prefill→decode hand-off boundary, the
+        same reviewed flush point as the in-process migration (the
+        request completed, so the source pipeline is drained and no
+        decode step waits on this). Codes, quant scale rows and
+        generic-decoder pos lines ride back byte-exact."""
+        import jax
+
+        rep = self.replica
+        rid = int(args["rid"])
+        req = rep.rm.requests[rid]
+        assert req.status is RequestStatus.COMPLETED, (
+            f"migrate_out of request {rid} in state {req.status}"
+        )
+        assert req.pipeline_refs == 0, "migrate_out with dispatches in flight"
+        assert req.slot >= 0, "migrate_out after the slot was released"
+        eng = rep.engine
+        n_pages = eng.pager.pages_for(req.prompt_len)
+        row = eng.pager.table[req.slot]
+        handles = [eng.fetch_page(int(row[j])) for j in range(n_pages)]
+        # ffcheck: disable=FF107 -- transport migration flush point: the prefill→decode hand-off harvests its page gathers in ONE blocking sync before serialization — the request is COMPLETED (source pipeline drained) and the destination has not seen it, so no decode step anywhere waits on this transfer
+        values = jax.device_get(handles)
+        return {
+            "tokens": [int(t) for t in req.tokens],
+            "prompt_len": int(req.prompt_len),
+            "prompt": req.prompt,
+            "page_size": eng.pager.page_size,
+            "pages": [dict(v) for v in values],
+        }
+
+    def _m_migrate_in(self, args):
+        """Adopt an externally prefilled request + upload its migrated
+        pages — transactional: any upload failure rolls the adoption
+        back (``RequestManager.rollback_adopt``) before the error goes
+        back over the wire, so nothing leaks on this side and the
+        source keeps holding."""
+        rep = self.replica
+        eng = rep.engine
+        if int(args["page_size"]) != eng.pager.page_size:
+            raise ValueError(
+                "prefill and decode pools disagree on page_size "
+                f"({args['page_size']} vs {eng.pager.page_size})"
+            )
+        rid = rep.rm.adopt_prefilled(
+            [int(t) for t in args["tokens"]],
+            int(args["prompt_len"]),
+            gen_from_wire(args["gen"]),
+            prompt_text=args.get("prompt", ""),
+        )
+        if rid is None:
+            return self._envelope(rid=None)
+        try:
+            row = eng.pager.table[rep.rm.requests[rid].slot]
+            for j, payload in enumerate(args["pages"]):
+                eng.upload_page(int(row[j]), payload)
+        except Exception:
+            rep.rm.rollback_adopt(rid)
+            raise
+        return self._envelope(rid=rid)
+
+    def _m_export_tree(self, args):
+        return {"entries": self.replica.export_prefix_tree()}
+
+    def _m_import_tree(self, args):
+        return self._envelope(
+            adopted=self.replica.import_prefix_tree(args["entries"])
+        )
+
+    def _m_shutdown(self, args):
+        self.shutdown_requested = True
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # dispatch
+
+    def dispatch(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """One decoded request frame → one response dict. Never raises:
+        application exceptions become ``ok=False`` error responses
+        (and are cached like successes — a retried failing call must
+        not re-execute either)."""
+        if not isinstance(request, dict) or "method" not in request:
+            return {
+                "seq": None, "ok": False,
+                "error": {"type": "FrameError",
+                          "msg": f"malformed rpc request: {request!r}"},
+            }
+        seq = request.get("seq")
+        if seq is not None and seq in self._responses:
+            self._responses.move_to_end(seq)
+            return self._responses[seq]
+        method = str(request["method"])
+        handler = getattr(self, f"_m_{method}", None)
+        if handler is None:
+            response: Dict[str, Any] = {
+                "seq": seq, "ok": False,
+                "error": {"type": "FrameError",
+                          "msg": f"unknown rpc method {method!r}"},
+            }
+        else:
+            try:
+                response = {
+                    "seq": seq, "ok": True,
+                    "result": handler(request.get("args") or {}),
+                }
+            except Exception as exc:
+                response = {
+                    "seq": seq, "ok": False,
+                    "error": {"type": type(exc).__name__, "msg": str(exc)},
+                }
+        if seq is not None:
+            self._responses[seq] = response
+            while len(self._responses) > _SEQ_CACHE_SIZE:
+                self._responses.popitem(last=False)
+        return response
+
+
+# ---------------------------------------------------------------------------
+# subprocess entry point
+
+_DTYPES = {"float32": "float32", "bfloat16": "bfloat16", "float16": "float16"}
+
+
+def serving_config_from_dict(spec: Dict[str, Any]):
+    """ServingConfig from a JSON-able dict (``cache_dtype`` by name,
+    tuple fields from lists)."""
+    import jax.numpy as jnp
+
+    from ..engine import ServingConfig
+
+    kw = dict(spec)
+    if "cache_dtype" in kw:
+        name = str(kw["cache_dtype"])
+        if name not in _DTYPES:
+            raise ValueError(
+                f"unknown cache_dtype {name!r} (expected one of "
+                f"{sorted(_DTYPES)})"
+            )
+        kw["cache_dtype"] = jnp.dtype(_DTYPES[name])
+    for field in ("fused_decode", "sanitizers", "replica_endpoints"):
+        if field in kw:
+            kw[field] = tuple(kw[field])
+    return ServingConfig(**kw)
+
+
+def build_replica_from_spec(spec: Dict[str, Any]) -> Replica:
+    """Build the served replica from a JSON spec::
+
+        {"family": "llama",
+         "config": {"preset": "tiny", "dtype": "float32", ...overrides},
+         "seed": 0, "gen_seed": 0, "index": 0, "role": "mixed",
+         "serving": {...ServingConfig kwargs...}}
+
+    Param init is seeded (``jax.random.PRNGKey(seed)``), so every
+    process that builds the same spec holds byte-identical weights —
+    the cross-process analog of PR-8's params-shared-by-reference."""
+    import jax
+    import jax.numpy as jnp
+
+    family = spec.get("family", "llama")
+    if family != "llama":
+        raise ValueError(
+            f"replica server spec supports family='llama' for now "
+            f"(got {family!r}) — other families ride once checkpoint "
+            "loading lands in the spec"
+        )
+    from ...models import llama
+
+    conf = dict(spec.get("config") or {})
+    preset = conf.pop("preset", "tiny")
+    dtype = jnp.dtype(_DTYPES.get(str(conf.pop("dtype", "float32")),
+                                  "float32"))
+    maker = getattr(llama.LLaMAConfig, preset, None)
+    if maker is None:
+        raise ValueError(f"unknown llama config preset {preset!r}")
+    cfg = maker(dtype=dtype)
+    if conf:
+        cfg = dataclasses.replace(cfg, **conf)
+    params = llama.init_params(jax.random.PRNGKey(int(spec.get("seed", 0))),
+                               cfg)
+    serving = serving_config_from_dict(dict(spec.get("serving") or {}))
+    return Replica.build(
+        int(spec.get("index", 0)), llama, cfg, params, serving,
+        role=str(spec.get("role", "mixed")),
+        eos_token_id=spec.get("eos_token_id"),
+        seed=int(spec.get("gen_seed", 0)),
+    )
+
+
+def serve_forever(core: ReplicaServerCore, port: int = 0,
+                  host: str = "127.0.0.1",
+                  announce=None) -> None:
+    """Accept loop: one client at a time (the cluster front-end is the
+    only caller and drives RPCs serially), frames in / frames out. A
+    malformed frame closes that CONNECTION with a logged warning and
+    the server keeps accepting — a corrupt or hostile client cannot
+    take the replica down. Returns after a ``shutdown`` RPC."""
+    listener = socket.create_server((host, port))
+    actual_port = listener.getsockname()[1]
+    if announce is not None:
+        announce(actual_port)
+    _log.warning("replica server %d listening on %s:%d",
+                 core.replica.index, host, actual_port)
+    try:
+        while not core.shutdown_requested:
+            conn, addr = listener.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                while not core.shutdown_requested:
+                    try:
+                        request = read_frame_from_socket(conn)
+                    except ConnectionLost:
+                        break  # client went away — accept the next one
+                    except (FrameError, TransportError) as exc:
+                        _log.warning(
+                            "replica server %d: dropping connection on "
+                            "malformed frame (%s)",
+                            core.replica.index, exc,
+                        )
+                        break
+                    conn.sendall(encode_frame(core.dispatch(request)))
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+    finally:
+        listener.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m flexflow_tpu.serve.cluster.server",
+        description="Serve one cluster replica over localhost TCP "
+                    "(the multi-host end of ServingConfig."
+                    "replica_transport='socket').",
+    )
+    parser.add_argument("--port", type=int, default=0,
+                        help="TCP port to bind (0 = pick one and print it)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--spec", default=None,
+                        help="replica spec JSON (see "
+                             "build_replica_from_spec)")
+    parser.add_argument("--spec-file", default=None,
+                        help="path to a replica spec JSON file")
+    args = parser.parse_args(argv)
+    if bool(args.spec) == bool(args.spec_file):
+        parser.error("exactly one of --spec / --spec-file is required")
+    if args.spec_file:
+        with open(args.spec_file) as f:
+            spec = json.load(f)
+    else:
+        spec = json.loads(args.spec)
+    core = ReplicaServerCore(build_replica_from_spec(spec))
+
+    def announce(port):
+        # the line the spawning test/driver parses to find the port
+        print(f"FLEXFLOW_REPLICA_SERVER PORT={port}", flush=True)
+
+    serve_forever(core, port=args.port, host=args.host, announce=announce)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,6 +220,62 @@ class ServingConfig:
     # decode pool's own pending queue instead. None (default) = no
     # bound — the PR-8 behavior.
     migration_queue_budget: Optional[int] = None
+    # Replica RPC transport (serve/cluster/transport.py + remote.py).
+    # "inproc" (default): replicas are driven by direct method calls —
+    # the PR-8/9 in-process cluster, byte-for-byte unchanged.
+    # "loopback": every Replica call round-trips the length-prefixed
+    # binary wire codec in-process (encode → frame → decode → dispatch
+    # → encode → decode) — the transported cluster is BITWISE the
+    # in-process one (tests/test_transport.py), and all transport
+    # machinery (deadlines, retries, heartbeats, gap detection,
+    # transport fault kinds) runs for real. "socket": localhost TCP to
+    # subprocess replica servers (python -m
+    # flexflow_tpu.serve.cluster.server), one single-process JAX
+    # runtime per replica — true multi-process serving that sidesteps
+    # the CPU backend's missing multiprocess collectives; requires
+    # replica_endpoints.
+    replica_transport: str = "inproc"
+    # "host:port" per remote replica (socket transport only): one entry
+    # per replica, then one per warm standby, in position order.
+    replica_endpoints: Tuple[str, ...] = ()
+    # Warm-standby replicas (serve/cluster/manager.py): this many extra
+    # pre-built engines sit OUTSIDE the routing set; when a routed
+    # replica is circuit-broken (DOWN), a standby ADOPTS its position —
+    # the dead replica's prefix-cache radix tree (block keys + page
+    # bytes, host-spilled pages included) ships over the transport and
+    # re-admits on the standby, which then joins routing in the dead
+    # replica's place. Failover re-admissions land on a WARM tree
+    # instead of survivors re-seeding the families cold. Export is
+    # best-effort: a truly dead process (unreachable transport) makes
+    # the standby join cold — capacity is still replaced. 0 = none
+    # (the PR-9 behavior: survivors absorb the load).
+    standby_replicas: int = 0
+    # Every replica RPC's deadline in seconds (the socket timeout on
+    # send + response read; injected "delay" faults at/over it fail the
+    # attempt). A deadline expiry is retried like any transport error.
+    rpc_deadline_s: float = 5.0
+    # Bounded retries per RPC past the first attempt; retries reuse the
+    # request's seq id and the server replays cached responses, so a
+    # retried step/submit is at-most-once even when only the response
+    # was lost. Exhausted retries surface the TransportError to the
+    # drive loop — the same health observation path as a local step
+    # exception.
+    rpc_retries: int = 2
+    # Wall-clock base of the exponential retry backoff (socket
+    # transport only — the loopback fails or succeeds instantly, and
+    # all HEALTH accounting stays in deterministic cluster steps).
+    rpc_backoff_s: float = 0.02
+    # Idle remote replicas are heartbeated every this many cluster
+    # steps (a step RPC counts as contact, so busy replicas never pay
+    # a separate heartbeat); the response carries the SchedulerStats
+    # snapshot + queue-delay inputs the router reads.
+    heartbeat_interval_steps: int = 1
+    # No successful exchange for this many CLUSTER steps = a heartbeat
+    # gap: ONE health observation per gapped step (deduplicated against
+    # same-step RPC-error observations — a replica that is both gapped
+    # and erroring is observed once, preserving the PR-9 threshold
+    # arithmetic). Counted in cluster steps, never wall clock.
+    heartbeat_gap_steps: int = 4
     # Runtime hazard sanitizers (flexflow_tpu/analysis/): "retrace" — a
     # strict RetraceGuard on the engine's jit chokepoint that raises on
     # any step recompile after its first compile (the shape/dtype-drift
@@ -307,6 +363,53 @@ class ServingConfig:
             raise ValueError(
                 f"migration_queue_budget must be >= 0 or None (got "
                 f"{self.migration_queue_budget})"
+            )
+        if self.replica_transport not in ("inproc", "loopback", "socket"):
+            raise ValueError(
+                f"unknown replica_transport {self.replica_transport!r} "
+                "(expected 'inproc', 'loopback' or 'socket')"
+            )
+        if self.standby_replicas < 0:
+            raise ValueError(
+                f"standby_replicas must be >= 0 (got "
+                f"{self.standby_replicas})"
+            )
+        if self.standby_replicas and self.prefill_replicas:
+            raise ValueError(
+                "warm standbys are not composed with disaggregated "
+                "prefill/decode pools yet — a standby adopts ONE routing "
+                "position, which is ambiguous across split pools; use "
+                "standby_replicas with mixed replicas"
+            )
+        if self.replica_transport == "socket":
+            want = self.replicas + self.standby_replicas
+            if len(self.replica_endpoints) != want:
+                raise ValueError(
+                    "replica_transport='socket' needs one "
+                    "replica_endpoints entry per replica + standby "
+                    f"(want {want}, got {len(self.replica_endpoints)})"
+                )
+        if self.rpc_deadline_s <= 0:
+            raise ValueError(
+                f"rpc_deadline_s must be > 0 (got {self.rpc_deadline_s})"
+            )
+        if self.rpc_retries < 0:
+            raise ValueError(
+                f"rpc_retries must be >= 0 (got {self.rpc_retries})"
+            )
+        if self.rpc_backoff_s < 0:
+            raise ValueError(
+                f"rpc_backoff_s must be >= 0 (got {self.rpc_backoff_s})"
+            )
+        if self.heartbeat_interval_steps < 1:
+            raise ValueError(
+                f"heartbeat_interval_steps must be >= 1 (got "
+                f"{self.heartbeat_interval_steps})"
+            )
+        if self.heartbeat_gap_steps < 1:
+            raise ValueError(
+                f"heartbeat_gap_steps must be >= 1 (got "
+                f"{self.heartbeat_gap_steps})"
             )
 
     def resolved_context_shards(self, mesh_seq_degree: int = 1) -> int:

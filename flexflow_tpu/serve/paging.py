@@ -264,6 +264,17 @@ class PageAllocator:
             return None
         return free.pop()
 
+    def claim_free_page(self, shard: int = 0) -> Optional[int]:
+        """:meth:`take_free_page` + the caller's own single reference
+        (refcount 1) in one step — the prefix cache's page-adoption
+        idiom (host-tier re-admits and standby tree imports take a
+        page the TREE owns, never a slot). None when nothing can be
+        freed."""
+        page = self.take_free_page(shard)
+        if page is not None:
+            self.refcount[page] = 1
+        return page
+
     # ------------------------------------------------------------------
 
     def ensure(self, slot: int, num_lines: int) -> bool:

@@ -307,12 +307,11 @@ class PrefixCache:
         re-admitted prefix is bitwise the warm path's. False when no
         page could be freed even by further spilling (the match
         truncates there)."""
-        fresh = self.pager.take_free_page(
+        fresh = self.pager.claim_free_page(
             self.pager.shard_of_logical(logical)
         )
         if fresh is None:
             return False
-        self.pager.refcount[fresh] = 1  # the tree's reference
         self.upload_page(fresh, node.host)
         if node in self._pending_spills:
             self._pending_spills.remove(node)
@@ -609,6 +608,120 @@ class PrefixCache:
                 break
             freed += 1
         return freed
+
+    # ------------------------------------------------------------------
+    # tree export/import (cluster warm-standby adoption, serve/cluster/)
+
+    def export_tree(self, fetch_page=None) -> List[dict]:
+        """Serialize the whole tree for warm-standby adoption: preorder
+        entries ``{"parent": <entry index, -1 = root>, "tokens": [...],
+        "payload": {buffer: ndarray}}`` — the radix block keys plus
+        every page's CONTENT bytes (codes + quant scale rows +
+        generic-decoder pos lines), host-spilled nodes included (their
+        bytes ship straight from the PR-7 host tier). Device-resident
+        pages start their async gathers first and ONE blocking harvest
+        converts them — this runs on the failover/adoption path, off
+        every decode loop, the same reviewed flush-point pattern as the
+        migration harvest. ``fetch_page`` defaults to the spill tier's
+        mover (engines pass theirs explicitly when the tier is off)."""
+        import jax
+        import numpy as np
+
+        fetch = fetch_page or self.fetch_page
+        entries: List[dict] = []
+        pending: List[Tuple[int, object]] = []  # (entry idx, device slices)
+        stack = [
+            (child, -1)
+            for child in itertools.chain(
+                reversed(list(self._root.partials.values())),
+                reversed(list(self._root.children.values())),
+            )
+        ]
+        while stack:
+            node, parent_pos = stack.pop()
+            pos = len(entries)
+            entry = {
+                "parent": parent_pos,
+                "tokens": [int(t) for t in node.tokens],
+                "payload": None,
+            }
+            if node.host is not None:
+                entry["payload"] = {
+                    k: np.asarray(v) for k, v in node.host.items()
+                }
+            elif fetch is not None:
+                pending.append((pos, fetch(node.page)))
+            entries.append(entry)
+            for child in itertools.chain(
+                reversed(list(node.partials.values())),
+                reversed(list(node.children.values())),
+            ):
+                stack.append((child, pos))
+        if pending:
+            # ffcheck: disable=FF107 -- standby-adoption flush point: the dead replica's tree ships AFTER its circuit opened (failover path, outside every decode loop); the async per-page gathers above are harvested in this ONE blocking sync before serialization
+            values = jax.device_get([h for _, h in pending])
+            for (pos, _), val in zip(pending, values):
+                entries[pos]["payload"] = dict(val)
+        self._log.debug(
+            "prefix export: %d blocks (%d shipped from the host tier)",
+            len(entries), len(entries) - len(pending),
+        )
+        return entries
+
+    def import_tree(self, entries: Sequence[dict],
+                    upload_page=None) -> int:
+        """Adopt an exported tree: for each entry (parents first) take
+        a page the tree owns (:meth:`PageAllocator.claim_free_page` —
+        reclaim may evict/spill this cache's own cold blocks to make
+        room), upload the shipped content and link the node under its
+        parent. Blocks already present are kept (the standby's copy
+        wins — it may be mid-splice); a block that cannot get a page is
+        skipped WITH its subtree (children without K/V behind them
+        would serve garbage), so adoption under pool pressure is
+        partial, never corrupt. Returns the number of blocks adopted."""
+        up = upload_page or self.upload_page
+        if up is None:
+            raise ValueError(
+                "import_tree needs an upload_page mover (engine."
+                "upload_page) — adopted blocks carry page CONTENT"
+            )
+        nodes_by_pos: Dict[int, Tuple[_Node, int]] = {
+            -1: (self._root, 0)
+        }
+        tick = next(self._tick)
+        adopted = 0
+        for i, entry in enumerate(entries):
+            parent_entry = nodes_by_pos.get(int(entry["parent"]))
+            if parent_entry is None:
+                continue  # parent was skipped — skip the subtree
+            parent, depth = parent_entry
+            blk = tuple(int(t) for t in entry["tokens"])
+            if not blk or entry["payload"] is None:
+                continue
+            full = len(blk) == self.page_size
+            bucket = parent.children if full else parent.partials
+            existing = bucket.get(blk)
+            if existing is not None:
+                existing.last_used = tick
+                nodes_by_pos[i] = (existing, depth + 1)
+                continue
+            page = self.pager.claim_free_page(
+                self.pager.shard_of_logical(depth)
+            )
+            if page is None:
+                continue  # pool full — partial adoption, subtree skipped
+            up(page, entry["payload"])
+            node = _Node(blk, page, _chain(parent.key, blk), parent=parent)
+            node.last_used = tick
+            bucket[blk] = node
+            nodes_by_pos[i] = (node, depth + 1)
+            adopted += 1
+            if self.stats is not None:
+                self.stats.prefix_inserts += 1
+        self._log.debug(
+            "prefix import: adopted %d/%d blocks", adopted, len(entries),
+        )
+        return adopted
 
     def clear(self) -> int:
         """Drop every cached page (tree refs released; pages with no
