@@ -2803,7 +2803,22 @@ def serve_faults_bench(on_tpu, kernels):
     # the reference run's count lands mid-flight deterministically
     crash_step = max(5, steps_in_run // 3)
     plan = FaultPlan([Fault("crash", replica=1, step=crash_step)])
-    faulted = run(make_cm(), arrival_s, plan=plan)
+    # Observability (flexflow_tpu/obs): the faulted arm additionally
+    # records the cluster timeline + arms the flight recorder, and the
+    # phase emits the stitched Chrome-trace artifact — the serve-phase
+    # timeline ROADMAP item 5c's trace-driven soak consumes. Tracing
+    # rides only this arm (host-side dict appends; the asserted
+    # contracts are bitwise/zero-hang, not the tps ratio).
+    from flexflow_tpu.obs import (
+        FlightRecorder,
+        attach_observability,
+        write_chrome_trace,
+    )
+
+    faulted_cm = make_cm()
+    recorder = FlightRecorder(capacity=256)
+    obs_buf = attach_observability(faulted_cm, recorder=recorder)
+    faulted = run(faulted_cm, arrival_s, plan=plan)
 
     assert base["errors"] == 0 and faulted["errors"] == 0, (
         "failover must absorb a single replica death without a single "
@@ -2817,6 +2832,32 @@ def serve_faults_bench(on_tpu, kernels):
     fs = faulted["stats"]
     assert fs["replica_down"] >= 1 and fs["failovers"] >= 1, (
         f"the fault did not fire as scripted: {fs}"
+    )
+
+    # timeline artifact: one stitched Chrome/Perfetto trace of the
+    # faulted run (replica lanes + router lane; failover/health events
+    # included) + the crashed replica's flight-recorder post-mortem
+    trace_path = os.path.join(
+        os.environ.get("BENCH_TRACE_DIR", "."),
+        "BENCH_trace_serve_faults.json",
+    )
+    doc = write_chrome_trace(trace_path, obs_buf)
+    down_dumps = recorder.dumps_for("replica1")
+    assert down_dumps, (
+        "the crashed replica tripped DOWN but the flight recorder "
+        "captured no post-mortem dump"
+    )
+    lanes = sorted({e.get("lane", "") for e in obs_buf.events})
+    emit(
+        "faults_serve_trace_events",
+        len(doc["traceEvents"]),
+        "events",
+        kernels=kernels,
+        path=trace_path,
+        lanes=lanes,
+        flight_recorder_dumps=len(recorder.dumps),
+        down_dump_final_event=down_dumps[0]["events"][-1]["name"],
+        platform=_platform(),
     )
 
     # goodput dip: worst post-fault bucket over the pre-fault median

@@ -150,6 +150,17 @@ def cmd_serve(args):
                 "surface"
             )
         llm.rm.attach_faults(FaultPlan.from_json(args.fault_plan))
+    obs_buf = None
+    recorder = None
+    if args.trace_out or args.metrics_out or args.flight_recorder:
+        # Observability (flexflow_tpu/obs): tracing + flight recorder
+        # attach to whichever manager compile built (bare scheduler or
+        # cluster); exports are written after the run below.
+        from .obs import FlightRecorder, attach_observability
+
+        if args.flight_recorder:
+            recorder = FlightRecorder(out_dir=args.flight_recorder)
+        obs_buf = attach_observability(llm.rm, recorder=recorder)
     prompts = args.prompt or [[3, 17, 91, 42, 7]]
     gen = GenerationConfig(num_beams=args.num_beams)
     outs = llm.generate(
@@ -157,6 +168,29 @@ def cmd_serve(args):
         gen=gen if args.num_beams > 1 else None,
         max_new_tokens=args.max_new_tokens,
     )
+    if obs_buf is not None:
+        from .obs import write_chrome_trace, write_prometheus
+        from .serve.cluster import ClusterManager
+
+        if args.trace_out:
+            doc = write_chrome_trace(args.trace_out, obs_buf)
+            print(f"trace: {len(doc['traceEvents'])} events -> "
+                  f"{args.trace_out} (load in ui.perfetto.dev)")
+        if args.metrics_out:
+            if isinstance(llm.rm, ClusterManager):
+                sched = {str(r.index): r.rm.stats for r in llm.rm.replicas}
+                cluster = llm.rm.stats
+            else:
+                sched = {"0": llm.rm.stats}
+                cluster = None
+            write_prometheus(
+                args.metrics_out, scheduler=sched, cluster=cluster,
+                profiles=[o.profile for o in outs],
+            )
+            print(f"metrics: prometheus snapshot -> {args.metrics_out}")
+        if recorder is not None and recorder.paths:
+            print(f"flight recorder: {len(recorder.paths)} dump(s) -> "
+                  f"{args.flight_recorder}")
     for o in outs:
         p = o.profile
         print(o.output_text or o.output_tokens)
@@ -348,6 +382,19 @@ def main(argv=None):
     # reference -output-file (request_manager.cc:417-440): append each
     # finished request's latency/steps/token-ids
     s.add_argument("--output-file", "-output-file", default=None)
+    s.add_argument("--trace-out", default=None,
+                   help="write a Chrome/Perfetto trace_event JSON of the "
+                        "run (one lane per replica; load in "
+                        "ui.perfetto.dev)")
+    s.add_argument("--metrics-out", default=None,
+                   help="write a Prometheus text-format metrics snapshot "
+                        "(SchedulerStats/ClusterStats/ProfileInfo, "
+                        "drift-guarded)")
+    s.add_argument("--flight-recorder", default=None, metavar="DIR",
+                   help="arm the failure flight recorder: bounded "
+                        "per-replica event rings dumping redacted JSON "
+                        "post-mortems into DIR on DOWN trips, failover "
+                        "errors and terminal request errors")
     _degree_args(s)
     s.set_defaults(fn=cmd_serve)
 

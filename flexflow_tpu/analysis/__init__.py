@@ -63,6 +63,13 @@ FF107     sync-transfer         ``jax.device_get``/blocking
                                 every decode step — hierarchical-KV spill
                                 traffic must stay async (copy_to_host_async
                                 + harvest at the flush sync point).
+FF108     tracer-sync           A device sync (``.item()``/``.tolist()``/
+                                ``np.asarray``/``jax.device_get``…) inside a
+                                tracer ``event()``/``span()`` ARGUMENT on the
+                                serving hot path: telemetry reading an
+                                un-flushed array stalls the very pipeline it
+                                measures — the observability layer must
+                                record host state (or defer to a flush).
 ========  ====================  ==============================================
 
 Suppressions: ``# ffcheck: disable=FF101 -- reason`` on (or alone

@@ -13,6 +13,7 @@ from . import (
     static_hashability,
     sync_transfer,
     tracer_control_flow,
+    tracer_sync,
     unordered_iteration,
     weak_dtype,
 )
@@ -25,6 +26,7 @@ _RULE_MODULES = (
     missing_donation,
     static_hashability,
     sync_transfer,
+    tracer_sync,
 )
 
 ALL_RULES = tuple(m.RULE for m in _RULE_MODULES)

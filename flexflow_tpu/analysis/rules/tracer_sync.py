@@ -1,0 +1,124 @@
+"""FF108 tracer-sync: observability calls that force a device sync on
+the serving hot path.
+
+The tracing layer (flexflow_tpu/obs) is only free because every event
+records HOST-side primitives the scheduler already holds. The failure
+mode this rule guards against is an attribute like
+``tracer.event("decode", logit=float(logits[0]))`` or
+``tr.event("step", tok=toks.item())`` inside a span-annotated hot
+loop: the innocent-looking telemetry argument is a host read of an
+un-flushed device array — it stalls the dispatch-ahead pipeline on a
+PCIe round-trip per step, silently reintroducing exactly the syncs
+PR 6 removed (and that FF107 polices for non-tracer code).
+
+Mechanically this is the :mod:`.sync_transfer` machinery re-aimed:
+the same HOT_ROOTS reachability walk over serve/ files, but scoped to
+the ARGUMENT subtrees of tracer emission calls (``*.event(...)`` /
+``*.span(...)`` on a ``tracer``/``tr`` receiver) — and therefore
+strict about a wider set of concretizers (``.item()``, ``.tolist()``,
+``np.asarray``/``np.array``, the ``jax.*`` transfer calls): inside a
+trace-event argument there is never a legitimate reason to touch
+device memory. Telemetry must be computed from host state, or deferred
+to a flush point.
+
+Suppress with ``# ffcheck: disable=FF108 -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..lint import FileContext, Finding, FuncDef, Rule
+from .sync_transfer import RULE as _SYNC_TRANSFER
+
+#: emission methods of obs.tracer.Tracer
+TRACER_METHODS = {"event", "span"}
+#: receiver names the serve stack binds tracers to (``self.tracer``,
+#: a local ``tr = self.tracer``, or a ``tracer=`` parameter)
+TRACER_NAMES = {"tr", "tracer"}
+
+#: dotted calls that force a transfer / concretization of a device
+#: array when evaluated inside an event's argument list
+SYNC_PATHS = {
+    "jax.device_get",
+    "jax.device_put",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.copy",
+}
+#: zero-arg methods that force a device->host read on an array receiver
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _is_tracer_call(node: ast.Call) -> bool:
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr not in TRACER_METHODS:
+        return False
+    recv = func.value
+    if isinstance(recv, ast.Name):
+        return recv.id in TRACER_NAMES
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in TRACER_NAMES
+    return False
+
+
+class TracerSyncRule(Rule):
+    code = "FF108"
+    slug = "tracer-sync"
+    doc = (
+        "device sync (.item()/.tolist()/np.asarray/jax.device_get/...) "
+        "inside a tracer event/span argument on the serving hot path — "
+        "telemetry must read host state, never un-flushed arrays"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not ("/serve/" in path or path.startswith("serve/")
+                or "/obs/" in path or path.startswith("obs/")):
+            return
+        reachable = _SYNC_TRANSFER._reachable(ctx)
+        seen: Set[int] = set()
+        for fn in reachable:
+            for stmt in fn.body if isinstance(fn, FuncDef) else []:
+                for node in ast.walk(stmt):
+                    if (
+                        not isinstance(node, ast.Call)
+                        or id(node) in seen
+                        or not _is_tracer_call(node)
+                    ):
+                        continue
+                    seen.add(id(node))
+                    yield from self._check_args(ctx, node)
+
+    def _check_args(self, ctx: FileContext,
+                    call: ast.Call) -> Iterator[Finding]:
+        subtrees = list(call.args) + [kw.value for kw in call.keywords]
+        for arg in subtrees:
+            for node in ast.walk(arg):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.resolve(node.func)
+                if resolved in SYNC_PATHS:
+                    yield self.finding(
+                        ctx, node,
+                        f"{resolved} inside a tracer "
+                        f"{call.func.attr}() argument forces a device "
+                        "sync on the hot path — record host state, or "
+                        "defer the read to a flush point",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SYNC_METHODS
+                    and not node.args
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        f".{node.func.attr}() inside a tracer "
+                        f"{call.func.attr}() argument is a blocking "
+                        "device->host read — the telemetry stalls the "
+                        "dispatch pipeline it is measuring",
+                    )
+
+
+RULE = TracerSyncRule()

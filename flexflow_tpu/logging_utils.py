@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Dict
+import warnings
+from typing import Dict, Set
 
 _CONFIGURED = False
 _LEVELS = {
@@ -25,6 +26,26 @@ _LEVELS = {
     "warning": logging.WARNING,
     "error": logging.ERROR,
 }
+#: bad FF_LOG level tokens already warned about — a typo'd level (e.g.
+#: ``FF_LOG=serve=trace``) falls back to INFO, but SILENTLY doing so
+#: hides exactly the debug output the user was trying to turn on, so
+#: the first resolution of each bad token warns loudly (once: every
+#: get_logger call re-parses the spec).
+_WARNED_LEVELS: Set[str] = set()
+
+
+def _resolve_level(token: str) -> int:
+    lvl = token.strip().lower()
+    if lvl in _LEVELS:
+        return _LEVELS[lvl]
+    if lvl not in _WARNED_LEVELS:
+        _WARNED_LEVELS.add(lvl)
+        warnings.warn(
+            f"FF_LOG: unknown level {token.strip()!r} — falling back to "
+            f"INFO (accepted levels: {', '.join(sorted(_LEVELS))})",
+            stacklevel=4,
+        )
+    return logging.INFO
 
 
 def _parse_ff_log() -> Dict[str, int]:
@@ -36,9 +57,9 @@ def _parse_ff_log() -> Dict[str, int]:
             continue
         if "=" in part:
             cat, lvl = part.split("=", 1)
-            out[cat.strip()] = _LEVELS.get(lvl.strip().lower(), logging.INFO)
+            out[cat.strip()] = _resolve_level(lvl)
         else:
-            out["*"] = _LEVELS.get(part.lower(), logging.INFO)
+            out["*"] = _resolve_level(part)
     return out
 
 

@@ -36,7 +36,13 @@ class StepTimes:
             "mean_ms": round(float(a.mean()), 3),
             "p50_ms": round(float(np.percentile(a, 50)), 3),
             "p90_ms": round(float(np.percentile(a, 90)), 3),
+            # the SLO figure soak/latency work quotes (p90 alone hides
+            # the tail a stall or recompile puts there)
+            "p99_ms": round(float(np.percentile(a, 99)), 3),
             "max_ms": round(float(a.max()), 3),
+            # total recorded wall time: the denominator of
+            # throughput-per-step-loop comparisons
+            "total_ms": round(float(a.sum()), 3),
         }
 
     def report(self) -> str:
@@ -45,7 +51,9 @@ class StepTimes:
             return "no steps recorded"
         return (
             f"{s['steps']} steps: mean {s['mean_ms']}ms, "
-            f"p50 {s['p50_ms']}ms, p90 {s['p90_ms']}ms, max {s['max_ms']}ms"
+            f"p50 {s['p50_ms']}ms, p90 {s['p90_ms']}ms, "
+            f"p99 {s['p99_ms']}ms, max {s['max_ms']}ms, "
+            f"total {s['total_ms']}ms"
         )
 
 

@@ -61,6 +61,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Union
 
 from ...logging_utils import get_logger
 from ...metrics import ClusterStats
+from ...obs.tracer import NULL_TRACER
 from ..batch_config import (
     GenerationConfig,
     GenerationResult,
@@ -219,6 +220,14 @@ class ClusterManager:
         self._migration_queue: List[int] = []
         self._mig_queued: Set[int] = set()
         self._log = get_logger("serve")
+        # Observability (flexflow_tpu/obs): the router/manager lane of
+        # the cluster timeline (placements, migrations, failovers,
+        # health transitions, heartbeat gaps) plus the failure flight
+        # recorder's dump triggers. NULL_TRACER/None by default — the
+        # drive loop pays one attribute read per guarded site;
+        # obs.attach_observability wires live ones in.
+        self.tracer = NULL_TRACER
+        self.flight_recorder = None
 
     # ------------------------------------------------------------------
     # construction
@@ -405,6 +414,15 @@ class ClusterManager:
                 "(DOWN) — the request fails terminally instead of "
                 "waiting for a probe that may never succeed"
             )
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("place_failed", trace_id=cr.cluster_id, how=how)
+        if self.flight_recorder is not None:
+            self.flight_recorder.dump(
+                self.tracer.lane or "router", "request_error",
+                step=self._step_counter,
+                extra={"cluster_id": cr.cluster_id, "how": how},
+            )
         return False
 
     def _place(
@@ -481,11 +499,13 @@ class ClusterManager:
             # boundary — and the held slot keeps its pages alive for
             # the migration that follows
             cr.rid = rep.rm.submit(
-                known, dataclasses.replace(gen_home, max_new_tokens=1)
+                known, dataclasses.replace(gen_home, max_new_tokens=1),
+                trace_id=cr.cluster_id,
             )
             rep.rm.hold_on_finish(cr.rid)
         else:
-            cr.rid = rep.rm.submit(known, gen_home)
+            cr.rid = rep.rm.submit(known, gen_home,
+                                   trace_id=cr.cluster_id)
         req = rep.rm.requests[cr.rid]
         if first:
             req.profile.replica_id = rep.index
@@ -503,6 +523,12 @@ class ClusterManager:
             cr.profile.replica_id = rep.index
             cr.profile.router_queue_delay_s = delay
         cr._known = None
+        tr = self.tracer
+        if tr.enabled:
+            tr.event(
+                "place", trace_id=cr.cluster_id, replica=rep.index,
+                phase=phase, retries=cr.retries,
+            )
         return True
 
     # convenience alias (c_backend drives both manager kinds identically)
@@ -521,6 +547,15 @@ class ClusterManager:
         if transition is None:
             return
         rep = self.replicas[pos]
+        tr = self.tracer
+        if tr.enabled:
+            # health transitions land on the AFFECTED replica's lane so
+            # a flight-recorder dump of that lane ends with them
+            tr.event(
+                "health", lane=f"replica{rep.index}", replica=rep.index,
+                state=transition,
+                error=str(self.health[pos].last_error or "")[:200],
+            )
         if transition == "suspect":
             self.stats.replica_suspect += 1
             self._log.warning(
@@ -533,7 +568,20 @@ class ClusterManager:
                               rep.index)
         elif transition == "down":
             self.stats.replica_down += 1
+            # capture the machine's recorded trip BEFORE failover runs
+            # (_adopt_standby may replace the health record)
+            down_at = self.health[pos].down_at_step
             self._on_replica_down(pos, exc)
+            if self.flight_recorder is not None:
+                self.flight_recorder.dump(
+                    f"replica{rep.index}", "replica_down",
+                    step=self._step_counter,
+                    extra={
+                        "replica_index": rep.index,
+                        "health_state": HealthState.DOWN.value,
+                        "down_at_step": down_at,
+                    },
+                )
 
     def _on_replica_down(self, pos: int,
                          exc: Optional[BaseException]) -> None:
@@ -644,6 +692,17 @@ class ClusterManager:
                 f"{self.serving.failover_retries})"
             )
             self.stats.failover_errors += 1
+            tr = self.tracer
+            if tr.enabled:
+                tr.event("request_error", trace_id=cr.cluster_id,
+                         reason="failover_exhausted")
+            if self.flight_recorder is not None:
+                self.flight_recorder.dump(
+                    self.tracer.lane or "router", "request_error",
+                    step=self._step_counter,
+                    extra={"cluster_id": cr.cluster_id,
+                           "error": cr.error[:500]},
+                )
             return
         backoff = (
             0 if cr.retries == 1
@@ -670,6 +729,13 @@ class ClusterManager:
             if self._place(cr, cr._known, ignore_slo=True):
                 self.stats.failovers += 1
                 progressed = True
+                tr = self.tracer
+                if tr.enabled:
+                    tr.event(
+                        "failover", trace_id=cid,
+                        replica=cr.profile.failover_replica_id,
+                        retry=cr.retries,
+                    )
                 self._log.warning(
                     "failover: request %d re-admitted on replica %d "
                     "(retry %d, %d tokens recomputed)",
@@ -783,6 +849,7 @@ class ClusterManager:
                 rid_dst = migrate_request(
                     src, dst, cr.rid, gen_dst,
                     stats=self.stats, injector=self.fault_injector,
+                    trace_id=cr.cluster_id, tracer=self.tracer,
                 )
             except Exception as exc:
                 self.stats.migration_failures += 1
@@ -851,12 +918,16 @@ class ClusterManager:
         gen_home = dataclasses.replace(
             cr.gen, max_new_tokens=cr.gen.max_new_tokens - produced
         )
-        cr.rid = rep.rm.submit(known, gen_home)
+        cr.rid = rep.rm.submit(known, gen_home, trace_id=cr.cluster_id)
         cr.replica = self.replicas.index(rep)
         rep.rm.requests[cr.rid].profile = cr.profile
         cr.profile.retries = cr.retries
         cr.profile.failover_replica_id = rep.index
         cr.profile.replica_id = rep.index
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("recompute_readmit", trace_id=cid,
+                     replica=rep.index, n_tokens=len(known))
         self._log.debug(
             "migration back-pressure: request %d drained to replica %d "
             "via recompute (%d tokens re-prefill)",
@@ -887,6 +958,9 @@ class ClusterManager:
         gap = step_no - rep.last_contact_step
         if gap >= self.serving.heartbeat_gap_steps:
             self.stats.heartbeat_gaps += 1
+            tr = self.tracer
+            if tr.enabled:
+                tr.event("heartbeat_gap", replica=rep.index, gap=gap)
             self._observe_failure(
                 pos,
                 HeartbeatGap(
@@ -929,6 +1003,9 @@ class ClusterManager:
             if h.state is HealthState.DOWN:
                 if h.maybe_probe(step_no):
                     self.stats.probes += 1
+                    if self.tracer.enabled:
+                        self.tracer.event("probe", replica=rep.index,
+                                          backoff=h.backoff_steps)
                     self._log.warning(
                         "replica %d probing (circuit half-open after "
                         "%d-step backoff)", rep.index, h.backoff_steps,

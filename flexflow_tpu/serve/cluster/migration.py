@@ -54,6 +54,8 @@ def migrate_request(
     *,
     stats: Optional[ClusterStats] = None,
     injector=None,
+    trace_id: Optional[int] = None,
+    tracer=None,
 ) -> Optional[int]:
     """Move a prefilled request from replica ``src`` to replica ``dst``.
 
@@ -87,7 +89,8 @@ def migrate_request(
                 "replica_transport is uniform, so both ends must be "
                 "RemoteReplica"
             )
-        return _migrate_remote(src, dst, rid, gen, stats=stats)
+        return _migrate_remote(src, dst, rid, gen, stats=stats,
+                               trace_id=trace_id, tracer=tracer)
     req = src.rm.requests[rid]
     assert req.status is RequestStatus.COMPLETED, (
         f"migrating request {rid} in state {req.status}"
@@ -102,6 +105,7 @@ def migrate_request(
     rid_dst = dst.rm.adopt_prefilled(
         req.tokens, prompt_len, gen,
         profile=req.profile, prompt_text=req.prompt,
+        trace_id=trace_id,
     )
     if rid_dst is None:
         return None
@@ -129,6 +133,13 @@ def migrate_request(
         stats.migrations += 1
         stats.migrated_pages += n_pages
         stats.migrated_bytes += bytes_moved
+    if tracer is not None and tracer.enabled:
+        tracer.event(
+            "migrate",
+            trace_id=-1 if trace_id is None else trace_id,
+            src=src.index, dst=dst.index, pages=n_pages,
+            bytes=bytes_moved,
+        )
     _log.debug(
         "migrate: request %d replica %d -> %d (%d pages, %d bytes, "
         "prompt %d tokens)",
@@ -138,7 +149,8 @@ def migrate_request(
 
 
 def _migrate_remote(src, dst, rid: int, gen,
-                    *, stats: Optional[ClusterStats] = None
+                    *, stats: Optional[ClusterStats] = None,
+                    trace_id: Optional[int] = None, tracer=None,
                     ) -> Optional[int]:
     """The over-the-wire hand-off: the SOURCE server gathers + harvests
     the held prefill's pages (``migrate_out`` — codes, quant scale rows
@@ -152,7 +164,7 @@ def _migrate_remote(src, dst, rid: int, gen,
     retries with backoff or falls back to recompute re-admission."""
     view = src.rm.requests[rid]
     out = src.migrate_out(rid)
-    rid_dst = dst.migrate_in(out, gen)
+    rid_dst = dst.migrate_in(out, gen, trace_id=trace_id)
     if rid_dst is None:
         return None
     # the cluster-side profile object follows the request to its new
@@ -167,6 +179,17 @@ def _migrate_remote(src, dst, rid: int, gen,
         stats.migrations += 1
         stats.migrated_pages += n_pages
         stats.migrated_bytes += bytes_moved
+    if tracer is not None and tracer.enabled:
+        # the WIRE HOP of a migrated request's timeline: the same trace
+        # id as its prefill-replica and decode-replica spans, on the
+        # wire lane (the underlying migrate_out/migrate_in rpc spans
+        # carry the byte-level story)
+        tracer.event(
+            "wire_migrate", lane="wire",
+            trace_id=-1 if trace_id is None else trace_id,
+            src=src.index, dst=dst.index, pages=n_pages,
+            bytes=bytes_moved,
+        )
     _log.debug(
         "migrate (wire): request %d replica %d -> %d (%d pages, %d "
         "bytes on the wire, prompt %d tokens)",

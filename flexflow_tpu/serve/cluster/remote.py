@@ -57,6 +57,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ...logging_utils import get_logger
+from ...obs.tracer import NULL_TRACER
 from ..batch_config import GenerationConfig, ProfileInfo
 from ..request_manager import TERMINAL_STATUSES, RequestStatus
 from .server import gen_to_wire
@@ -202,6 +203,7 @@ class _RemoteRM:
         prompt: Union[str, Sequence[int]],
         gen: Optional[GenerationConfig] = None,
         max_new_tokens: Optional[int] = None,
+        trace_id: Optional[int] = None,
     ) -> int:
         if isinstance(prompt, str):
             raise ValueError(
@@ -212,9 +214,15 @@ class _RemoteRM:
         if max_new_tokens is not None:
             gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
         owner = self._owner
-        res = owner._rpc("submit", {
+        args = {
             "tokens": [int(t) for t in prompt], "gen": gen_to_wire(gen),
-        })
+        }
+        if trace_id is not None:
+            # cross-host correlation: the trace id rides the RPC
+            # envelope so the server-side scheduler's spans for this
+            # request stitch under the cluster-wide timeline
+            args["trace_id"] = int(trace_id)
+        res = owner._rpc("submit", args)
         rid = int(res["rid"])
         view = _RequestView(rid)
         self.requests[rid] = view
@@ -291,6 +299,11 @@ class RemoteReplica:
         self._pending_abandon = False
         self._last_call_retries = 0
         self._log = get_logger("serve")
+        # Observability: the WIRE tracer — rpc spans, retries and
+        # envelope-shipped server events land on it when
+        # obs.attach_observability wires a live one (lane "wire",
+        # clocked by the client-side step counter).
+        self.tracer = NULL_TRACER
 
     def bind_stats(self, stats) -> None:
         """Late-bind the ClusterStats source (the manager owns it but
@@ -324,12 +337,22 @@ class RemoteReplica:
         retries = self.serving.rpc_retries if retryable else 0
         self._last_call_retries = 0
         last_exc: Optional[TransportError] = None
+        tr = self.tracer
+        t0 = time.perf_counter() if tr.enabled else 0.0
         for attempt in range(retries + 1):
             if attempt:
                 self._last_call_retries += 1
                 st = self.stats
                 if st is not None:
                     st.rpc_retries += 1
+                if tr.enabled:
+                    # retries/backoff are part of the request's wire
+                    # story — each is its own event on the wire lane
+                    tr.event(
+                        "rpc_retry", method=method, attempt=attempt,
+                        replica=self.index,
+                        error=type(last_exc).__name__,
+                    )
                 if self.transport.needs_backoff:
                     time.sleep(
                         self.serving.rpc_backoff_s * (2 ** (attempt - 1))
@@ -351,7 +374,14 @@ class RemoteReplica:
                         # it as step latency, same as the in-process
                         # "latency" fault kind
                         self.injected_latency_s += extra
-                return self.transport.call(seq, method, args, deadline)
+                result = self.transport.call(seq, method, args, deadline)
+                if tr.enabled:
+                    tr.event(
+                        "rpc", t=t0, dur=time.perf_counter() - t0,
+                        method=method, replica=self.index,
+                        attempts=attempt + 1, ok=True,
+                    )
+                return result
             except TransportError as exc:
                 last_exc = exc
                 kind = getattr(exc, "kind", None)
@@ -366,6 +396,12 @@ class RemoteReplica:
         if st is not None:
             st.rpc_errors += 1
         assert last_exc is not None
+        if tr.enabled:
+            tr.event(
+                "rpc", t=t0, dur=time.perf_counter() - t0, method=method,
+                replica=self.index, attempts=retries + 1, ok=False,
+                error=type(last_exc).__name__,
+            )
         raise last_exc
 
     def _apply_envelope(self, result: Dict[str, Any]) -> None:
@@ -374,6 +410,15 @@ class RemoteReplica:
             self._telemetry = tel
             self.rm.stats.update(tel.get("stats") or {})
             self.rm.hold_finished = set(tel.get("hold_finished") or ())
+            shipped = tel.get("trace_events")
+            if shipped and self.tracer.enabled:
+                # the replica server's spans come home inside every
+                # state-bearing envelope — merge them (already tagged
+                # with the replica lane) so the front-end's buffer
+                # holds ONE stitched cross-host timeline
+                self.tracer.buffer.extend(
+                    shipped, lane=f"replica{self.index}"
+                )
         for rid, state in (result.get("updates") or {}).items():
             view = self.rm.requests.get(int(rid))
             if view is not None:
@@ -525,15 +570,21 @@ class RemoteReplica:
         return self._rpc("migrate_out", {"rid": int(rid)})
 
     def migrate_in(self, payload: Dict[str, Any],
-                   gen: GenerationConfig) -> Optional[int]:
-        res = self._rpc("migrate_in", {
+                   gen: GenerationConfig,
+                   trace_id: Optional[int] = None) -> Optional[int]:
+        args = {
             "tokens": payload["tokens"],
             "prompt_len": payload["prompt_len"],
             "prompt": payload.get("prompt", ""),
             "page_size": payload["page_size"],
             "pages": payload["pages"],
             "gen": gen_to_wire(gen),
-        })
+        }
+        if trace_id is not None:
+            # the trace context follows the pages: the decode server's
+            # adoption + decode spans stitch under the same timeline
+            args["trace_id"] = int(trace_id)
+        res = self._rpc("migrate_in", args)
         rid = res.get("rid")
         if rid is None:
             self._apply_envelope(res)

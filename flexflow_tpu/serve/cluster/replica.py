@@ -266,6 +266,9 @@ class Replica:
             rm.slots[slot] = None
             rm.requests[rid].slot = -1
         self.reset_rate()
+        tr = rm.tracer
+        if tr.enabled:
+            tr.event("abandon", dropped=dropped)
         return dropped
 
     # ------------------------------------------------------------------

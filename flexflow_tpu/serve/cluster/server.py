@@ -92,7 +92,7 @@ class ReplicaServerCore:
 
     def _telemetry(self) -> Dict[str, Any]:
         rep = self.replica
-        return {
+        out = {
             "steps_taken": rep.steps_taken,
             "has_work": rep.has_work(),
             "load": rep.load(),
@@ -104,6 +104,15 @@ class ReplicaServerCore:
             "hold_finished": sorted(rep.rm.hold_finished),
             "stats": rep.rm.stats.snapshot(),
         }
+        tracer = rep.rm.tracer
+        if tracer.enabled:
+            # tracing on (obs/): this server's spans ship home inside
+            # every state-bearing envelope — drained, so each event
+            # crosses the wire once. Events are codec-safe flat dicts.
+            events = tracer.buffer.drain()
+            if events:
+                out["trace_events"] = events
+        return out
 
     def _request_state(self, req) -> Dict[str, Any]:
         return {
@@ -167,7 +176,8 @@ class ReplicaServerCore:
 
     def _m_submit(self, args):
         rid = self.replica.rm.submit(
-            [int(t) for t in args["tokens"]], gen_from_wire(args["gen"])
+            [int(t) for t in args["tokens"]], gen_from_wire(args["gen"]),
+            trace_id=args.get("trace_id"),
         )
         req = self.replica.rm.requests[rid]
         return self._envelope(rid=rid, prompt_len=int(req.prompt_len))
@@ -230,6 +240,7 @@ class ReplicaServerCore:
             int(args["prompt_len"]),
             gen_from_wire(args["gen"]),
             prompt_text=args.get("prompt", ""),
+            trace_id=args.get("trace_id"),
         )
         if rid is None:
             return self._envelope(rid=None)
@@ -362,12 +373,20 @@ def build_replica_from_spec(spec: Dict[str, Any]) -> Replica:
     params = llama.init_params(jax.random.PRNGKey(int(spec.get("seed", 0))),
                                cfg)
     serving = serving_config_from_dict(dict(spec.get("serving") or {}))
-    return Replica.build(
+    replica = Replica.build(
         int(spec.get("index", 0)), llama, cfg, params, serving,
         role=str(spec.get("role", "mixed")),
         eos_token_id=spec.get("eos_token_id"),
         seed=int(spec.get("gen_seed", 0)),
     )
+    if spec.get("trace"):
+        # observability: trace into a local buffer that _telemetry
+        # drains into every envelope — the client stitches this
+        # subprocess's spans into the cluster-wide timeline
+        from ...obs import attach_observability
+
+        attach_observability(replica)
+    return replica
 
 
 def serve_forever(core: ReplicaServerCore, port: int = 0,

@@ -59,6 +59,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ...obs.tracer import NULL_TRACER
+
 MAGIC = b"FT"
 VERSION = 1
 _HEADER = struct.Struct("!2sBI")
@@ -351,6 +353,12 @@ class Transport:
         self.bytes_sent = 0
         self.bytes_received = 0
         self.reconnects = 0
+        # Observability (flexflow_tpu/obs): with a live tracer attached
+        # (obs.attach_observability sets the owning RemoteReplica's
+        # wire tracer here too) every frame exchange becomes a "wire"
+        # event carrying its byte counts — the per-RPC half of the
+        # ClusterStats wire_bytes_* counters.
+        self.tracer = NULL_TRACER
 
     @property
     def stats(self):
@@ -412,6 +420,10 @@ class LoopbackTransport(Transport):
         self._count(sent=len(request))
         response_frame = encode_frame(self.dispatch(decode_frame(request)))
         self._count(received=len(response_frame))
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("wire", method=method, sent=len(request),
+                     received=len(response_frame))
         response = decode_frame(response_frame)
         return _unwrap_response(response, seq)
 
@@ -476,6 +488,10 @@ class SocketTransport(Transport):
             self.drop_connection()
             raise ConnectionLost(f"rpc {method!r} failed: {exc}") from exc
         self._count(received=size_out[0])
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("wire", method=method, sent=len(frame),
+                     received=size_out[0])
         return _unwrap_response(response, seq)
 
     def drop_connection(self) -> None:

@@ -20,6 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.mesh import DATA_AXIS, MachineSpec, set_mesh as _set_mesh
+from ..obs.tracer import NULL_TRACER
 from .batch_config import BatchConfig
 
 
@@ -643,6 +644,13 @@ class InferenceEngine:
         # count_dispatch. The fused-epilogue claim ("strictly fewer
         # programs per step") is measured against this counter.
         self.dispatch_count = 0
+        # Observability (flexflow_tpu/obs): count_dispatch doubles as
+        # the tracing chokepoint — with a tracer attached (shared with
+        # the owning scheduler's lane by obs.attach_observability),
+        # every dispatched device program becomes a trace event, which
+        # is what lets a timeline show dispatched-programs-per-step.
+        # NULL_TRACER (default) keeps the counter a bare increment.
+        self.tracer = NULL_TRACER
         # Quantized KV pages (serve/kv_quant.py): validated up front so
         # a bad value fails at engine construction, not mid-serve.
         self.kv_quant_spec = None
@@ -901,8 +909,10 @@ class InferenceEngine:
 
     def count_dispatch(self, kind: str = "step") -> None:
         """Record one dispatched device program (see dispatch_count)."""
-        del kind  # per-kind breakdown not tracked; the total is the metric
         self.dispatch_count += 1
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("dispatch", kind=kind)
 
     def _get_step(self, chunk: int, all_logits: bool, with_mask: bool):
         """One compiled program per static signature — the analog of the

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..logging_utils import get_logger
 from ..metrics import SchedulerStats
+from ..obs.tracer import NULL_TRACER
 from .batch_config import (
     BatchConfig,
     GenerationConfig,
@@ -161,6 +162,18 @@ class RequestManager:
         self._prev_dispatch_slots: set = set()
         self.stats = SchedulerStats()
         self._log = get_logger("serve")
+        # Observability (flexflow_tpu/obs): request-lifecycle tracing +
+        # failure flight recorder. Disabled by default — every emission
+        # site below guards on ``tracer.enabled`` (one attribute read)
+        # before building any event, so a no-obs run does no extra
+        # per-step host work (tests/test_observability.py proves it).
+        # obs.attach_observability wires a live tracer in; the engine
+        # shares it so dispatch events land on the same lane.
+        self.tracer = NULL_TRACER
+        self.flight_recorder = None
+        # rid -> cluster-wide trace id (bound at submission; local runs
+        # fall back to the rid itself — see trace_of)
+        self._trace_ids: Dict[int, int] = {}
         # Retrace sentinel telemetry (analysis/retrace.py): compile
         # events recorded at the engine's jit chokepoint surface in the
         # scheduler stats (FF_LOG=serve=debug + bench reports). The
@@ -249,16 +262,32 @@ class RequestManager:
         prompt: Union[str, Sequence[int]],
         gen: Optional[GenerationConfig] = None,
         max_new_tokens: Optional[int] = None,
+        trace_id: Optional[int] = None,
     ) -> int:
         """Non-blocking submission: queue one request and return its id
         immediately. Drive the scheduler with :meth:`step` (or a
         concurrent :meth:`generate_stream`/:meth:`generate` call) and
         read tokens from ``requests[rid]`` / :meth:`result` as they
-        drain."""
+        drain. ``trace_id`` binds a cluster-wide trace id so this
+        request's spans stitch with its router/migration/other-replica
+        spans (obs/tracer.py); local rids are their own trace ids."""
         gen = gen or GenerationConfig()
         if max_new_tokens is not None:
             gen = dataclasses.replace(gen, max_new_tokens=max_new_tokens)
-        return self.register_request(prompt, gen)
+        rid = self.register_request(prompt, gen)
+        if trace_id is not None:
+            self._trace_ids[rid] = int(trace_id)
+        return rid
+
+    def bind_trace(self, rid: int, trace_id: int) -> None:
+        """Bind ``rid``'s spans to a cluster-wide trace id (submission
+        and migration adoption call this — see obs/__init__.py)."""
+        self._trace_ids[int(rid)] = int(trace_id)
+
+    def trace_of(self, rid: int) -> int:
+        """The trace id this request's spans carry: the bound
+        cluster-wide id, else the rid itself (single-engine runs)."""
+        return self._trace_ids.get(rid, rid)
 
     # ------------------------------------------------------------------
     # cluster hooks (serve/cluster/): hold-for-migration + adoption of
@@ -291,6 +320,7 @@ class RequestManager:
         *,
         profile: Optional[ProfileInfo] = None,
         prompt_text: str = "",
+        trace_id: Optional[int] = None,
     ) -> Optional[int]:
         """Admit an EXTERNALLY prefilled request straight into DECODING
         (cluster prefill→decode migration, serve/cluster/migration.py):
@@ -332,6 +362,14 @@ class RequestManager:
         self.requests[rid] = req
         self.slots[slot] = rid
         self.stats.admitted += 1
+        if trace_id is not None:
+            self._trace_ids[rid] = int(trace_id)
+        tr = self.tracer
+        if tr.enabled:
+            tr.event(
+                "adopt", trace_id=self.trace_of(rid), rid=rid, slot=slot,
+                prompt_len=int(prompt_len),
+            )
         return rid
 
     def rollback_adopt(self, rid: int) -> None:
@@ -432,6 +470,10 @@ class RequestManager:
         req.inflight = 0
         self.pending.insert(0, req.request_id)
         self.stats.preemptions += 1
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("preempt", trace_id=self.trace_of(req.request_id),
+                     rid=req.request_id)
 
     def _lines_needed(self, req: Request, chunk: Optional[int] = None) -> int:
         """Conservative cache-line bound the next step may touch."""
@@ -651,6 +693,16 @@ class RequestManager:
                     self.stats.prefix_misses += 1
             self.slots[i] = rid
             self.stats.admitted += 1
+            tr = self.tracer
+            if tr.enabled:
+                tid = self.trace_of(rid)
+                if self.prefix_cache is not None:
+                    tr.event("prefix_lookup", trace_id=tid, rid=rid,
+                             matched=matched)
+                tr.event(
+                    "admit", trace_id=tid, rid=rid, slot=i,
+                    prompt_len=req.prompt_len, cached_prefix=matched,
+                )
 
     def _active(self, status: RequestStatus) -> List[Request]:
         out = []
@@ -677,6 +729,21 @@ class RequestManager:
         req.status = RequestStatus.ERROR if error else RequestStatus.COMPLETED
         req.error = error
         req.profile.finish_time = time.perf_counter()
+        tr = self.tracer
+        if tr.enabled:
+            tr.event(
+                "terminal", trace_id=self.trace_of(req.request_id),
+                rid=req.request_id, status=req.status.value,
+                error=(error or "")[:200],
+            )
+        if error and self.flight_recorder is not None:
+            # terminal request errors are a flight-recorder trigger
+            # (obs/flight_recorder.py): dump this lane's recent ring
+            self.flight_recorder.dump(
+                self.tracer.lane, "request_error",
+                step=self._step_counter,
+                extra={"rid": req.request_id, "error": error[:500]},
+            )
         if (
             self.prefix_cache is not None
             and error is None
@@ -830,6 +897,11 @@ class RequestManager:
             # the request's first generated token, as the host observes
             # it (TTFT the way a streaming client would measure it)
             req.profile.first_token_time = time.perf_counter()
+            tr = self.tracer
+            if tr.enabled:
+                tr.event("first_token",
+                         trace_id=self.trace_of(req.request_id),
+                         rid=req.request_id)
         req.tokens.append(int(token))
         gen_len = len(req.tokens) - req.prompt_len
         eos = self.eos_token_id
@@ -908,6 +980,9 @@ class RequestManager:
             "decode", active_slots=len(decoding), num_slots=R,
             decode_tokens=len(decoding),
         )
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("decode_step", rows=len(decoding))
         self._maybe_log_stats()
 
     def _dispatch_mixed(self, prefilling: List[Request],
@@ -951,6 +1026,7 @@ class RequestManager:
             snapshot.append((req.request_id, s, 1, True))
             sampled_slots.add(s)
         spent = 0
+        tr = self.tracer
         for req in sorted(prefilling, key=lambda r: r.admit_seq):
             n = min(C, len(req.tokens) - req.n_sched)
             if n <= 0:
@@ -984,6 +1060,12 @@ class RequestManager:
                         s, req.tokens[: req.prompt_len], req.prompt_len
                     )
             snapshot.append((req.request_id, s, n, final))
+            if tr.enabled:
+                tr.event(
+                    "prefill_chunk",
+                    trace_id=self.trace_of(req.request_id),
+                    rid=req.request_id, n=n, offset=off, final=final,
+                )
         if last is None:
             last = jnp.zeros((R,), jnp.int32)
         self._key, sub = jax.random.split(self._key)
@@ -1003,6 +1085,11 @@ class RequestManager:
             prefill_tokens=spent, decode_tokens=len(decoding),
             budget=C * max(1, len(prefilling)),
         )
+        if tr.enabled:
+            tr.event(
+                "mixed_step", prefill_tokens=spent,
+                decode_rows=len(decoding),
+            )
         self._maybe_log_stats()
 
     def _flush_one(self):
@@ -1016,6 +1103,9 @@ class RequestManager:
         # ffcheck: disable=FF107 -- the pipeline flush IS the designed sync point: it drains steps the device already finished, dispatch_ahead steps behind
         toks = np.asarray(jax.device_get(toks))
         self.stats.flushes += 1
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("flush", entries=len(snapshot))
         for rid, slot, ntoks, samples in snapshot:
             req = self.requests.get(rid)
             if req is None:
@@ -1229,6 +1319,12 @@ class RequestManager:
             ) if prefilling else 0,
             decode_tokens=len(decoding),
         )
+        tr = self.tracer
+        if tr.enabled:
+            tr.event(
+                "sync_step", prefill_rows=len(prefilling),
+                decode_rows=len(decoding),
+            )
         self._maybe_log_stats()
         return True
 

@@ -704,6 +704,10 @@ class SpecInferManager(RequestManager):
         loop + merge_dfs_trees, request_manager.cc:2397-2410). The
         early-exit draft is the LLM engine itself through the
         layer-sliced step — one tree, nothing to merge."""
+        tr = self.tracer
+        if tr.enabled:
+            tr.event("spec_draft", width=W, depth=D, rows=len(reqs),
+                     draft=self.spec.draft)
         if self.spec.draft == "early_exit":
             return self._grow_trees_one_ssm(
                 self.engine, reqs, W, D,
@@ -762,6 +766,14 @@ class SpecInferManager(RequestManager):
             self.stats.spec_rounds += 1
             self.stats.spec_drafted += drafted
             self.stats.spec_accepted += n_accepted
+            tr = self.tracer
+            if tr.enabled:
+                tr.event(
+                    "spec_verify",
+                    trace_id=self.trace_of(req.request_id),
+                    rid=req.request_id, drafted=drafted,
+                    accepted=n_accepted,
+                )
             if self.spec.adaptive:
                 # the controller reads acceptance from the ALREADY
                 # fetched greedy walk — no extra transfer (FF107)
