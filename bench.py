@@ -215,6 +215,13 @@ _PHASES = [
     # failed-over outputs vs the fault-free run, zero hung requests,
     # zero steady-state recompiles on survivors asserted
     ("serve_faults", 700, 500, True, True),
+    # elastic control plane: Poisson traffic through a live scale
+    # 2→3→2 (warm scale_out, drain-based scale_in) plus a scripted
+    # manager kill/restart recovered from the durable request journal
+    # — zero lost requests + bitwise outputs vs the static-membership
+    # run asserted; recovery/drain times + journal bytes/request
+    # reported
+    ("serve_elastic", 700, 500, True, True),
     # multi-host cluster transport: loopback-transported replicas
     # (every Replica call through the binary RPC wire codec) with a
     # warm standby — kill the replica holding a set of prefix families
@@ -452,6 +459,31 @@ def orchestrate(which):
                 failovers=d.get("failovers"),
                 retries=d.get("retries"),
                 replica_down=d.get("replica_down"),
+                output_parity=d.get("output_parity"),
+                platform=d.get("platform"),
+            )
+
+    # Derived: control-plane recovery — how long a manager death
+    # strands its in-flight requests (journal replay + engine rebuild +
+    # recompute re-admission drain), plus the drain cost of a live
+    # scale_in and the journal's per-request byte overhead, so
+    # BENCH_r*.json tracks the elastic-control-plane envelope the
+    # item-2b autoscaler budgets against.
+    rec = _RESULTS.get("elastic_serve_tokens_per_sec_per_chip")
+    if rec:
+        d = rec.get("detail") or {}
+        if d.get("manager_recovery_time_s") is not None:
+            emit(
+                "manager_recovery_time_s",
+                d["manager_recovery_time_s"],
+                "s",
+                source=rec["metric"],
+                recover_build_time_s=d.get("recover_build_time_s"),
+                drain_time_s=d.get("drain_time_s"),
+                journal_bytes_per_request=d.get(
+                    "journal_bytes_per_request"),
+                journal_replayed=d.get("journal_replayed"),
+                lost_requests=d.get("lost_requests"),
                 output_parity=d.get("output_parity"),
                 platform=d.get("platform"),
             )
@@ -2903,6 +2935,273 @@ def serve_faults_bench(on_tpu, kernels):
     return faulted["tps"]
 
 
+def serve_elastic_bench(on_tpu, kernels):
+    """Elastic, crash-recoverable control plane (serve/cluster/
+    journal.py + reconfigure.py + ClusterManager.recover): Poisson
+    traffic through a LIVE scale 2→3→2 — a replica joins mid-run
+    (scale_out) and later drains back out (scale_in) — plus a scripted
+    MANAGER death (FaultPlan "manager_crash") recovered from the
+    durable request journal mid-traffic.
+
+    Two runs on the SAME arrival schedule and prompts: a static
+    2-replica reference, then the elastic run. ASSERTED: zero lost
+    requests and zero errors (every submission reaches a terminal
+    state through the restart), greedy outputs BITWISE the static
+    run's (scale_out/scale_in/set_pools placements and the journal
+    recovery's recompute re-admissions move WHERE tokens are computed,
+    never WHICH tokens), scale_outs == scale_ins == 1 with the retired
+    replica leak-free, manager_recoveries == 1, and zero steady-state
+    recompiles on replicas that lived through the whole run.
+
+    Reported: manager recovery time (crash → every stranded request
+    terminal) + the recover() rebuild time, drain time (begin_scale_in
+    → retire), journal bytes/records per request, and both runs'
+    tokens/sec.
+
+    Measurement caveat (CPU): in-process replicas time-slice one
+    device, so the scale events do not change hardware capacity here —
+    recovery/drain times measure the CONTROL PLANE's cost (journal
+    replay, engine rebuild, recompute re-admission), which is the
+    number the item-2b autoscaler budgets against; on real multi-host
+    the capacity change adds on top."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import ClusterManager, ServingConfig
+    from flexflow_tpu.serve.cluster import Fault, FaultPlan
+    from flexflow_tpu.serve.cluster.faults import InjectedManagerCrash
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_slots = 16 if on_tpu else 8        # per replica
+    n_req = 30 if on_tpu else 18
+    n_new = 24 if on_tpu else 12
+    prompt_len = 48 if on_tpu else 16
+    page_size = 64 if on_tpu else 8
+    if not on_tpu and kernels == "pallas":
+        _log("serve_elastic: forcing kernels=xla off-TPU")
+        kernels = "xla"
+
+    prompts = [
+        [(i * 13 + j * 7 + 5) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_req)
+    ]
+    journal_dir = tempfile.mkdtemp(prefix="ffelastic_")
+
+    def sc(journal=False):
+        return ServingConfig(
+            max_requests_per_batch=n_slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=16 if on_tpu else 8,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=page_size,
+            replicas=2,
+            router_policy="round_robin",
+            journal_dir=journal_dir if journal else None,
+            sanitizers=("retrace",),
+        )
+
+    def make_cm(journal=False):
+        cm = ClusterManager.build(llama, cfg, params, sc(journal))
+        warm = [
+            [(i * 7 + j * 3 + 11) % cfg.vocab_size
+             for j in range(prompt_len)]
+            for i in range(2)
+        ]
+        for rep in cm.replicas:
+            rep.rm.generate(warm, max_new_tokens=3)
+            rep.rm.stats = type(rep.rm.stats)()
+        cm.stats = type(cm.stats)()
+        return cm
+
+    # --- static reference arm (also calibrates the Poisson schedule)
+    cm_ref = make_cm()
+    t0 = time.perf_counter()
+    cm_ref.generate(prompts[:n_slots], max_new_tokens=n_new)
+    est_tps = (n_slots * n_new) / (time.perf_counter() - t0)
+    for rep in cm_ref.replicas:
+        rep.rm.stats = type(rep.rm.stats)()
+    cm_ref.stats = type(cm_ref.stats)()
+    rng = np.random.default_rng(47)
+    arrival_s = np.cumsum(
+        rng.exponential(scale=n_new / est_tps, size=n_req)
+    ).tolist()
+
+    def run_static(cm):
+        cids, due = [], list(zip(arrival_s, prompts))
+        t0 = time.perf_counter()
+        while due or any(not cm._terminal(c) for c in cids):
+            now = time.perf_counter() - t0
+            assert now < (900.0 if on_tpu else 420.0), "static arm hung"
+            while due and due[0][0] <= now:
+                _, p = due.pop(0)
+                cids.append(cm.submit(p, max_new_tokens=n_new))
+            if not cm.step() and due:
+                time.sleep(max(0.0, due[0][0] - (time.perf_counter() - t0)))
+        cm.drain()
+        wall = time.perf_counter() - t0
+        outs = [list(cm.result(c).output_tokens) for c in cids]
+        return outs, sum(len(o) for o in outs) / wall
+
+    steps_before = cm_ref._step_counter
+    ref_outs, ref_tps = run_static(cm_ref)
+    ref_steps = cm_ref._step_counter - steps_before
+    errors_ref = sum(
+        1 for c in cm_ref.requests if cm_ref.result(c).error is not None
+    )
+    del cm_ref
+
+    # --- elastic arm: scale out at 1/4 submitted, drain the newcomer
+    # back out at 3/4 submitted, manager dies mid-run and recovers
+    crash_step = max(8, ref_steps // 2)
+    plan = FaultPlan([Fault("manager_crash", replica=0, step=crash_step)])
+    cm = make_cm(journal=True)
+    injector = cm.attach_faults(plan)
+    scale_out_at = max(1, n_req // 4)
+    scale_in_at = max(2, (3 * n_req) // 4)
+    cids, due = [], list(zip(arrival_s, prompts))
+    scaled_out = drain_begun = False
+    t_drain0 = t_drain1 = None
+    t_crash = recover_build_s = None
+    at_crash_inflight, completions = [], {}
+    jbytes_before_crash = jrecs_before_crash = 0
+    recoveries = 0
+    t0 = time.perf_counter()
+    wall_budget = 900.0 if on_tpu else 420.0
+    while due or any(not cm._terminal(c) for c in cids):
+        now = time.perf_counter() - t0
+        assert now < wall_budget, (
+            f"hung requests after {wall_budget}s "
+            f"(health={cm.health_snapshot()})"
+        )
+        while due and due[0][0] <= now:
+            _, p = due.pop(0)
+            cids.append(cm.submit(p, max_new_tokens=n_new))
+        if not scaled_out and len(cids) >= scale_out_at:
+            cm.scale_out(warm=True)
+            scaled_out = True
+        if scaled_out and not drain_begun and len(cids) >= scale_in_at:
+            cm.begin_scale_in(2)
+            t_drain0 = time.perf_counter()
+            drain_begun = True
+        try:
+            progressed = cm.step()
+        except InjectedManagerCrash:
+            # the scripted kill -9: drop the manager object (everything
+            # in-process dies with it) and restart from the journal —
+            # the SAME injector re-attaches so the crash stays consumed
+            t_crash = time.perf_counter()
+            at_crash_inflight = [c for c in cids if not cm._terminal(c)]
+            jbytes_before_crash = cm.stats.journal_bytes
+            jrecs_before_crash = cm.stats.journal_records
+            was_draining = bool(cm._draining)
+            del cm
+            cm = ClusterManager.recover(llama, cfg, params, sc(journal=True))
+            recover_build_s = time.perf_counter() - t_crash
+            cm.attach_faults(injector)
+            recoveries += 1
+            if was_draining and len(cm.replicas) > 2:
+                # the drain had begun but not committed — re-issue it
+                # (recovery replays committed membership only)
+                cm.begin_scale_in(2)
+            continue
+        if drain_begun and t_drain1 is None and len(cm.replicas) == 2:
+            t_drain1 = time.perf_counter()
+        for c in cids:
+            if c not in completions and cm._terminal(c):
+                completions[c] = time.perf_counter() - t0
+        if not progressed and due:
+            time.sleep(max(0.0, due[0][0] - (time.perf_counter() - t0)))
+    cm.drain()
+    wall = time.perf_counter() - t0
+    if t_drain1 is None and len(cm.replicas) == 2:
+        t_drain1 = time.perf_counter()
+    for c in cids:
+        completions.setdefault(c, wall)
+    outs = [list(cm.result(c).output_tokens) for c in cids]
+    errors = sum(1 for c in cids if cm.result(c).error is not None)
+    tps = sum(len(o) for o in outs) / wall
+
+    st = cm.cluster_stats()
+    assert errors == 0 and errors_ref == 0, (
+        f"elastic serving lost requests (static={errors_ref}, "
+        f"elastic={errors})"
+    )
+    assert len(outs) == n_req, "a submission vanished across the restart"
+    assert outs == ref_outs, (
+        "elastic outputs diverged from the static-membership run — "
+        "reconfiguration/recovery must be bitwise"
+    )
+    assert recoveries == 1 and st["manager_recoveries"] == 1, (
+        f"the manager crash did not fire/recover as scripted: {st}"
+    )
+    # scale events split across manager incarnations (stats are
+    # per-incarnation; the journal carries membership across) — the
+    # membership itself is the cross-incarnation assertion:
+    assert scaled_out and drain_begun
+    assert len(cm.replicas) == 2, (
+        f"scale_in never retired the newcomer ({len(cm.replicas)} "
+        "replicas at end)"
+    )
+    cm.check_no_leaks()
+    for rep in cm.replicas:
+        assert rep.rm.hold_finished == set()
+        assert rep.rm.stats.retraces == 0, (
+            f"replica {rep.index}: steady-state recompiles"
+        )
+    recovery_s = 0.0
+    if t_crash is not None and at_crash_inflight:
+        recovery_s = max(
+            completions[c] for c in at_crash_inflight
+        ) - (t_crash - t0)
+    drain_s = (
+        (t_drain1 - t_drain0)
+        if t_drain0 is not None and t_drain1 is not None else 0.0
+    )
+    journal_bytes = jbytes_before_crash + st["journal_bytes"]
+    journal_records = jrecs_before_crash + st["journal_records"]
+    shutil.rmtree(journal_dir, ignore_errors=True)
+
+    emit(
+        "elastic_serve_tokens_per_sec_per_chip",
+        round(tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=tps / max(1e-9, ref_tps),
+        kernels=kernels,
+        n_requests=n_req,
+        n_slots_per_replica=n_slots,
+        new_tokens_per_request=n_new,
+        schedule="2->3->2 + manager kill/restart",
+        crash_step=crash_step,
+        manager_recovery_time_s=round(recovery_s, 3),
+        recover_build_time_s=round(recover_build_s or 0.0, 3),
+        drain_time_s=round(drain_s, 3),
+        journal_bytes=journal_bytes,
+        journal_records=journal_records,
+        journal_bytes_per_request=round(journal_bytes / n_req, 1),
+        journal_replayed=st["journal_replayed"],
+        scale_outs_after_recovery=st["scale_outs"],
+        scale_ins=st["scale_ins"],
+        manager_recoveries=st["manager_recoveries"],
+        failovers=st["failovers"],
+        errors=0,
+        lost_requests=0,
+        output_parity=1,
+        steady_state_recompiles=0,
+        static_tokens_per_sec=round(ref_tps, 2),
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return tps
+
+
 def serve_transport_bench(on_tpu, kernels):
     """Multi-host cluster transport (serve/cluster/transport.py +
     remote.py): a LOOPBACK-transported cluster — every Replica call
@@ -3442,6 +3741,8 @@ def child_main(phase, platform, kernels):
         serve_cluster_bench(on_tpu, kernels)
     elif phase == "serve_faults":
         serve_faults_bench(on_tpu, kernels)
+    elif phase == "serve_elastic":
+        serve_elastic_bench(on_tpu, kernels)
     elif phase == "serve_transport":
         serve_transport_bench(on_tpu, kernels)
     elif phase == "serve_7b":
@@ -3459,7 +3760,7 @@ def main():
                  "serve_paged", "serve_continuous", "serve_prefix",
                  "serve_paged_q", "serve_kv_hierarchy",
                  "serve_long_context", "serve_cluster",
-                 "serve_faults", "serve_transport", "serve_fused",
+                 "serve_faults", "serve_elastic", "serve_transport", "serve_fused",
                  "serve_int8", "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )

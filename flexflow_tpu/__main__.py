@@ -117,6 +117,7 @@ def cmd_serve(args):
             s for s in (args.replica_endpoints or "").split(",") if s
         ),
         standby_replicas=args.standby_replicas,
+        journal_dir=args.journal_dir,
     )
     ssms = []
     spec = None
@@ -371,6 +372,15 @@ def main(argv=None):
                    help="comma-separated host:port per remote replica "
                         "(then per standby) for --replica-transport "
                         "socket")
+    s.add_argument("--journal-dir", default=None, metavar="DIR",
+                   help="elastic control plane: write the durable "
+                        "request journal (submissions, flushed-token "
+                        "deltas, terminal records, membership "
+                        "snapshots) into DIR — a SIGKILL'd serve "
+                        "process restarts with ClusterManager.recover "
+                        "and finishes every journaled request bitwise "
+                        "(forces the cluster manager even at "
+                        "--replicas 1)")
     s.add_argument("--standby-replicas", type=int, default=0,
                    help="warm standbys: pre-built engines outside "
                         "routing that ADOPT a circuit-broken replica's "

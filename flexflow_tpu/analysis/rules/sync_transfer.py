@@ -125,6 +125,27 @@ HOT_ROOTS = {
     "export_tree",
     "import_tree",
     "_adopt_standby",
+    # elastic control plane (serve/cluster/journal.py + reconfigure.py):
+    # the journal's append/flush run at the drive loop's flush sync
+    # point EVERY cluster step and the reconfiguration ops run under
+    # live traffic — a blocking device transfer (or a hot-path fsync
+    # smuggled in as one) anywhere here would stall every replica's
+    # decode behind control-plane bookkeeping. The retire-time tree
+    # hand-off reuses export_tree's reviewed harvest suppression.
+    "append",
+    "append_now",
+    "flush",
+    "_journal_sync",
+    "_journal_checkpoint",
+    "compact",
+    "scale_out",
+    "begin_scale_in",
+    "maybe_retire",
+    "_retire",
+    "_warm_join",
+    "set_pools",
+    "rebuild_routing",
+    "on_cluster_step",
 }
 
 # Calls that force a synchronous transfer / device round-trip.

@@ -316,6 +316,20 @@ class ClusterStats:
     standby_adoptions: int = 0
     wire_bytes_sent: int = 0
     wire_bytes_received: int = 0
+    # Elastic control plane (serve/cluster/{journal,reconfigure}.py):
+    # committed reconfigurations by kind (replicas added live, replicas
+    # drained + retired, prefill/decode pool flips), journal traffic
+    # (records + raw frame bytes appended; compactions that rewrote the
+    # log to the live set), manager restarts recovered from the journal,
+    # and unfinished requests a recovery re-admitted through recompute.
+    scale_outs: int = 0
+    scale_ins: int = 0
+    pool_flips: int = 0
+    journal_records: int = 0
+    journal_bytes: int = 0
+    journal_compactions: int = 0
+    manager_recoveries: int = 0
+    journal_replayed: int = 0
 
     def record_placement(self, how: str) -> None:
         self.placements[how] = self.placements.get(how, 0) + 1
@@ -385,6 +399,14 @@ class ClusterStats:
             "standby_adoptions": self.standby_adoptions,
             "wire_bytes_sent": self.wire_bytes_sent,
             "wire_bytes_received": self.wire_bytes_received,
+            "scale_outs": self.scale_outs,
+            "scale_ins": self.scale_ins,
+            "pool_flips": self.pool_flips,
+            "journal_records": self.journal_records,
+            "journal_bytes": self.journal_bytes,
+            "journal_compactions": self.journal_compactions,
+            "manager_recoveries": self.manager_recoveries,
+            "journal_replayed": self.journal_replayed,
             "replicas": agg,
             "per_replica": per,
         }
@@ -405,6 +427,9 @@ class ClusterStats:
             f"rpc_err={s['rpc_errors']} rpc_retry={s['rpc_retries']} "
             f"hb_gaps={s['heartbeat_gaps']} reconn={s['reconnects']} "
             f"standby={s['standby_adoptions']} "
+            f"scale+{s['scale_outs']}/-{s['scale_ins']} "
+            f"flip={s['pool_flips']} jrnl={s['journal_records']}r/"
+            f"{s['journal_bytes']}B recov={s['manager_recoveries']} "
             f"wireB={s['wire_bytes_sent']}/{s['wire_bytes_received']} "
             f"pfx_hit_rate={agg.get('prefix_hit_rate', 0.0)} "
             f"adm={agg.get('admitted', 0)} "

@@ -132,6 +132,9 @@ CLUSTER_COUNTERS = frozenset({
     "failover_errors", "migration_failures", "migration_queue_overflows",
     "rpc_errors", "rpc_retries", "heartbeat_gaps", "reconnects",
     "standby_adoptions", "wire_bytes_sent", "wire_bytes_received",
+    "scale_outs", "scale_ins", "pool_flips", "journal_records",
+    "journal_bytes", "journal_compactions", "manager_recoveries",
+    "journal_replayed",
 })
 CLUSTER_GAUGES = frozenset({
     "migration_queue_depth", "migration_queue_peak",

@@ -57,15 +57,23 @@ per-request ``ProfileInfo.replica_id`` / ``router_queue_delay_s`` /
 """
 from .faults import (
     KINDS,
+    PROCESS_KINDS,
     REPLICA_KINDS,
     TRANSPORT_KINDS,
     Fault,
     FaultInjector,
     FaultPlan,
     InjectedFault,
+    InjectedManagerCrash,
     InjectedTransportFault,
 )
 from .health import HealthConfig, HealthMonitor, HealthState, ReplicaHealth
+from .journal import (
+    JournalEntry,
+    JournalState,
+    RequestJournal,
+    replay_journal,
+)
 from .manager import ClusterManager, ClusterRequest
 from .migration import migrate_request
 from .remote import HeartbeatGap, RemoteReplica
@@ -100,10 +108,16 @@ __all__ = [
     "FaultPlan",
     "FaultInjector",
     "InjectedFault",
+    "InjectedManagerCrash",
     "InjectedTransportFault",
     "KINDS",
     "REPLICA_KINDS",
     "TRANSPORT_KINDS",
+    "PROCESS_KINDS",
+    "RequestJournal",
+    "JournalEntry",
+    "JournalState",
+    "replay_journal",
     "TransportError",
     "FrameError",
     "ConnectionLost",

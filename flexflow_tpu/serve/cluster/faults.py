@@ -67,10 +67,22 @@ kind           effect (during steps ``[step, step+count)``)
                backoff)
 =============  ==========================================================
 
+**Process kinds** (PR 14) exercise REAL process death rather than
+surface-level raises: ``sigkill`` sends SIGKILL to the registered
+subprocess replica server pid at the replica's client-side step
+(socket clusters only; ``FaultInjector.register_process`` wires the
+pid) — the transport then fails against a genuinely dead peer — and
+``manager_crash`` raises :class:`InjectedManagerCrash` out of
+``ClusterManager.step`` at a scripted CLUSTER step, exactly once, so
+tests/bench drop the manager there and recover it from the durable
+journal (``ClusterManager.recover``) the way an operator would restart
+a SIGKILL'd control plane.
+
 ``FaultPlan.random(seed, n_replicas)`` draws a reproducible plan for
-chaos tests (replica kinds by default; pass ``kinds=TRANSPORT_KINDS``
-or a mix for wire chaos); ``from_json``/``to_json`` round-trip plans
-for the CLI's ``--fault-plan`` flag and for bench scripts.
+chaos tests (replica kinds by default; ``include_transport=True`` /
+``include_process=True`` widen the pool, or pass ``kinds`` explicitly);
+``from_json``/``to_json`` round-trip plans for the CLI's
+``--fault-plan`` flag and for bench scripts.
 """
 from __future__ import annotations
 
@@ -86,11 +98,26 @@ from .transport import TransportError
 REPLICA_KINDS = ("crash", "transient", "latency", "migration", "oom")
 #: faults injected at the RPC transport (PR 12, remote replicas only)
 TRANSPORT_KINDS = ("drop", "delay", "disconnect", "partition")
-KINDS = REPLICA_KINDS + TRANSPORT_KINDS
+#: PROCESS-level faults (PR 14): real process death, not surface-level
+#: raises — "sigkill" SIGKILLs a registered subprocess replica server
+#: at the replica's client-side step (socket clusters only; the RPC
+#: layer then sees a REAL dead peer), "manager_crash" raises
+#: :class:`InjectedManagerCrash` at a scripted CLUSTER step so the
+#: caller can drop the manager and exercise journal recovery
+#: (``ClusterManager.recover``) where a real SIGKILL would restart
+#: the process.
+PROCESS_KINDS = ("sigkill", "manager_crash")
+KINDS = REPLICA_KINDS + TRANSPORT_KINDS + PROCESS_KINDS
 
 
 class InjectedFault(RuntimeError):
     """An injected replica failure (crash/transient step exception)."""
+
+
+class InjectedManagerCrash(InjectedFault):
+    """The scripted manager death ("manager_crash"): raised out of
+    ``ClusterManager.step`` at the scripted cluster step, exactly once
+    — the harness's stand-in for kill -9 on the control plane."""
 
 
 class InjectedMigrationFault(InjectedFault):
@@ -170,12 +197,22 @@ class FaultPlan:
         horizon: int = 120,
         n_faults: Optional[int] = None,
         kinds: Sequence[str] = REPLICA_KINDS,
+        include_transport: bool = False,
+        include_process: bool = False,
     ) -> "FaultPlan":
         """A reproducible random plan: same seed → same plan, always
         (stdlib ``random.Random`` — no global RNG state touched).
-        Defaults to the replica kinds — the PR-9 contract; pass
-        ``kinds=TRANSPORT_KINDS`` (or a mix) to script wire chaos
-        against remote replicas."""
+        Defaults to the replica kinds — the PR-9 contract;
+        ``include_transport=True`` adds the wire kinds (remote replicas
+        only) and ``include_process=True`` adds the process kinds
+        (sigkill needs a socket cluster + registered pids;
+        manager_crash needs a recovery-capable driver) — or pass
+        ``kinds`` explicitly for full control."""
+        kinds = tuple(kinds)
+        if include_transport:
+            kinds += tuple(k for k in TRANSPORT_KINDS if k not in kinds)
+        if include_process:
+            kinds += tuple(k for k in PROCESS_KINDS if k not in kinds)
         rng = random.Random(seed)
         n = n_faults if n_faults is not None else rng.randint(1, 3)
         faults = []
@@ -211,7 +248,19 @@ class FaultInjector:
         }
         # replica index -> (release_at_step, [held pages], pager)
         self._held: Dict[int, Tuple[int, List[int], object]] = {}
+        # PROCESS kinds: registered subprocess pids ("sigkill" targets)
+        # + once-only firing state (a killed process stays killed; a
+        # recovered manager must not immediately re-crash)
+        self._pids: Dict[int, int] = {}
+        self._sigkilled: set = set()
+        self._mgr_fired: set = set()
         self._log = get_logger("serve")
+
+    def register_process(self, replica_index: int, pid: int) -> None:
+        """Register the OS pid serving ``replica_index`` so a scripted
+        "sigkill" fault can kill the REAL process (socket clusters;
+        the harness that spawned the server knows the pid)."""
+        self._pids[int(replica_index)] = int(pid)
 
     # ------------------------------------------------------------------
 
@@ -255,6 +304,51 @@ class FaultInjector:
                 self._fire(fault, sn, seconds=fault.seconds)
             if fault.kind == "oom" and sn == fault.step:
                 self._grab_pages(replica, fault)
+            if (
+                fault.kind == "sigkill"
+                and sn >= fault.step
+                and idx not in self._sigkilled
+            ):
+                import os as _os
+                import signal as _signal
+
+                pid = self._pids.get(idx)
+                if pid is None:
+                    raise RuntimeError(
+                        f"sigkill fault for replica {idx} but no pid "
+                        "was registered — call FaultInjector."
+                        "register_process(index, pid) with the spawned "
+                        "server's pid"
+                    )
+                self._sigkilled.add(idx)
+                self._fire(fault, sn, pid=pid)
+                self._log.warning(
+                    "fault harness: SIGKILL pid %d (replica %d server)",
+                    pid, idx,
+                )
+                _os.kill(pid, _signal.SIGKILL)
+                # the step proceeds into its RPC against a genuinely
+                # dead peer — deadlines/retries/health see REAL process
+                # death, not a surface-level raise
+
+    def on_cluster_step(self, manager) -> None:
+        """Consulted at the top of ``ClusterManager.step``: a scripted
+        "manager_crash" raises :class:`InjectedManagerCrash` exactly
+        once at (or after) its cluster step — the caller abandons the
+        manager and recovers from the journal."""
+        sn = manager._step_counter
+        for i, fault in enumerate(self.plan):
+            if (
+                fault.kind != "manager_crash"
+                or sn < fault.step
+                or i in self._mgr_fired
+            ):
+                continue
+            self._mgr_fired.add(i)
+            self._fire(fault, sn)
+            raise InjectedManagerCrash(
+                f"injected manager crash (cluster step {sn})"
+            )
 
     def on_rpc(self, replica_index: int, step_no: int, method: str,
                attempt: int) -> float:

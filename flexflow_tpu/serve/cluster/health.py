@@ -246,6 +246,20 @@ class HealthMonitor:
     def __len__(self) -> int:
         return len(self.replicas)
 
+    def add(self) -> ReplicaHealth:
+        """A fresh HEALTHY record for a replica joining the cluster
+        (live scale_out — serve/cluster/reconfigure.py)."""
+        h = ReplicaHealth(len(self.replicas), self.cfg)
+        self.replicas.append(h)
+        return h
+
+    def remove(self, pos: int) -> None:
+        """Drop the record at ``pos`` (a retired replica leaves the
+        membership) and re-index the survivors to their new positions."""
+        del self.replicas[pos]
+        for i, h in enumerate(self.replicas):
+            h.index = i
+
     def routable(self, pos: int) -> bool:
         return self.replicas[pos].routable
 

@@ -1,0 +1,341 @@
+"""Durable request journal — the crash-recovery substrate of the
+cluster control plane.
+
+PRs 9/12/13 made every *replica* expendable; this module makes the
+ClusterManager itself expendable. It is an append-only, CRC-framed
+record log (the PR-12 binary codec carries the payloads — no pickle,
+no JSON) of everything the manager has PROMISED and everything it has
+already DELIVERED:
+
+* ``submit``   — one record per accepted request: prompt tokens,
+  GenerationConfig, session id, cluster/trace id. Written (and
+  flushed) before the request is placed, so a submission that returned
+  a cluster id is never lost to a manager crash.
+* ``tokens``   — flushed-token DELTAS, batched at the drive loop's
+  existing flush sync point (one buffered write + one file flush per
+  cluster step — never a per-token write, never a hot-path fsync).
+  The journal only ever holds FLUSHED host truth, which is exactly
+  what the recompute re-admission path replays.
+* ``terminal`` — the request reached COMPLETED/ERROR (``error`` set
+  for sheds/failures); recovery rehydrates these so ``result`` still
+  answers for them after a restart.
+* ``members``  — the CURRENT cluster membership snapshot (index /
+  role / endpoint per replica), rewritten by every committed
+  reconfiguration (scale_out / scale_in / set_pools), so a recovered
+  manager rebuilds the membership the crash interrupted, not the one
+  the config started with.
+* ``reconfig`` — begin/commit markers around each reconfiguration (a
+  begin without a commit recovers as "the op never happened": every
+  mutation is applied in memory only between the two records and the
+  commit carries the resulting members snapshot).
+
+**Frame format**: ``MAGIC(2="FJ") | LENGTH(4, big-endian) |
+CRC32(4, of the payload) | PAYLOAD`` where PAYLOAD is one codec value
+(:func:`~.transport.encode_value`). A torn tail — a partial header, a
+short payload, or a CRC mismatch from a crash mid-write — recovers by
+TRUNCATION at the last whole record, never by corruption propagating
+into replay (:func:`replay_journal` rewrites the file to the good
+prefix before returning).
+
+**Compaction**: terminal records retire their entries; once
+``compact_threshold`` finished requests accumulate, :meth:`compact`
+rewrites the log to the live set (members snapshot + one submit +
+tokens record per unfinished request) through a temp file and an
+atomic ``os.replace`` — the journal's size tracks in-flight work, not
+run length.
+
+Durability scope: ``flush`` pushes buffered frames into the OS page
+cache (``file.flush``) — what survives a killed PROCESS, which is the
+failure this PR recovers from (the tested contract: SIGKILL the
+manager, restart from the journal, bitwise outputs). ``fsync=True``
+additionally survives a host power loss at the price of a disk sync
+per flush point; off by default and NOT part of the hot-path budget.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional
+
+from ...logging_utils import get_logger
+from ..batch_config import GenerationConfig
+from .transport import FrameError, decode_value, encode_value
+
+MAGIC = b"FJ"
+_HEADER = struct.Struct("!2sII")  # magic, payload length, payload crc32
+#: one journal record's payload cap — a corrupt length prefix must not
+#: make replay try to allocate gigabytes (prompts + flushed deltas are
+#: small; the members snapshot is a few hundred bytes).
+MAX_RECORD_BYTES = 1 << 26
+
+_log = get_logger("serve")
+
+
+def encode_record(record: Dict[str, Any]) -> bytes:
+    """One record dict → one CRC-framed journal frame."""
+    body = bytearray()
+    encode_value(record, body)
+    if len(body) > MAX_RECORD_BYTES:
+        raise FrameError(
+            f"journal record {len(body)} bytes exceeds MAX_RECORD_BYTES"
+        )
+    return _HEADER.pack(MAGIC, len(body), zlib.crc32(body)) + bytes(body)
+
+
+class RequestJournal:
+    """Append side of the log (see module docstring). ``stats`` is a
+    ClusterStats or a zero-arg callable returning one (the
+    callable-stats pattern) — record/byte/compaction counters land
+    there so the bench can price journal overhead per request."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        compact_threshold: int = 256,
+        fsync: bool = False,
+        stats=None,
+    ):
+        self.path = path
+        self.compact_threshold = int(compact_threshold)
+        self.fsync = bool(fsync)
+        self._stats_src = stats
+        self._buf = bytearray()
+        self._finished_since_compact = 0
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "ab")
+
+    @property
+    def stats(self):
+        return (
+            self._stats_src() if callable(self._stats_src)
+            else self._stats_src
+        )
+
+    # ------------------------------------------------------------------
+    # append side
+
+    def append(self, record: Dict[str, Any]) -> None:
+        """Buffer one record (framed + CRC'd). Nothing touches the file
+        until :meth:`flush` — token deltas batch at the drive loop's
+        flush sync point."""
+        frame = encode_record(record)
+        self._buf += frame
+        st = self.stats
+        if st is not None:
+            st.journal_records += 1
+            st.journal_bytes += len(frame)
+
+    def flush(self) -> None:
+        """Write buffered frames and push them to the OS (one
+        ``file.flush`` per call — the per-cluster-step durability
+        boundary; ``fsync=True`` additionally syncs the disk)."""
+        if not self._buf:
+            return
+        self._f.write(self._buf)
+        self._buf = bytearray()
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+
+    def append_now(self, record: Dict[str, Any]) -> None:
+        """Append + flush in one call — submissions, terminals and
+        reconfiguration records are durable the moment they return."""
+        self.append(record)
+        self.flush()
+
+    def note_finished(self) -> None:
+        self._finished_since_compact += 1
+
+    def should_compact(self) -> bool:
+        return self._finished_since_compact >= self.compact_threshold
+
+    def compact(self, live_records: List[Dict[str, Any]]) -> None:
+        """Rewrite the log to ``live_records`` (a members snapshot plus
+        one submit + tokens record per unfinished request, built by the
+        manager) through a temp file + atomic replace. Finished
+        entries retire here — the log's size tracks in-flight work."""
+        self.flush()
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            for rec in live_records:
+                f.write(encode_record(rec))
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self._finished_since_compact = 0
+        st = self.stats
+        if st is not None:
+            st.journal_compactions += 1
+        _log.debug("journal compacted to %d live records",
+                   len(live_records))
+
+    def close(self) -> None:
+        self.flush()
+        self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# replay side
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """One request's journaled lifecycle: what was promised (prompt +
+    GenerationConfig) and what was already delivered (flushed output
+    tokens), plus its terminal state if it reached one."""
+
+    cid: int
+    tokens: List[int]               # the ORIGINAL prompt
+    prompt_len: int
+    gen: GenerationConfig
+    session: Optional[object] = None
+    prompt_text: str = ""
+    flushed: List[int] = dataclasses.field(default_factory=list)
+    terminal: bool = False
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class JournalState:
+    """What :func:`replay_journal` reconstructs: every journaled
+    request, the last committed membership snapshot (None = the
+    config's static membership), and what the scan observed."""
+
+    entries: Dict[int, JournalEntry] = dataclasses.field(
+        default_factory=dict
+    )
+    members: Optional[List[Dict[str, Any]]] = None
+    records: int = 0
+    truncated_bytes: int = 0
+
+    @property
+    def next_cid(self) -> int:
+        return max(self.entries, default=0) + 1
+
+    def unfinished(self) -> List[JournalEntry]:
+        return [e for e in self.entries.values() if not e.terminal]
+
+
+def _gen_from_record(d: Dict[str, Any]) -> GenerationConfig:
+    d = dict(d)
+    d["stop_token_ids"] = tuple(d.get("stop_token_ids", ()))
+    return GenerationConfig(**d)
+
+
+def _apply(state: JournalState, rec: Dict[str, Any]) -> None:
+    kind = rec.get("type")
+    if kind == "submit":
+        cid = int(rec["cid"])
+        state.entries[cid] = JournalEntry(
+            cid=cid,
+            tokens=[int(t) for t in rec["tokens"]],
+            prompt_len=int(rec["prompt_len"]),
+            gen=_gen_from_record(rec["gen"]),
+            session=rec.get("session"),
+            prompt_text=rec.get("prompt", ""),
+        )
+    elif kind == "tokens":
+        entry = state.entries.get(int(rec["cid"]))
+        if entry is not None:
+            entry.flushed.extend(int(t) for t in rec["toks"])
+    elif kind == "terminal":
+        entry = state.entries.get(int(rec["cid"]))
+        if entry is not None:
+            entry.terminal = True
+            entry.error = rec.get("error")
+    elif kind == "members":
+        state.members = list(rec["members"])
+    # "reconfig" begin/commit markers carry no replayable state of their
+    # own: a commit always writes the members snapshot alongside, and a
+    # begin without a commit means the op never happened — replay
+    # ignores both and keeps the last committed membership.
+
+
+def replay_journal(path: str) -> JournalState:
+    """Scan the journal, apply every whole record, and TRUNCATE the
+    file at the first torn/corrupt frame (a crash mid-write leaves a
+    partial tail; replay recovers the good prefix and the restarted
+    manager appends from there). A missing file replays empty."""
+    state = JournalState()
+    if not os.path.exists(path):
+        return state
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    good = 0
+    why = None
+    while pos < len(data):
+        if pos + _HEADER.size > len(data):
+            why = "partial header"
+            break
+        magic, length, crc = _HEADER.unpack_from(data, pos)
+        if magic != MAGIC:
+            why = f"bad magic {magic!r}"
+            break
+        if length > MAX_RECORD_BYTES:
+            why = f"record length {length} exceeds MAX_RECORD_BYTES"
+            break
+        body = data[pos + _HEADER.size:pos + _HEADER.size + length]
+        if len(body) != length:
+            why = "torn payload"
+            break
+        if zlib.crc32(body) != crc:
+            why = "crc mismatch"
+            break
+        try:
+            rec = decode_value(body)
+        except FrameError as exc:
+            why = f"undecodable payload ({exc})"
+            break
+        _apply(state, rec)
+        state.records += 1
+        pos += _HEADER.size + length
+        good = pos
+    if good < len(data):
+        state.truncated_bytes = len(data) - good
+        _log.warning(
+            "journal %s: torn tail (%s) — truncating %d bytes after "
+            "%d whole records",
+            path, why, state.truncated_bytes, state.records,
+        )
+        with open(path, "r+b") as f:
+            f.truncate(good)
+    return state
+
+
+def live_records(
+    members: Optional[List[Dict[str, Any]]],
+    entries: List[JournalEntry],
+) -> List[Dict[str, Any]]:
+    """The compacted representation of the live state: the membership
+    snapshot (when dynamic) plus one submit + one tokens record per
+    unfinished request — replaying a compacted log is indistinguishable
+    from replaying the full history."""
+    from .server import gen_to_wire  # local import: server pulls heavy deps
+
+    out: List[Dict[str, Any]] = []
+    if members is not None:
+        out.append({"type": "members", "members": list(members)})
+    for e in entries:
+        out.append({
+            "type": "submit",
+            "cid": e.cid,
+            "tokens": list(e.tokens),
+            "prompt_len": e.prompt_len,
+            "gen": gen_to_wire(e.gen),
+            "session": e.session,
+            "prompt": e.prompt_text,
+        })
+        if e.flushed:
+            out.append({
+                "type": "tokens", "cid": e.cid, "toks": list(e.flushed),
+            })
+    return out

@@ -56,6 +56,7 @@ bitwise in tests/test_cluster.py).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Union
 
@@ -71,11 +72,72 @@ from ..batch_config import (
 from ..engine import ServingConfig
 from ..request_manager import TERMINAL_STATUSES, RequestStatus
 from .health import HealthConfig, HealthMonitor, HealthState, ReplicaHealth
+from .journal import RequestJournal, replay_journal
 from .migration import migrate_request
+from .reconfigure import (
+    begin_scale_in as _begin_scale_in,
+    maybe_retire as _maybe_retire,
+    scale_in as _scale_in,
+    scale_out as _scale_out,
+    set_pools as _set_pools,
+)
 from .remote import HeartbeatGap, RemoteReplica
 from .replica import Replica
 from .router import Router
 from .transport import LoopbackTransport, SocketTransport
+
+
+def _wire_session(session_id: Optional[object]):
+    """Session ids ride the journal as codec-safe primitives; anything
+    richer journals as its string form (affinity pins do not survive a
+    restart anyway — the journaled id only re-keys future turns)."""
+    if session_id is None or isinstance(session_id, (int, str, float, bool)):
+        return session_id
+    return str(session_id)
+
+
+def _build_member(serving, ctx, index: int, role: str,
+                  endpoint: Optional[str] = None):
+    """One replica (or standby) behind the configured transport —
+    shared by :meth:`ClusterManager.build`, :meth:`ClusterManager.
+    recover` and ``reconfigure.scale_out``. "loopback" wraps the SAME
+    in-process build in a RemoteReplica whose every call round-trips
+    the wire codec against a ReplicaServerCore; "socket" dials a
+    subprocess replica server (``endpoint``, falling back to the
+    config's positional entry) instead of building anything locally."""
+    if serving.replica_transport == "socket":
+        ep = endpoint
+        if ep is None:
+            if index >= len(serving.replica_endpoints):
+                raise ValueError(
+                    f"no endpoint for socket replica {index} — pass "
+                    "scale_out(endpoint=...) or extend replica_endpoints"
+                )
+            ep = serving.replica_endpoints[index]
+        host, _, port = ep.rpartition(":")
+        return RemoteReplica(
+            index, SocketTransport(host or "127.0.0.1", int(port)),
+            serving, role=role,
+        )
+    devs = ctx["devices"]
+    local = Replica.build(
+        index, ctx["model"], ctx["cfg"], ctx["params"], serving,
+        role=role,
+        devices=[devs[index % len(devs)]],
+        tokenizer=ctx["tokenizer"],
+        eos_token_id=ctx["eos_token_id"],
+        seed=ctx["seed"],
+        ssms=ctx["ssms"],
+        spec=ctx["spec"],
+    )
+    if serving.replica_transport == "inproc":
+        return local
+    from .server import ReplicaServerCore
+
+    return RemoteReplica(
+        index, LoopbackTransport(ReplicaServerCore(local).dispatch),
+        serving, role=role, local=local,
+    )
 
 
 @dataclasses.dataclass
@@ -95,6 +157,10 @@ class ClusterRequest:
     rid: Optional[int] = None           # replica-local request id
     phase: str = "single"               # "single" | "prefill" | "decode"
     error: Optional[str] = None         # terminal failure (shed/failover)
+    # terminal-success WITHOUT a live home: set when the request's home
+    # retired (scale_in) or when a recovered manager rehydrated its
+    # journaled terminal record — ``_known`` holds the full transcript
+    finished: bool = False
     profile: ProfileInfo = dataclasses.field(default_factory=ProfileInfo)
     # ORIGINAL prompt length (the output-token baseline): a failover
     # re-admission's home sees prompt+generated as its prompt, so the
@@ -115,9 +181,14 @@ class ClusterRequest:
         """RequestStatus-shaped view (c_backend drives clusters through
         the same loop it drives a bare RequestManager with)."""
         if self.rid is None:
-            # shed / failed = terminal; between homes (failover pending)
+            # shed / failed = terminal; retired-home / recovered
+            # completions = COMPLETED; between homes (failover pending)
             # = PENDING, so nothing treats an in-flight recovery as done
-            return RequestStatus.ERROR if self.error else RequestStatus.PENDING
+            if self.error:
+                return RequestStatus.ERROR
+            if self.finished:
+                return RequestStatus.COMPLETED
+            return RequestStatus.PENDING
         home = self._manager.replicas[self.replica].rm
         st = home.requests[self.rid].status
         if self.phase == "prefill" and st in TERMINAL_STATUSES:
@@ -194,12 +265,19 @@ class ClusterManager:
         self.disaggregated = bool(self.prefill_pool)
         if self.disaggregated and not self.decode_pool:
             raise ValueError("prefill pool without a decode pool")
+        # Live reconfiguration (serve/cluster/reconfigure.py): replica
+        # INDICES currently draining toward retirement — excluded from
+        # every placement exactly like DOWN replicas, but still stepped
+        # (their in-flight work finishes or migrates; maybe_retire
+        # removes them once idle). Keyed by index, not position, so
+        # membership surgery never invalidates the set.
+        self._draining: Set[int] = set()
         routing = self.prefill_pool if self.disaggregated else self.replicas
         # router positions index the ROUTING pool; map back to cluster
         # positions so ClusterRequest.replica is always cluster-wide
         self._routing_pos = [self.replicas.index(r) for r in routing]
         health_cb = (
-            lambda pos: self.health[self._routing_pos[pos]].routable
+            lambda pos: self._routable_pos(self._routing_pos[pos])
         )
         self.router = router or Router(
             routing,
@@ -228,6 +306,24 @@ class ClusterManager:
         # obs.attach_observability wires live ones in.
         self.tracer = NULL_TRACER
         self.flight_recorder = None
+        # events recorded before a tracer could attach (recovery runs
+        # before obs wiring) — flushed on the first traced step
+        self._pending_trace: List[tuple] = []
+        # Elastic control plane (journal.py + reconfigure.py): the
+        # durable request journal (opened by build/recover — see
+        # _open_journal), per-request flushed-token high-water marks,
+        # terminal records already written, the replica factory context
+        # scale_out/recover rebuild members from, and the index→endpoint
+        # map the members snapshot journals for socket clusters.
+        self.journal: Optional[RequestJournal] = None
+        self._journal_flushed: Dict[int, int] = {}
+        self._journal_done: Set[int] = set()
+        self._build_ctx: Optional[Dict[str, Any]] = None
+        self._endpoints: Dict[int, str] = {}
+        self._next_replica_index = 1 + max(
+            (r.index for r in list(self.replicas) + self.standbys),
+            default=-1,
+        )
 
     # ------------------------------------------------------------------
     # construction
@@ -275,49 +371,348 @@ class ClusterManager:
                 ["prefill"] * serving.prefill_replicas
                 + ["decode"] * serving.decode_replicas
             )
-        roles += ["mixed"] * serving.standby_replicas
-
-        def make(i):
-            """One replica (or standby) behind the configured
-            transport. "loopback" wraps the SAME in-process build in a
-            RemoteReplica whose every call round-trips the wire codec
-            against a ReplicaServerCore; "socket" dials a subprocess
-            replica server instead of building anything locally."""
-            if serving.replica_transport == "socket":
-                host, _, port = serving.replica_endpoints[i].rpartition(":")
-                return RemoteReplica(
-                    i, SocketTransport(host or "127.0.0.1", int(port)),
-                    serving, role=roles[i],
-                )
-            local = Replica.build(
-                i, model, cfg, params, serving,
-                role=roles[i],
-                devices=[devs[i % len(devs)]],
-                tokenizer=tokenizer,
-                eos_token_id=eos_token_id,
-                seed=seed,
-                ssms=ssms,
-                spec=spec,
-            )
-            if serving.replica_transport == "inproc":
-                return local
-            from .server import ReplicaServerCore
-
-            return RemoteReplica(
-                i, LoopbackTransport(ReplicaServerCore(local).dispatch),
-                serving, role=roles[i], local=local,
-            )
-
-        replicas = [make(i) for i in range(serving.replicas)]
+        ctx = dict(
+            model=model, cfg=cfg, params=params, devices=devs,
+            tokenizer=tokenizer, eos_token_id=eos_token_id, seed=seed,
+            ssms=ssms, spec=spec,
+        )
+        replicas = [
+            _build_member(serving, ctx, i, roles[i])
+            for i in range(serving.replicas)
+        ]
         standbys = [
-            make(serving.replicas + j)
+            _build_member(serving, ctx, serving.replicas + j, "mixed")
             for j in range(serving.standby_replicas)
         ]
-        return cls(
+        cm = cls(
             replicas, serving, tokenizer=tokenizer,
             eos_token_id=eos_token_id, health_config=health_config,
             standbys=standbys,
         )
+        cm._build_ctx = ctx
+        if serving.replica_transport == "socket":
+            cm._endpoints = {
+                i: serving.replica_endpoints[i]
+                for i in range(serving.replicas)
+            }
+        # build() starts a FRESH log (use recover() to resume one): a
+        # stale journal replaying into a new cluster would resurrect a
+        # previous run's requests
+        cm._open_journal(resume=False)
+        return cm
+
+    @classmethod
+    def recover(
+        cls,
+        model: Any,
+        cfg: Any,
+        params: Any,
+        serving: Optional[ServingConfig] = None,
+        *,
+        tokenizer: Any = None,
+        eos_token_id: Optional[int] = None,
+        seed: int = 0,
+        devices: Optional[Sequence[Any]] = None,
+        health_config: Optional[HealthConfig] = None,
+        ssms: Sequence[Any] = (),
+        spec: Any = None,
+    ) -> "ClusterManager":
+        """Rebuild a crashed manager from ``serving.journal_dir``.
+
+        The journal replays first (a torn tail truncates — never
+        corrupts), yielding the last COMMITTED membership (scale_out /
+        scale_in / set_pools survive the crash; an uncommitted begin
+        recovers as "never happened") and every journaled request with
+        its flushed-token prefix. Replicas rebuild per that membership:
+        still-running subprocess servers are RECONNECTED — a heartbeat
+        rebuilds the client mirror from its envelope, then ``abandon``
+        clears the orphaned scheduler state (the PR-12 seq cache keeps
+        the replayed RPCs at-most-once; the server's prefix tree
+        survives, so it rejoins WARM) — while in-process/loopback
+        replicas, which died with the manager, rebuild fresh. Every
+        unfinished request then re-admits through the PR-9 recompute
+        path with its journaled prompt + flushed prefix, so greedy
+        outputs are BITWISE the uninterrupted run's and already-
+        delivered tokens are regenerated identically, never duplicated
+        (stream-monotone across the restart). Terminal entries
+        rehydrate so ``result`` still answers for them."""
+        serving = serving or ServingConfig()
+        if not serving.journal_dir:
+            raise ValueError(
+                "ClusterManager.recover needs ServingConfig.journal_dir "
+                "(there is no journal to recover from)"
+            )
+        serving.validate_cluster(
+            specinfer=bool(ssms)
+            or getattr(spec, "draft", "ssm") == "early_exit"
+        )
+        state = replay_journal(cls._journal_path(serving))
+        import jax
+
+        devs = list(devices or jax.devices())
+        roles = ["mixed"] * serving.replicas
+        if serving.prefill_replicas:
+            roles = (
+                ["prefill"] * serving.prefill_replicas
+                + ["decode"] * serving.decode_replicas
+            )
+        is_socket = serving.replica_transport == "socket"
+        members = state.members or [
+            {"index": i, "role": roles[i],
+             "endpoint": (serving.replica_endpoints[i] if is_socket
+                          else "")}
+            for i in range(serving.replicas)
+        ]
+        # standby endpoints stay config-positional (the tail entries);
+        # the MEMBER endpoints come from the journaled snapshot, which
+        # survives scale_out/scale_in having changed them
+        standby_eps = (
+            serving.replica_endpoints[len(serving.replica_endpoints)
+                                      - serving.standby_replicas:]
+            if is_socket and serving.standby_replicas else ()
+        )
+        n_prefill = sum(1 for m in members if m["role"] == "prefill")
+        n_decode = sum(1 for m in members if m["role"] == "decode")
+        serving = dataclasses.replace(
+            serving,
+            replicas=len(members),
+            prefill_replicas=n_prefill,
+            decode_replicas=n_decode,
+            replica_endpoints=(
+                tuple(str(m.get("endpoint", "")) for m in members)
+                + tuple(standby_eps)
+            ) if is_socket else serving.replica_endpoints,
+        )
+        ctx = dict(
+            model=model, cfg=cfg, params=params, devices=devs,
+            tokenizer=tokenizer, eos_token_id=eos_token_id, seed=seed,
+            ssms=ssms, spec=spec,
+        )
+        replicas = [
+            _build_member(serving, ctx, int(m["index"]), str(m["role"]),
+                          str(m.get("endpoint") or "") or None)
+            for m in members
+        ]
+        max_idx = max((int(m["index"]) for m in members), default=-1)
+        standbys = [
+            _build_member(serving, ctx, max_idx + 1 + j, "mixed",
+                          standby_eps[j] if standby_eps else None)
+            for j in range(serving.standby_replicas)
+        ]
+        cm = cls(
+            replicas, serving, tokenizer=tokenizer,
+            eos_token_id=eos_token_id, health_config=health_config,
+            standbys=standbys,
+        )
+        cm._build_ctx = ctx
+        cm._endpoints = {
+            int(m["index"]): str(m.get("endpoint", ""))
+            for m in members if m.get("endpoint")
+        }
+        cm._next_replica_index = max_idx + 1 + serving.standby_replicas
+        # reconnect still-running subprocess servers (see docstring);
+        # loopback/inproc replicas were just rebuilt and need neither
+        for rep in cm.replicas:
+            if getattr(rep, "is_remote", False) and rep.local is None:
+                if rep.heartbeat():
+                    rep.abandon()
+        # rehydrate the journaled requests
+        cm._next_cid = state.next_cid
+        replayed = 0
+        now = time.perf_counter()
+        for e in state.entries.values():
+            cr = ClusterRequest(
+                cluster_id=e.cid, tokens=list(e.tokens),
+                prompt_text=e.prompt_text, gen=e.gen,
+                session_id=e.session, prompt_len=e.prompt_len,
+                _manager=cm,
+            )
+            cr._known = list(e.tokens) + list(e.flushed)
+            cm.requests[e.cid] = cr
+            cm._journal_flushed[e.cid] = len(e.flushed)
+            if e.terminal:
+                cr.error = e.error
+                cr.finished = e.error is None
+                cm._journal_done.add(e.cid)
+            else:
+                # recompute re-admission with the journaled prompt +
+                # flushed prefix: retries=1 marks it a re-admission, so
+                # _place keeps the ORIGINAL prompt_len boundary and the
+                # carried profile (fresh clock — recovery restarts it)
+                cr.profile.start_time = now
+                cr.retries = 1
+                cm._failovers.append(e.cid)
+                replayed += 1
+        cm.stats.submitted += len(state.entries)
+        cm.stats.manager_recoveries += 1
+        cm.stats.journal_replayed += replayed
+        cm._pending_trace.append(("recover", dict(
+            replicas=len(members), replayed=replayed,
+            records=state.records,
+        )))
+        cm._pending_trace.append(("replay", dict(
+            requests=len(state.entries), records=state.records,
+            truncated_bytes=state.truncated_bytes,
+        )))
+        # resume the SAME log, compacted to the recovered state (the
+        # full history was just replayed — rewriting it keeps replay
+        # idempotent and the file bounded)
+        cm._open_journal(resume=True)
+        cm._journal_checkpoint(include_finished=True)
+        cm._log.warning(
+            "manager recovered from %s: %d replicas, %d requests "
+            "rehydrated (%d re-admitted, %d already terminal)%s",
+            cls._journal_path(serving), len(members), len(state.entries),
+            replayed, len(state.entries) - replayed,
+            f", {state.truncated_bytes}B torn tail truncated"
+            if state.truncated_bytes else "",
+        )
+        return cm
+
+    # ------------------------------------------------------------------
+    # durable request journal (serve/cluster/journal.py)
+
+    @staticmethod
+    def _journal_path(serving: ServingConfig) -> str:
+        return os.path.join(serving.journal_dir, "requests.journal")
+
+    def _open_journal(self, resume: bool) -> None:
+        if not self.serving.journal_dir:
+            return
+        path = self._journal_path(self.serving)
+        if not resume and os.path.exists(path):
+            self._log.warning(
+                "journal %s exists — build() starts a FRESH log over "
+                "it (use ClusterManager.recover to resume a crashed "
+                "manager's journal)", path,
+            )
+            os.remove(path)
+        self.journal = RequestJournal(path, stats=lambda: self.stats)
+
+    def _journal_sync(self) -> None:
+        """Batch-write flushed-token deltas + newly terminal records —
+        called at the drive loop's flush sync points (end of step/
+        drain/submit): one buffered write + one file flush, never a
+        per-token write and never a device sync."""
+        j = self.journal
+        if j is None:
+            return
+        for cid, cr in self.requests.items():
+            if cid in self._journal_done:
+                continue
+            out = cr.output_tokens
+            sent = self._journal_flushed.get(cid, 0)
+            if len(out) > sent:
+                j.append({
+                    "type": "tokens", "cid": cid,
+                    "toks": [int(t) for t in out[sent:]],
+                })
+                self._journal_flushed[cid] = len(out)
+            if cr.status in TERMINAL_STATUSES:
+                err = cr.error
+                if err is None and cr.rid is not None:
+                    err = self.replicas[cr.replica].rm.requests[
+                        cr.rid].error
+                j.append({"type": "terminal", "cid": cid, "error": err})
+                self._journal_done.add(cid)
+                j.note_finished()
+        j.flush()
+        if j.should_compact():
+            self._journal_checkpoint(include_finished=False)
+
+    def _journal_checkpoint(self, include_finished: bool) -> None:
+        """Rewrite the journal to the current live state (compaction —
+        finished entries retire unless ``include_finished``, which the
+        recovery checkpoint uses so results survive one more restart)."""
+        j = self.journal
+        if j is None:
+            return
+        from .server import gen_to_wire
+
+        recs: List[Dict[str, Any]] = [
+            {"type": "members", "members": self.members_snapshot()}
+        ]
+        for cid in sorted(self.requests):
+            cr = self.requests[cid]
+            done = cid in self._journal_done
+            if done and not include_finished:
+                continue
+            out = cr.output_tokens
+            recs.append({
+                "type": "submit", "cid": cid,
+                "tokens": [int(t) for t in cr.tokens[:cr.prompt_len]],
+                "prompt_len": int(cr.prompt_len),
+                "gen": gen_to_wire(cr.gen),
+                "session": _wire_session(cr.session_id),
+                "prompt": cr.prompt_text,
+            })
+            if out:
+                recs.append({
+                    "type": "tokens", "cid": cid,
+                    "toks": [int(t) for t in out],
+                })
+                self._journal_flushed[cid] = len(out)
+            if done:
+                err = cr.error
+                recs.append({"type": "terminal", "cid": cid, "error": err})
+        j.compact(recs)
+
+    def _make_member(self, index: int, role: str,
+                     endpoint: Optional[str] = None):
+        """Build (or dial) one more replica through the same factory
+        construction used — scale_out's replica source."""
+        if self._build_ctx is None:
+            raise RuntimeError(
+                "this cluster was constructed from prebuilt replicas "
+                "(no build context) — pass scale_out(replica=...) a "
+                "prebuilt one"
+            )
+        return _build_member(self.serving, self._build_ctx, index, role,
+                             endpoint)
+
+    def members_snapshot(self) -> List[Dict[str, Any]]:
+        """The journaled membership: index/role/endpoint per replica —
+        what :meth:`recover` rebuilds after reconfigurations moved the
+        cluster away from the config's static shape."""
+        return [
+            {"index": r.index, "role": r.role,
+             "endpoint": self._endpoints.get(r.index, "")}
+            for r in self.replicas
+        ]
+
+    def close(self) -> None:
+        """Flush + close the journal and every remote transport (the
+        orderly shutdown; crash recovery never needs it)."""
+        if self.journal is not None:
+            self._journal_sync()
+            self.journal.close()
+        for rep in list(self.replicas) + self.standbys + self._retired:
+            close_fn = getattr(rep, "close", None)
+            if close_fn is not None:
+                close_fn()
+
+    # ------------------------------------------------------------------
+    # live reconfiguration (serve/cluster/reconfigure.py)
+
+    def scale_out(self, **kw) -> int:
+        """Grow the cluster by one replica (warm by default) — see
+        :func:`~.reconfigure.scale_out`."""
+        return _scale_out(self, **kw)
+
+    def begin_scale_in(self, pos: int) -> None:
+        """Start draining the replica at ``pos`` (non-blocking) — see
+        :func:`~.reconfigure.begin_scale_in`."""
+        _begin_scale_in(self, pos)
+
+    def scale_in(self, pos: int, **kw) -> None:
+        """Drain + retire the replica at ``pos`` (blocking, bounded) —
+        see :func:`~.reconfigure.scale_in`."""
+        _scale_in(self, pos, **kw)
+
+    def set_pools(self, roles: Dict[int, str]) -> None:
+        """Flip replicas between prefill/decode pools under traffic —
+        see :func:`~.reconfigure.set_pools`."""
+        _set_pools(self, roles)
 
     def attach_faults(self, plan):
         """Wire a :class:`~.faults.FaultPlan` (or a prebuilt injector,
@@ -334,6 +729,15 @@ class ClusterManager:
         injector = plan if isinstance(plan, FaultInjector) else (
             FaultInjector(plan)
         )
+        if any(f.kind == "sigkill" for f in injector.plan) and (
+            self.serving.replica_transport != "socket"
+        ):
+            raise ValueError(
+                "the 'sigkill' fault kind kills a real subprocess "
+                "replica server — it needs replica_transport='socket' "
+                "(and FaultInjector.register_process per target); use "
+                "'crash' to script surface-level death elsewhere"
+            )
         transport_faults = [
             f.kind for f in injector.plan if f.kind in TRANSPORT_KINDS
         ]
@@ -369,8 +773,35 @@ class ClusterManager:
             return list(self.tokenizer.encode(prompt)), prompt
         return [int(t) for t in prompt], ""
 
+    def _routable_pos(self, pos: int) -> bool:
+        """May the router/failover/migration paths place work at this
+        cluster position? DOWN (circuit open) and DRAINING (scale_in in
+        progress) are both excluded — the one router-exclusion flow."""
+        return (
+            self.health[pos].routable
+            and self.replicas[pos].index not in self._draining
+        )
+
     def _routable_rep(self, rep: Replica) -> bool:
-        return self.health[self.replicas.index(rep)].routable
+        return self._routable_pos(self.replicas.index(rep))
+
+    def _drop_sessions(self, pos: int) -> int:
+        """Re-home the sessions pinned to the replica at ``pos`` —
+        the ONE flow both the DOWN path and the drain path use: each
+        session re-pins on its next turn (which also re-seeds, or
+        re-homes, the replica's prefix families on survivors)."""
+        rep = self.replicas[pos]
+        try:
+            rpos = self.router.replicas.index(rep)
+        except ValueError:
+            return 0  # not in the routing pool (e.g. a decode replica)
+        dropped = self.router.drop_replica_sessions(rpos)
+        if dropped:
+            self._log.debug(
+                "replica %d: %d session affinities dropped (re-pin on "
+                "survivors)", rep.index, dropped,
+            )
+        return dropped
 
     def submit(
         self,
@@ -397,6 +828,24 @@ class ClusterManager:
         )
         self.requests[cid] = cr
         self._place(cr, tokens)
+        if self.journal is not None:
+            # durable the moment submit returns: the journaled prompt
+            # (post-placement — prompt_len is the home's authoritative,
+            # possibly truncated, boundary) + GenerationConfig is what a
+            # recovered manager re-promises. One record + one flush per
+            # SUBMISSION, not per step — then the terminal sweep covers
+            # the shed-on-arrival case.
+            from .server import gen_to_wire
+
+            self.journal.append({
+                "type": "submit", "cid": cid,
+                "tokens": [int(t) for t in cr.tokens[:cr.prompt_len]],
+                "prompt_len": int(cr.prompt_len),
+                "gen": gen_to_wire(gen),
+                "session": _wire_session(session_id),
+                "prompt": text,
+            })
+            self._journal_sync()
         return cid
 
     def _place_failed(self, cr: ClusterRequest, how: str) -> bool:
@@ -596,17 +1045,12 @@ class ClusterManager:
             rep.index, exc if exc is not None else
             self.health[pos].last_error,
         )
-        try:
-            rpos = self.router.replicas.index(rep)
-        except ValueError:
-            rpos = None  # decode-pool replica: not in the routing pool
-        if rpos is not None:
-            dropped = self.router.drop_replica_sessions(rpos)
-            if dropped:
-                self._log.debug(
-                    "replica %d: %d session affinities dropped "
-                    "(re-pin on survivors)", rep.index, dropped,
-                )
+        if rep.index in self._draining:
+            # died mid-drain: the DOWN path owns it now (failover +
+            # standby adoption); the scale_in never commits and its
+            # journaled begin recovers as "never happened"
+            self._draining.discard(rep.index)
+        self._drop_sessions(pos)
         victims = [
             cr for cr in self.requests.values()
             if cr.rid is not None and cr.replica == pos
@@ -726,7 +1170,24 @@ class ClusterManager:
             if self._step_counter < cr._retry_at_step:
                 still.append(cid)
                 continue
-            if self._place(cr, cr._known, ignore_slo=True):
+            try:
+                placed = self._place(cr, cr._known, ignore_slo=True)
+            except Exception as exc:
+                # the chosen home refused the submission (e.g. a
+                # recovered manager re-admitting onto a replica whose
+                # server died with the old manager, before the gap
+                # detector trips it) — a health observation + another
+                # bounded retry, never an exception out of the drive
+                # loop
+                pos = cr.replica
+                cr.rid = None
+                cr.replica = None
+                if pos is not None:
+                    self._observe_failure(pos, exc, self._step_counter)
+                self._schedule_failover(cr)
+                progressed = True
+                continue
+            if placed:
                 self.stats.failovers += 1
                 progressed = True
                 tr = self.tracer
@@ -995,6 +1456,19 @@ class ClusterManager:
         nothing is pending recovery."""
         self._step_counter += 1
         step_no = self._step_counter
+        if self.fault_injector is not None:
+            # scripted manager death (FaultPlan "manager_crash"): the
+            # checkpoint-kill raises HERE, before any replica steps —
+            # the test/bench recovers from the journal where a real
+            # SIGKILL would restart the process
+            self.fault_injector.on_cluster_step(self)
+        tr = self.tracer
+        if tr.enabled and self._pending_trace:
+            # recovery ran before a tracer could attach — its
+            # recover/replay events flush on the first traced step
+            for name, kw in self._pending_trace:
+                tr.event(name, **kw)
+            self._pending_trace = []
         self._failed_obs = set()
         progressed = False
         for pos in range(len(self.replicas)):
@@ -1042,11 +1516,15 @@ class ClusterManager:
             self._queue_migrations()
             progressed = self._drain_migration_queue() or progressed
         progressed = self._run_failovers() or progressed
+        progressed = _maybe_retire(self) or progressed
         if self._failovers or self._migration_queue:
             # pending recoveries keep the drive loop alive through their
             # backoff windows — a generate() must never break out and
             # strand a request between homes
             progressed = True
+        # journal sync point: flushed-token deltas + newly terminal
+        # records batch into ONE buffered write + file flush per step
+        self._journal_sync()
         if step_no % 200 == 0:
             self._log.debug(
                 "%s", self.stats.report([r.rm.stats for r in self.replicas])
@@ -1077,6 +1555,8 @@ class ClusterManager:
             self._queue_migrations()
             self._drain_migration_queue()
         self._run_failovers()
+        _maybe_retire(self)
+        self._journal_sync()
 
     # ------------------------------------------------------------------
     # results

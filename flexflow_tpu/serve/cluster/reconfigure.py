@@ -1,0 +1,451 @@
+"""Drain-based live reconfiguration — grow, shrink and re-pool a
+serving cluster under traffic.
+
+ROADMAP item 2b's autoscaler needs a MECHANISM before it can have a
+policy; this module is that mechanism. Three first-class, journaled
+operations over a live :class:`~.manager.ClusterManager`:
+
+* :func:`scale_out` — build (or dial) a new replica, WARM it by
+  shipping a donor's hot prefix subtrees through the PR-12
+  export/import path (best-effort, like standby adoption: an
+  unreachable donor means a cold join), then enter it into routing.
+  The first request it sees can already be a prefix hit.
+* :func:`begin_scale_in` / :func:`maybe_retire` — mark a replica
+  DRAINING: the router immediately stops placing on it (the same
+  health-callback exclusion a DOWN replica gets, without the failover
+  — its requests are fine), its session pins drop through the SAME
+  ``Router.drop_replica_sessions`` flow the DOWN path uses (they
+  re-pin on survivors), and in-flight work finishes where it is (held
+  prefills on a draining prefill replica still hand off through the
+  existing page-migration queue). Once idle, the replica retires: its
+  prefix tree ships to a survivor (so re-pinned sessions land WARM,
+  not cold), ``check_no_leaks`` audits the pool, and it leaves the
+  membership. :func:`scale_in` is the blocking convenience wrapper.
+* :func:`set_pools` — flip replicas between the prefill/decode pools
+  (or from all-mixed into a disaggregated split) under traffic.
+  Placement-only: live requests keep decoding where they are; only
+  future placements see the new pools. Flips that would strand held
+  prefills (dropping disaggregation with migrations still queued) are
+  rejected loudly — drain first.
+
+Every operation journals a ``reconfig`` begin marker, applies its
+mutations in memory, and journals a commit + the resulting membership
+snapshot (``members`` record) — so a manager crash mid-operation
+recovers as "the op never happened" and a crash after the commit
+recovers the NEW membership (:meth:`ClusterManager.recover`).
+
+Nothing here touches a device: reconfiguration is host-side membership
+surgery plus the (already reviewed, FF107-suppressed) tree-export
+harvest — the drive loop's dispatch pipeline never waits on it.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ...logging_utils import get_logger
+from ..request_manager import TERMINAL_STATUSES
+from .health import HealthState
+from .replica import ROLES
+
+_log = get_logger("serve")
+
+
+# ---------------------------------------------------------------------------
+# routing-table surgery shared by every operation
+
+
+def rebuild_routing(cm) -> None:
+    """Recompute the pools + routing table after a membership or role
+    change, preserving session pins whose replica is still in the
+    routing set (pins to removed/re-pooled replicas drop and re-pin on
+    their next turn, exactly like the DOWN path)."""
+    cm.prefill_pool = [r for r in cm.replicas if r.role == "prefill"]
+    cm.decode_pool = [r for r in cm.replicas if r.role == "decode"]
+    cm.disaggregated = bool(cm.prefill_pool)
+    routing = cm.prefill_pool if cm.disaggregated else cm.replicas
+    old = list(cm.router.replicas)
+    old_sessions = dict(cm.router.sessions)
+    cm.router.replicas[:] = routing
+    cm._routing_pos = [cm.replicas.index(r) for r in routing]
+    new_pos = {id(r): i for i, r in enumerate(routing)}
+    cm.router.sessions = {
+        k: new_pos[id(old[v])]
+        for k, v in old_sessions.items()
+        if 0 <= v < len(old) and id(old[v]) in new_pos
+    }
+    if routing:
+        cm.router._rr_next %= len(routing)
+
+
+def _journal_begin(cm, op: str, **detail) -> None:
+    if cm.journal is not None:
+        cm.journal.append_now(
+            {"type": "reconfig", "op": op, "phase": "begin", **detail}
+        )
+
+
+def _journal_commit(cm, op: str, **detail) -> None:
+    if cm.journal is not None:
+        cm.journal.append(
+            {"type": "reconfig", "op": op, "phase": "commit", **detail}
+        )
+        cm.journal.append_now(
+            {"type": "members", "members": cm.members_snapshot()}
+        )
+
+
+# ---------------------------------------------------------------------------
+# scale_out
+
+
+def scale_out(
+    cm,
+    *,
+    role: str = "mixed",
+    endpoint: Optional[str] = None,
+    warm: bool = True,
+    replica=None,
+) -> int:
+    """Add one replica to the live cluster and return its position.
+
+    The replica is built through the same factory :meth:`build` /
+    :meth:`recover` used (in-process / loopback / socket — ``endpoint``
+    names the server for socket transport), or taken prebuilt via
+    ``replica``. With ``warm=True`` the first routable survivor with a
+    non-empty prefix tree donates: its exported subtrees import into
+    the newcomer BEFORE it enters routing, so it joins warm (the
+    warm-standby path, reused). ``role`` must be consistent with the
+    current pool structure (a disaggregated cluster takes
+    prefill/decode, an all-mixed one takes mixed)."""
+    if role not in ROLES:
+        raise ValueError(f"unknown replica role {role!r} "
+                         f"(expected one of {ROLES})")
+    if cm.disaggregated and role == "mixed":
+        raise ValueError(
+            "scale_out(role='mixed') on a disaggregated cluster — pick "
+            "'prefill' or 'decode' (mixed replicas cannot join split "
+            "pools)"
+        )
+    if not cm.disaggregated and role != "mixed":
+        raise ValueError(
+            f"scale_out(role={role!r}) on a non-disaggregated cluster "
+            "— use set_pools to split the pools first"
+        )
+    _journal_begin(cm, "scale_out", role=role, endpoint=endpoint or "")
+    index = cm._next_replica_index
+    if replica is None:
+        rep = cm._make_member(index, role, endpoint)
+    else:
+        rep = replica
+        rep.role = role
+        index = rep.index
+    cm._next_replica_index = max(cm._next_replica_index, index) + 1
+    if getattr(rep, "is_remote", False):
+        rep.bind_stats(lambda: cm.stats)
+    rep.fault_injector = cm.fault_injector
+    blocks = 0
+    if warm:
+        blocks = _warm_join(cm, rep)
+    pos = len(cm.replicas)
+    cm.replicas.append(rep)
+    cm.health.add()
+    if endpoint:
+        cm._endpoints[index] = endpoint
+    rebuild_routing(cm)
+    cm.serving.replicas = len(cm.replicas)
+    if cm.disaggregated:
+        cm.serving.prefill_replicas = len(cm.prefill_pool)
+        cm.serving.decode_replicas = len(cm.decode_pool)
+    cm.stats.scale_outs += 1
+    _journal_commit(cm, "scale_out", index=index, role=role)
+    tr = cm.tracer
+    if tr.enabled:
+        tr.event("scale_out", replica=index, role=role, warm_blocks=blocks)
+    _log.warning(
+        "scale_out: replica %d joined at position %d (%s, %d prefix "
+        "blocks warm, %d replicas now)",
+        index, pos, role, blocks, len(cm.replicas),
+    )
+    return pos
+
+
+def _warm_join(cm, rep) -> int:
+    """Ship a donor's prefix tree into the joining replica (best
+    effort: any failure means a cold join, capacity still grows)."""
+    for pos, donor in enumerate(cm.replicas):
+        if not cm._routable_pos(pos):
+            continue
+        try:
+            entries = donor.export_prefix_tree()
+            if not entries:
+                continue
+            return rep.import_prefix_tree(entries)
+        except Exception as exc:
+            _log.warning(
+                "scale_out warm join: export from replica %d failed "
+                "(%s) — trying the next donor", donor.index, exc,
+            )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# scale_in (drain → retire)
+
+
+def begin_scale_in(cm, pos: int) -> None:
+    """Mark the replica at ``pos`` DRAINING (non-blocking): the router
+    places nothing new on it, its sessions re-pin on survivors, and
+    the drive loop retires it (:func:`maybe_retire`) once its in-flight
+    work finished or migrated."""
+    if not 0 <= pos < len(cm.replicas):
+        raise ValueError(f"scale_in position {pos} out of range "
+                         f"(cluster has {len(cm.replicas)} replicas)")
+    rep = cm.replicas[pos]
+    if rep.index in cm._draining:
+        raise ValueError(f"replica {rep.index} is already draining")
+    survivors = [
+        p for p in range(len(cm.replicas))
+        if p != pos and cm._routable_pos(p)
+    ]
+    if not survivors:
+        raise ValueError(
+            "scale_in would leave no routable replica — grow the "
+            "cluster (or recover the others) first"
+        )
+    if cm.disaggregated:
+        pool = cm.prefill_pool if rep.role == "prefill" else cm.decode_pool
+        rest = [
+            r for r in pool
+            if r is not rep and cm._routable_pos(cm.replicas.index(r))
+        ]
+        if not rest:
+            raise ValueError(
+                f"scale_in of replica {rep.index} would empty the "
+                f"{rep.role} pool — set_pools (or scale_out) first"
+            )
+    _journal_begin(cm, "scale_in", index=rep.index)
+    cm._draining.add(rep.index)
+    # drain and DOWN re-home sessions through the SAME flow — the
+    # draining replica's multi-turn sessions re-pin on survivors (and
+    # land WARM once the retiree's tree ships at retire time)
+    dropped = cm._drop_sessions(pos)
+    tr = cm.tracer
+    if tr.enabled:
+        tr.event("drain_begin", replica=rep.index, sessions_dropped=dropped)
+    _log.warning(
+        "scale_in: replica %d draining (%d sessions re-pin; router "
+        "places nothing new on it)", rep.index, dropped,
+    )
+
+
+def _drain_blockers(cm, pos: int) -> int:
+    """Work still pinning the draining replica at ``pos``: live
+    requests homed there plus queued migrations sourcing from it."""
+    n = 0
+    for cr in cm.requests.values():
+        if (
+            cr.rid is not None and cr.replica == pos
+            and cr.status not in TERMINAL_STATUSES
+        ):
+            n += 1
+    n += sum(1 for cid in cm._migration_queue
+             if cm.requests[cid].replica == pos)
+    return n
+
+
+def maybe_retire(cm) -> bool:
+    """Retire every draining replica whose work has drained (called
+    from the manager's drive loop each cluster step). Returns True when
+    a replica retired this call."""
+    if not cm._draining:
+        return False
+    retired_any = False
+    for pos in range(len(cm.replicas) - 1, -1, -1):
+        rep = cm.replicas[pos]
+        if rep.index not in cm._draining:
+            continue
+        if cm.health[pos].state is HealthState.DOWN:
+            # died mid-drain: the failover/standby path owns it now and
+            # the scale_in never commits (recovery replays the old
+            # membership; the begin marker dangles harmlessly)
+            cm._draining.discard(rep.index)
+            _log.warning(
+                "scale_in: draining replica %d went DOWN — the "
+                "failover path owns it, the drain is void", rep.index,
+            )
+            continue
+        if _drain_blockers(cm, pos) or rep.has_work():
+            continue
+        _retire(cm, pos)
+        retired_any = True
+    return retired_any
+
+
+def _retire(cm, pos: int) -> None:
+    rep = cm.replicas[pos]
+    rep.drain()  # defensive: flush any tail the idle check raced with
+    # re-home the retiree's prefix families on the least-loaded
+    # survivor BEFORE it leaves: the sessions begin_scale_in re-pinned
+    # land warm instead of re-seeding cold (best-effort, like standby
+    # adoption)
+    blocks = 0
+    heirs = [
+        r for p, r in enumerate(cm.replicas)
+        if p != pos and cm._routable_pos(p)
+    ]
+    if heirs:
+        heir = min(heirs, key=lambda r: (r.load(), r.index))
+        try:
+            entries = rep.export_prefix_tree()
+            if entries:
+                blocks = heir.import_prefix_tree(entries)
+        except Exception as exc:
+            _log.warning(
+                "scale_in: prefix-tree hand-off from retiring replica "
+                "%d failed (%s) — survivors re-seed cold",
+                rep.index, exc,
+            )
+    # the retiring pool must audit clean — a drained replica with a
+    # leaked page is a bug, not a tolerable degrade
+    rep.check_no_leaks()
+    assert not rep.rm.hold_finished, (
+        f"retiring replica {rep.index} still holds slots "
+        f"{rep.rm.hold_finished}"
+    )
+    # terminal requests that lived here re-home their RESULTS to the
+    # cluster record (the retired object leaves the manager's reach)
+    for cr in cm.requests.values():
+        if cr.rid is None or cr.replica != pos:
+            continue
+        req = rep.rm.requests[cr.rid]
+        cr._known = list(req.tokens)
+        if cr.error is None:
+            cr.error = req.error
+        cr.finished = cr.error is None
+        cr.rid = None
+        cr.replica = None
+    cm.replicas.pop(pos)
+    cm.health.remove(pos)
+    cm._draining.discard(rep.index)
+    cm._failed_obs.discard(pos)
+    cm._failed_obs = {p - 1 if p > pos else p for p in cm._failed_obs}
+    for cr in cm.requests.values():
+        if cr.replica is not None and cr.replica > pos:
+            cr.replica -= 1
+    rebuild_routing(cm)
+    cm.serving.replicas = len(cm.replicas)
+    if cm.disaggregated:
+        cm.serving.prefill_replicas = len(cm.prefill_pool)
+        cm.serving.decode_replicas = len(cm.decode_pool)
+    cm._endpoints.pop(rep.index, None)
+    cm._retired.append(rep)
+    cm.stats.scale_ins += 1
+    _journal_commit(cm, "scale_in", index=rep.index)
+    tr = cm.tracer
+    if tr.enabled:
+        tr.event("retire", replica=rep.index, warm_blocks=blocks)
+    _log.warning(
+        "scale_in: replica %d retired leak-free (%d prefix blocks "
+        "re-homed; %d replicas remain)",
+        rep.index, blocks, len(cm.replicas),
+    )
+
+
+def scale_in(cm, pos: int, *, max_steps: int = 5000) -> None:
+    """Blocking convenience: :func:`begin_scale_in` then drive the
+    cluster until the replica retires. Bounded — a drain that makes no
+    progress within ``max_steps`` raises instead of hanging (the PR-2
+    never-hang contract extends to operations)."""
+    rep = cm.replicas[pos]
+    begin_scale_in(cm, pos)
+    for _ in range(max_steps):
+        if all(r.index != rep.index for r in cm.replicas):
+            return
+        cm.step()
+    raise RuntimeError(
+        f"scale_in of replica {rep.index} did not drain within "
+        f"{max_steps} cluster steps "
+        f"({_drain_blockers(cm, cm.replicas.index(rep))} blockers left)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# set_pools
+
+
+def set_pools(cm, roles: Dict[int, str]) -> None:
+    """Flip replica pool roles under traffic: ``roles`` maps cluster
+    POSITIONS to their new role. The resulting assignment must be a
+    valid pool structure (all mixed, or a non-empty prefill pool with a
+    non-empty decode pool — the same invariant ``validate_cluster``
+    enforces at construction). Placement-only: live requests finish
+    where they run; only future placements see the new pools."""
+    new_roles = [r.role for r in cm.replicas]
+    for pos, role in roles.items():
+        if not 0 <= int(pos) < len(cm.replicas):
+            raise ValueError(f"set_pools position {pos} out of range")
+        if role not in ROLES:
+            raise ValueError(f"unknown replica role {role!r} "
+                             f"(expected one of {ROLES})")
+        if cm.replicas[int(pos)].index in cm._draining:
+            raise ValueError(
+                f"set_pools on draining replica at position {pos} — "
+                "let the drain finish (or is the drain the point?)"
+            )
+        new_roles[int(pos)] = role
+    n_prefill = sum(1 for r in new_roles if r == "prefill")
+    n_decode = sum(1 for r in new_roles if r == "decode")
+    n_mixed = sum(1 for r in new_roles if r == "mixed")
+    if n_prefill or n_decode:
+        if n_mixed:
+            raise ValueError(
+                "set_pools would mix 'mixed' replicas with split "
+                f"pools ({new_roles}) — assign every replica a pool"
+            )
+        if not (n_prefill and n_decode):
+            raise ValueError(
+                f"set_pools needs BOTH pools non-empty (got "
+                f"{n_prefill} prefill / {n_decode} decode)"
+            )
+        if cm.serving.kv_layout != "paged":
+            raise ValueError(
+                "disaggregated pools need kv_layout='paged' (pages are "
+                "the migration unit)"
+            )
+    else:
+        # dropping disaggregation entirely: held prefills waiting on
+        # the migration queue (or still prefilling toward it) would
+        # strand — the queue only drains while the cluster is
+        # disaggregated
+        pending = cm._migration_queue or any(
+            cr.phase == "prefill" and cr.rid is not None
+            and cr.status not in TERMINAL_STATUSES
+            for cr in cm.requests.values()
+        )
+        if pending:
+            raise ValueError(
+                "set_pools to all-mixed with prefill-phase requests "
+                "still in flight would strand their page hand-offs — "
+                "drain first"
+            )
+    _journal_begin(cm, "set_pools",
+                   roles={int(p): r for p, r in roles.items()})
+    for pos, role in roles.items():
+        cm.replicas[int(pos)].role = role
+    rebuild_routing(cm)
+    cm.serving.prefill_replicas = len(cm.prefill_pool)
+    cm.serving.decode_replicas = len(cm.decode_pool)
+    cm.stats.pool_flips += 1
+    _journal_commit(cm, "set_pools")
+    tr = cm.tracer
+    if tr.enabled:
+        tr.event(
+            "set_pools",
+            prefill=len(cm.prefill_pool), decode=len(cm.decode_pool),
+            mixed=sum(1 for r in cm.replicas if r.role == "mixed"),
+        )
+    _log.warning(
+        "set_pools: %d prefill / %d decode / %d mixed",
+        len(cm.prefill_pool), len(cm.decode_pool),
+        sum(1 for r in cm.replicas if r.role == "mixed"),
+    )

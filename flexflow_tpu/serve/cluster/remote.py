@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import random
 import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -294,7 +295,17 @@ class RemoteReplica:
         #: CLUSTER steps (deterministic, no wall clock)
         self.last_contact_step = 0
         self._stats_src = stats
-        self._seq = itertools.count(1)
+        # Seqs start at a random 62-bit point per CLIENT INCARNATION,
+        # not at 1: a recovered manager re-dialing a STILL-RUNNING
+        # server (ClusterManager.recover) must not collide with the
+        # server's bounded response cache for the dead manager's seqs —
+        # a collision replays the old client's cached response instead
+        # of executing the new call. Retries still reuse one seq, so
+        # the at-most-once contract is untouched; nothing downstream
+        # depends on seq values (bitwise tests assert on outputs).
+        self._seq = itertools.count(
+            random.SystemRandom().getrandbits(62) | 1
+        )
         self._telemetry: Dict[str, Any] = {}
         self._pending_abandon = False
         self._last_call_retries = 0

@@ -266,6 +266,21 @@ class ServingConfig:
     # transport only — the loopback fails or succeeds instantly, and
     # all HEALTH accounting stays in deterministic cluster steps).
     rpc_backoff_s: float = 0.02
+    # Elastic, crash-recoverable control plane (serve/cluster/
+    # journal.py + reconfigure.py): a directory for the durable request
+    # journal — an append-only, CRC-framed log of submissions,
+    # flushed-token deltas (batched at the drive loop's flush sync
+    # point; no hot-path fsync) and terminal records, plus the
+    # membership snapshots live reconfiguration (scale_out / scale_in /
+    # set_pools) commits. A SIGKILL'd ClusterManager restarts with
+    # ``ClusterManager.recover(...)``: the journal replays (a torn tail
+    # truncates, never corrupts), still-running subprocess replica
+    # servers reconnect, and every unfinished request re-admits through
+    # the recompute path with its journaled prompt + flushed prefix —
+    # greedy outputs bitwise the uninterrupted run, zero lost or
+    # duplicated requests. None (default) = no journal (a manager crash
+    # strands in-flight requests, the pre-PR-14 behavior).
+    journal_dir: Optional[str] = None
     # Idle remote replicas are heartbeated every this many cluster
     # steps (a step RPC counts as contact, so busy replicas never pay
     # a separate heartbeat); the response carries the SchedulerStats
@@ -411,6 +426,10 @@ class ServingConfig:
             raise ValueError(
                 f"heartbeat_gap_steps must be >= 1 (got "
                 f"{self.heartbeat_gap_steps})"
+            )
+        if self.journal_dir is not None and not str(self.journal_dir):
+            raise ValueError(
+                "journal_dir must be a non-empty directory path or None"
             )
 
     def resolved_context_shards(self, mesh_seq_degree: int = 1) -> int:

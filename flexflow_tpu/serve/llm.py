@@ -155,12 +155,18 @@ class LLM:
         self.params = self._place_params(
             self.family, self.cfg, self.params, pipelined, quantization, offload
         )
-        if serving.replicas > 1 or serving.prefill_replicas:
+        if (
+            serving.replicas > 1 or serving.prefill_replicas
+            or serving.journal_dir
+        ):
             # Cluster serving (serve/cluster/): N engine replicas behind
             # the prefix-aware router. With ``ssms`` every replica runs
             # a SpecInferManager over its OWN draft mirror engines —
             # draft params are placed once here and shared by reference
-            # across replicas, exactly like the target's.
+            # across replicas, exactly like the target's. A journal_dir
+            # forces the cluster manager even at replicas=1 — the
+            # durable request journal (crash recovery, scale_out from
+            # one replica) lives at the cluster control plane.
             from .cluster import ClusterManager
 
             ssm_triples = []
