@@ -240,6 +240,13 @@ _PHASES = [
     # sampling / both) on small-batch sync decode — decode_step_ms
     # p50/p99 + dispatched programs per step, bitwise parity asserted
     ("serve_fused", 600, 400, True, True),
+    # whole-step decode megakernel: PR-6 fused vs whole_step vs
+    # whole_step × quantized-allreduce (TP2) — decode_step_ms p50/p99
+    # from SchedulerStats, one dispatched program per decode step,
+    # strictly fewer launch sites than the per-layer fused step,
+    # bitwise parity asserted (CPU runs the interpret-mode walk: the
+    # timing rows carry the documented off-chip caveat)
+    ("serve_megakernel", 700, 500, True, True),
     ("serve_int8", 600, 400, True, True),
     ("searched", 700, 400, False, True),
     ("serve_int4", 600, 400, True, True),
@@ -3567,6 +3574,236 @@ def serve_fused_bench(on_tpu, kernels):
     return res["both"]["p50_ms"]
 
 
+def serve_megakernel_bench(on_tpu, kernels):
+    """Whole-step decode megakernel (fused_decode=("whole_step",),
+    serve/kernels.whole_step_decode): small-batch greedy decode on the
+    blocking sync scheduler, ablating
+
+      base        fused_decode=()                   step + host decode head
+      pr6         ("rope_kv_write", "sampling")     the PR-6 per-layer fusions
+      whole_step  ("whole_step",)                   ONE layer-walking program
+      whole_step+q  whole_step × quantized_allreduce="int8" on a TP2 mesh
+                    (EQuARX collectives; skipped below 2 devices)
+
+    Reports decode_step_ms p50/p99 (now sourced from SchedulerStats —
+    the scheduler's own reservoir, derived decode_step_ms_p50 summary),
+    dispatched programs per decode step, and the program_launch_count
+    structural launch proxy. Asserts BITWISE output parity of base /
+    pr6 / whole_step, greedy parity of the quantized-collective arm vs
+    its exact twin, zero steady-state recompiles everywhere, whole_step
+    at ONE dispatched program per decode step, and STRICTLY fewer
+    kernel launches than the PR-6 fused step.
+
+    Measurement caveat (CPU): the whole-step walk runs interpret-mode
+    Pallas off-TPU, so its decode_step_ms is an interpreter artifact —
+    the CPU rows measure PARITY, dispatch counts and launch structure;
+    only the chip measures the VMEM-streaming win (same caveat as
+    serve_fused's rope_kv_write row). pr6/base run kernels=xla off-TPU
+    for the same reason."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu.core.mesh import MachineSpec
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import (
+        InferenceEngine, RequestManager, ServingConfig,
+    )
+    from flexflow_tpu.serve.engine import program_launch_count
+    from flexflow_tpu.serve.request_manager import RequestStatus
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_slots = 4
+    n_new = 32 if on_tpu else 10
+    prompt_len = 32 if on_tpu else 8
+    page_size = 16
+    base_kernels = kernels if on_tpu else "xla"
+    if not on_tpu and kernels == "pallas":
+        _log("serve_megakernel: pr6/base arms run kernels=xla off-TPU "
+             "(interpret-mode pallas would dominate); the whole_step "
+             "arm necessarily runs its interpret-mode walk")
+
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_slots)
+    ]
+
+    def make_rm(fused, mesh=None, collective=None, kern=None):
+        sc = ServingConfig(
+            max_requests_per_batch=n_slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=prompt_len,
+            max_spec_tree_tokens=8,
+            cache_dtype=cfg.dtype,
+            kernels=kern or base_kernels,
+            kv_layout="paged",
+            page_size=page_size,
+            max_cached_tokens=n_slots * (prompt_len + n_new + page_size),
+            fused_decode=fused,
+            quantized_allreduce=collective,
+            sanitizers=("retrace",),
+        )
+        eng = InferenceEngine(llama, cfg, params, sc, mesh=mesh)
+        return RequestManager(eng)
+
+    def run(fused, mesh=None, collective=None, kern=None):
+        rm = make_rm(fused, mesh, collective, kern)
+        rm.supports_fast_decode = False  # sync: true per-step wall time
+        rm.generate(prompts, max_new_tokens=2)   # warm every step key
+        rm.stats = type(rm.stats)()
+        eng = rm.engine
+        rids = [rm.submit(p, max_new_tokens=n_new) for p in prompts]
+        decode_dispatches, n_decode = 0, 0
+        t0 = time.perf_counter()
+        while True:
+            decode_only = (
+                rm._active(RequestStatus.DECODING)
+                and not rm._active(RequestStatus.PREFILLING)
+            )
+            d0 = eng.dispatch_count
+            if not rm.step():
+                break
+            if decode_only:
+                decode_dispatches += eng.dispatch_count - d0
+                n_decode += 1
+        rm.drain()
+        wall = time.perf_counter() - t0
+        outs = [list(rm.requests[r].output_tokens) for r in rids]
+        stats = rm.stats.snapshot()
+        return {
+            "fused": fused,
+            "outputs": outs,
+            "tps": sum(len(o) for o in outs) / wall,
+            # SchedulerStats' OWN reservoir — the new decode_step_ms
+            # telemetry, not a bench-side stopwatch
+            "p50_ms": stats["decode_step_ms_p50"],
+            "p99_ms": stats["decode_step_ms_p99"],
+            "dispatches_per_step": decode_dispatches / max(1, n_decode),
+            "decode_steps": n_decode,
+            "retraces": stats["retraces"],
+            "whole_step_on": getattr(rm.engine, "whole_step_on", False),
+        }
+
+    res = {
+        "base": run(()),
+        "pr6": run(("rope_kv_write", "sampling"),
+                   kern=kernels if on_tpu else "xla"),
+        "whole_step": run(("whole_step",)),
+    }
+    assert res["whole_step"]["whole_step_on"], (
+        "whole_step fell back — VMEM pricing tripped on the bench shape"
+    )
+    tp_ok = len(jax.devices()) >= 2
+    if tp_ok:
+        mesh = MachineSpec(model=2).make_mesh(jax.devices()[:2])
+        res["whole_step_tp_exact"] = run(
+            ("whole_step",), mesh=mesh, collective="exact"
+        )
+        res["whole_step_tp_q"] = run(
+            ("whole_step",), mesh=mesh, collective="int8"
+        )
+    else:
+        _log("serve_megakernel: <2 devices — skipping the TP2 "
+             "quantized-allreduce ablation")
+
+    base = res["base"]
+    for name in ("base", "pr6", "whole_step"):
+        r = res[name]
+        assert r["outputs"] == base["outputs"], (
+            f"{name} generations diverged — whole-step decode must be "
+            "bitwise the unfused step"
+        )
+    for name, r in res.items():
+        assert r["retraces"] == 0, (
+            f"{name}: {r['retraces']} steady-state recompiles"
+        )
+    assert res["whole_step"]["dispatches_per_step"] == 1.0, (
+        "whole-step decode must stay ONE dispatched program: "
+        f"{res['whole_step']['dispatches_per_step']:.2f}"
+    )
+    assert (res["whole_step"]["dispatches_per_step"]
+            <= res["pr6"]["dispatches_per_step"] + 1e-9)
+    assert (res["whole_step"]["dispatches_per_step"]
+            < base["dispatches_per_step"])
+    if tp_ok:
+        # the quantized collective must not move greedy decode tokens
+        assert (res["whole_step_tp_q"]["outputs"]
+                == res["whole_step_tp_exact"]["outputs"]), (
+            "int8 allreduce moved greedy tokens vs exact mode"
+        )
+
+    # structural launch proxy: the walk vs the PR-6 per-layer step
+    R, NP = n_slots, -(-(prompt_len + n_new + 8 + 8 + 1) // page_size)
+    pool_pages = n_slots * NP
+    cache = llama.init_paged_kv_cache(cfg, pool_pages, page_size)
+    pt = jnp.zeros((R, NP), jnp.int32)
+    toks = jnp.zeros((R, 1), jnp.int32)
+    pos = jnp.zeros((R, 1), jnp.int32)
+    lidx = jnp.zeros((R,), jnp.int32)
+    cl = NP * page_size - 1
+    n_whole = program_launch_count(
+        functools.partial(llama.serve_step_whole, cfg=cfg, cache_len=cl),
+        params, cache, toks, pos, lidx, pt,
+    )
+    n_pr6 = program_launch_count(
+        functools.partial(llama.serve_step_paged, cfg=cfg, cache_len=cl,
+                          kernels="pallas", fused_rope=True),
+        params, cache, toks, pos, lidx, None, None, pt,
+    )
+    assert n_whole < n_pr6, (
+        "whole-step must execute strictly fewer kernel launches than "
+        f"the PR-6 fused step: {n_whole} vs {n_pr6}"
+    )
+
+    detail = {}
+    for name, r in res.items():
+        detail[f"{name}_decode_step_ms_p50"] = round(r["p50_ms"], 3)
+        detail[f"{name}_decode_step_ms_p99"] = round(r["p99_ms"], 3)
+        detail[f"{name}_tokens_per_sec"] = round(r["tps"], 2)
+        detail[f"{name}_dispatches_per_step"] = round(
+            r["dispatches_per_step"], 2
+        )
+    emit(
+        "whole_step_launches_per_decode_step",
+        n_whole,
+        "launch sites/step",
+        # <1: the walk's structural launch count vs the PR-6 fused step
+        vs_baseline=n_whole / max(1, n_pr6),
+        pr6_launches_per_step=n_pr6,
+        kernels=base_kernels,
+        platform=_platform(),
+    )
+    emit(
+        "whole_step_decode_step_ms_p50",
+        round(res["whole_step"]["p50_ms"], 3),
+        "ms",
+        # off-TPU this ratio is an interpreter artifact (see docstring);
+        # parity/dispatch/launch assertions are the CPU substance
+        vs_baseline=res["whole_step"]["p50_ms"] / max(1e-9,
+                                                      base["p50_ms"]),
+        output_parity="bitwise",
+        steady_state_recompiles=0,
+        dispatches_per_decode_step=1.0,
+        quantized_allreduce_ablation=(
+            "greedy-parity-vs-exact" if tp_ok else "skipped (<2 devices)"
+        ),
+        cpu_caveat=(
+            None if on_tpu else
+            "whole_step arm runs interpret-mode Pallas: decode_step_ms "
+            "is an interpreter artifact off-chip"
+        ),
+        n_slots=n_slots,
+        new_tokens_per_request=n_new,
+        decode_steps_measured=res["whole_step"]["decode_steps"],
+        **detail,
+        platform=_platform(),
+    )
+    return res["whole_step"]["p50_ms"]
+
+
 def serve_quantized_bench(on_tpu, kernels, bits):
     """Weight-only int8/int4 serving (reference --8bit/4bit-quantization,
     file_loader.cc:651,710 + decompress kernels): decode is
@@ -3695,6 +3932,14 @@ def _platform():
 
 
 def child_main(phase, platform, kernels):
+    if phase == "serve_megakernel" and platform == "cpu":
+        # the quantized-allreduce ablation needs a TP2 mesh: give the
+        # CPU child two virtual devices BEFORE jax initialises
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
     import jax
 
     if platform == "cpu":
@@ -3733,6 +3978,8 @@ def child_main(phase, platform, kernels):
         serve_spec_adaptive_bench(on_tpu, kernels)
     elif phase == "serve_fused":
         serve_fused_bench(on_tpu, kernels)
+    elif phase == "serve_megakernel":
+        serve_megakernel_bench(on_tpu, kernels)
     elif phase == "serve_int8":
         serve_quantized_bench(on_tpu, kernels, bits=8)
     elif phase == "serve_int4":
@@ -3761,7 +4008,7 @@ def main():
                  "serve_paged_q", "serve_kv_hierarchy",
                  "serve_long_context", "serve_cluster",
                  "serve_faults", "serve_elastic", "serve_transport", "serve_fused",
-                 "serve_int8", "serve_int4", "serve_7b"],
+                 "serve_megakernel", "serve_int8", "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )
     ap.add_argument("--child", default=None, help=argparse.SUPPRESS)

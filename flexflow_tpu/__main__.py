@@ -106,6 +106,7 @@ def cmd_serve(args):
         fused_decode=tuple(
             s for s in (args.fused_decode or "").split(",") if s
         ),
+        quantized_allreduce=args.quantized_allreduce,
         replicas=args.replicas,
         router_policy=args.router_policy,
         prefill_replicas=args.prefill_replicas,
@@ -307,12 +308,22 @@ def main(argv=None):
                         "(complete) or as soon as prefill ends (prefill)")
     s.add_argument("--fused-decode", default=None,
                    help="megakernel decode-step fusions, comma-separated "
-                        "(rope_kv_write,sampling): fold RoPE + the KV "
-                        "page write into the ragged paged Pallas kernel "
-                        "(requires --kv-layout paged; active with "
-                        "--pallas) and/or the greedy/top-k sampling "
-                        "epilogue into the step program; each fusion is "
+                        "(rope_kv_write,sampling,whole_step): fold RoPE "
+                        "+ the KV page write into the ragged paged "
+                        "Pallas kernel (requires --kv-layout paged; "
+                        "active with --pallas), the greedy/top-k "
+                        "sampling epilogue into the step program, "
+                        "and/or run the WHOLE decode step as one "
+                        "persistent layer-walking Pallas program "
+                        "(paged layouts); each fusion is "
                         "bitwise-identical to the unfused step")
+    s.add_argument("--quantized-allreduce", default=None,
+                   choices=["exact", "int8"],
+                   help="whole_step TP decode collectives "
+                        "(serve/collectives.py, EQuARX-style): 'exact' "
+                        "= lax.psum (bitwise the GSPMD reduction), "
+                        "'int8' = quantized codes + per-block scales "
+                        "(~1/4 the reduce bytes, documented tolerance)")
     s.add_argument("--replicas", type=int, default=1,
                    help="cluster serving (serve/cluster/): drive this "
                         "many engine replicas — each its own mesh and "

@@ -118,11 +118,15 @@ SCHED_GAUGES = frozenset({"host_bytes", "cp_shards", "shard_balance"})
 SCHED_EXCLUDED = {
     "occupancy_sum": "mean_occupancy",
     "budget_fill_sum": "mean_budget_fill",
+    # the raw reservoir is host-side sample storage; the scrape surface
+    # carries its derived percentiles
+    "decode_step_ms_samples": "decode_step_ms_p50",
 }
 #: Derived snapshot() rates exported as gauges alongside the counters.
 SCHED_DERIVED = (
     "mean_occupancy", "mean_budget_fill", "prefix_hit_rate",
     "host_hit_rate", "spec_accept_rate",
+    "decode_step_ms_p50", "decode_step_ms_p99",
 )
 
 CLUSTER_COUNTERS = frozenset({

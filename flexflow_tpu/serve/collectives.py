@@ -1,0 +1,154 @@
+"""Quantized tensor-parallel decode collectives (EQuARX, PAPERS.md
+arxiv 2506.17615).
+
+Megatron-TP decode is collective-bound: every layer ends in TWO
+all-reduces (the attention out-projection and the MLP down-projection
+are row-parallel), each moving an ``(R, C, D)`` f32 partial across the
+``model`` axis, and at decode (C=1, small R) the reduce latency — not
+its FLOPs — serializes against the next layer's compute. EQuARX's
+observation is that the reduce operand tolerates aggressive
+quantization: ship int8 CODES plus per-block f32 amax scales (~1/4 the
+f32 bytes at block=128) and dequantize-and-sum at the receiver. This
+module is that collective for the whole-step decode walk
+(``ServingConfig.fused_decode=("whole_step",)`` on a TP mesh,
+models/*.serve_step_whole): the walk issues ONE of these per fusion
+point instead of leaving the reduce to GSPMD, so the byte count is an
+explicit, quantizable quantity.
+
+Two modes (``ServingConfig.quantized_allreduce``):
+
+``"exact"`` (default, the fp fallback)
+    literally ``lax.psum`` — the same reduction GSPMD inserts for the
+    row-parallel matmuls, so the collective-explicit walk stays
+    BITWISE the GSPMD-scheduled unfused step (asserted in
+    tests/test_whole_step.py). This is the mode every correctness
+    claim is anchored on.
+
+``"int8"``
+    per-shard symmetric int8 quantization over ``block``-wide channel
+    groups (one f32 amax scale per block), ``all_gather`` of codes +
+    scales, dequantized accumulation in ABSOLUTE shard order (shard
+    0..n-1 on every shard — deterministic, replicated result). Wire
+    bytes drop to ``1/4 + 4/block`` of f32 (~27% at block=128).
+    Tolerance contract: the reduced value differs from the exact sum
+    by at most ``n · amax_block / 254`` per element (each shard's
+    rounding error is ≤ scale/2 = amax/254); greedy decode tokens are
+    asserted equal to the exact mode's in tests, logits within the
+    documented bound. NOT bitwise — choosing it is an explicit
+    accuracy/bandwidth trade, like kv_quant.
+
+The gather-then-sum shape (rather than quantized reduce-scatter +
+all-gather) is chosen for determinism: every shard applies the same
+association, so the result replicates exactly and run-to-run bitwise
+determinism survives. On-chip the codes move over ICI; the follow-up
+(ROADMAP item 5b) is issuing these as in-kernel RDMA ring hops so the
+reduce for layer i overlaps layer i+1's weight DMA.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: channel-group width one f32 amax scale covers in "int8" mode — the
+#: EQuARX block size; 128 keeps the scale overhead at 4/128 bytes per
+#: element and matches the TPU lane width.
+BLOCK = 128
+
+#: modes ServingConfig.quantized_allreduce accepts (None means "exact")
+MODES = ("exact", "int8")
+
+
+def resolve_mode(mode: Optional[str]) -> str:
+    """Validate a ``ServingConfig.quantized_allreduce`` value (None
+    passes through as "exact"; unknown names are a ValueError, raised
+    at engine construction like kv_quant's)."""
+    if mode is None:
+        return "exact"
+    if mode not in MODES:
+        raise ValueError(
+            f"unknown quantized_allreduce {mode!r} (expected one of "
+            f"{MODES} or None)"
+        )
+    return mode
+
+
+def quantize_blocks(
+    x: jnp.ndarray, block: int = BLOCK
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization of the trailing dim in ``block``-wide
+    groups: ``x (..., D)`` → ``(codes int8 (..., D), scales f32
+    (..., D/block))``. The trailing dim pads up to a block multiple
+    internally; padding never reaches the wire shape (D is preserved).
+    All-zero blocks carry scale 0 and decode to exact zeros."""
+    D = x.shape[-1]
+    pad = (-D) % block
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, [(0, 0)] * (xf.ndim - 1) + [(0, pad)])
+    g = xf.reshape(xf.shape[:-1] + (-1, block))      # (..., G, block)
+    amax = jnp.max(jnp.abs(g), axis=-1)              # (..., G)
+    scale = amax / 127.0
+    q = jnp.round(g / jnp.maximum(scale[..., None], 1e-30))
+    q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+    codes = q.reshape(xf.shape)[..., :D]
+    return codes, scale
+
+
+def dequantize_blocks(
+    codes: jnp.ndarray, scales: jnp.ndarray, block: int = BLOCK
+) -> jnp.ndarray:
+    """Inverse of :func:`quantize_blocks` (f32 out): codes
+    ``(..., D)`` × scales ``(..., G)`` → ``(..., D)``."""
+    D = codes.shape[-1]
+    pad = (-D) % block
+    cf = codes.astype(jnp.float32)
+    if pad:
+        cf = jnp.pad(cf, [(0, 0)] * (cf.ndim - 1) + [(0, pad)])
+    g = cf.reshape(cf.shape[:-1] + (-1, block))
+    out = g * scales[..., None]
+    return out.reshape(cf.shape)[..., :D]
+
+
+def tp_allreduce(
+    x: jnp.ndarray,
+    axis_name: str,
+    mode: str = "exact",
+    block: int = BLOCK,
+) -> jnp.ndarray:
+    """All-reduce a row-parallel partial over the named (shard_map
+    manual) mesh axis — the decode-collective chokepoint of the
+    whole-step walk. ``mode="exact"`` IS ``lax.psum`` (bitwise the
+    GSPMD reduction); ``mode="int8"`` ships quantized codes + per-block
+    scales and accumulates the dequantized shards in absolute shard
+    order (see the module docstring for the tolerance contract)."""
+    if mode == "exact":
+        return lax.psum(x, axis_name)
+    if mode != "int8":
+        raise ValueError(f"unknown collective mode {mode!r}")
+    codes, scales = quantize_blocks(x, block)
+    # tiled=False stacks shard contributions on a fresh leading axis in
+    # absolute shard order; summing over it applies one association on
+    # every shard, so the result replicates exactly.
+    all_codes = lax.all_gather(codes, axis_name)     # (n, ..., D)
+    all_scales = lax.all_gather(scales, axis_name)   # (n, ..., G)
+    parts = dequantize_blocks(all_codes, all_scales, block)
+    return parts.sum(axis=0).astype(x.dtype)
+
+
+def allreduce_wire_bytes(
+    x_shape: Tuple[int, ...], mode: str = "exact", block: int = BLOCK
+) -> int:
+    """Per-shard payload bytes ONE allreduce of an f32 tensor with
+    shape ``x_shape`` puts on the interconnect — the bench's
+    bytes-moved accounting (exact: 4 B/elt; int8: 1 B/elt + 4 B per
+    ``block`` elements of scale)."""
+    n = 1
+    for d in x_shape:
+        n *= int(d)
+    if mode == "exact":
+        return 4 * n
+    groups = n // x_shape[-1] * (-(-x_shape[-1] // block))
+    return n + 4 * groups

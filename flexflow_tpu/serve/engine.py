@@ -165,9 +165,36 @@ class ServingConfig:
     #     batches skip the (R, V) sorts entirely, and the sync path
     #     drops from two dispatched programs per step (step + host-side
     #     sample) to one (engine.run_sampled).
+    #   "whole_step" — the WHOLE decode step (embedding, all L layers'
+    #     QKV/attention/MLP, the fused RoPE+KV-write prologue, ragged
+    #     paged attention over fp/int8/int4 pools, final norm, LM head
+    #     and the greedy sampling epilogue) runs as ONE persistent
+    #     Pallas program whose grid walks the layers with
+    #     double-buffered HBM→VMEM weight streaming
+    #     (serve/kernels.whole_step_decode; models/*.serve_step_whole).
+    #     Paged layout only; families advertise support via
+    #     FUSED_DECODE and gate unstreamable layouts (MoE, ALiBi,
+    #     weight-quantized params) in whole_step_weight_layout. On TP
+    #     meshes the walk runs collective-explicit (one
+    #     serve/collectives.tp_allreduce per row-parallel matmul —
+    #     see quantized_allreduce), still one dispatched program. When
+    #     the per-layer working set exceeds the VMEM budget
+    #     (kernels.WHOLE_STEP_VMEM_BUDGET, FF_WHOLE_STEP_VMEM_MB) the
+    #     engine logs and FALLS BACK to the PR-6 per-layer fusions.
+    #     Bitwise the unfused kernels="xla" step on the same backend.
     # Off by default; () compiles exactly the pre-fusion step programs
     # under exactly the pre-fusion step keys.
     fused_decode: Tuple[str, ...] = ()
+    # Quantized TP decode collectives (serve/collectives.py, EQuARX —
+    # PAPERS.md arxiv 2506.17615), whole_step + TP meshes only. None or
+    # "exact": the walk's per-layer allreduce is literally lax.psum —
+    # bitwise the GSPMD reduction of the unfused step. "int8": the
+    # reduce ships int8 codes + per-128-block f32 amax scales (~27% of
+    # the f32 bytes) and accumulates dequantized shards in absolute
+    # shard order — deterministic, greedy-token-stable in practice, but
+    # NOT bitwise (per-element error ≤ n·amax_block/254; an explicit
+    # accuracy/bandwidth trade like kv_quant).
+    quantized_allreduce: Optional[str] = None
     # Cluster serving (serve/cluster/): one process drives this many
     # engine replicas — each its own mesh and KV pool — behind a
     # front-end Router (prefix-cache-aware placement, session affinity,
@@ -494,15 +521,32 @@ class ServingConfig:
                 f"{self.page_size}) — raise the budget or shrink "
                 "page_size"
             )
-        if "rope_kv_write" in (self.fused_decode or ()) and (
-            mesh_seq_degree > 1
+        # PR-11's blanket rope_kv_write exclusion on sequence-sharded
+        # meshes is LIFTED: the fused prologue now joins the ring body
+        # (serve/kernels.ring_ragged_paged_attention fused mode — each
+        # shard rotates Q/K and commits its resident lines inside the
+        # shard_map program). What remains excluded is the QUANTIZED
+        # ring commit: the per-page amax scale update is not
+        # shard-local.
+        if (
+            "rope_kv_write" in (self.fused_decode or ())
+            and mesh_seq_degree > 1
+            and self.kv_quant is not None
         ):
             raise ValueError(
-                "fused_decode='rope_kv_write' is not composed with ring "
+                "fused_decode='rope_kv_write' is not composed with "
+                "QUANTIZED pools on a sequence-sharded mesh — the "
+                "in-ring quantizing commit's per-page scale update is "
+                "not shard-local; drop kv_quant or the fusion (full-"
+                "precision pools compose)"
+            )
+        if "whole_step" in (self.fused_decode or ()) and mesh_seq_degree > 1:
+            raise ValueError(
+                "fused_decode='whole_step' is not composed with ring "
                 "context parallelism on a sequence-sharded mesh — the "
-                "fused prologue commits K/V inside the single-shard "
-                "ragged kernel; drop the fusion or serve with "
-                "context_shards on a seq-degree-1 mesh"
+                "layer walk gathers pages through the full table; serve "
+                "whole_step with context_shards on a seq-degree-1 mesh "
+                "(the layout-blind gather), or drop one of the two"
             )
 
     @property
@@ -637,11 +681,67 @@ class InferenceEngine:
             self.serving = dataclasses.replace(self.serving,
                                                fused_decode=fused)
         for name in fused:
-            if name not in ("rope_kv_write", "sampling"):
+            if name not in ("rope_kv_write", "sampling", "whole_step"):
                 raise ValueError(
                     f"unknown fused_decode entry {name!r} (expected "
-                    "'rope_kv_write' and/or 'sampling')"
+                    "'rope_kv_write', 'sampling' and/or 'whole_step')"
                 )
+        # Whole-step decode megakernel (serve/kernels.whole_step_decode):
+        # capability-gated at construction; whole_step_on may still flip
+        # to False below if the VMEM pricing says the walk cannot fit.
+        self.whole_step_on = False
+        from .collectives import resolve_mode as _resolve_collective
+
+        self.collective_mode = _resolve_collective(
+            self.serving.quantized_allreduce
+        )
+        if (
+            self.serving.quantized_allreduce is not None
+            and "whole_step" not in fused
+        ):
+            raise ValueError(
+                "quantized_allreduce only applies to the whole-step "
+                "decode walk — set fused_decode=('whole_step',) (TP "
+                "meshes), or drop quantized_allreduce"
+            )
+        if "whole_step" in fused:
+            if not self.paged:
+                raise ValueError(
+                    "fused_decode='whole_step' requires "
+                    "kv_layout='paged' — the layer walk commits and "
+                    "gathers K/V through the page table"
+                )
+            if "whole_step" not in getattr(model, "FUSED_DECODE", ()):
+                raise ValueError(
+                    "fused_decode='whole_step' requested but "
+                    f"{getattr(model, '__name__', repr(model))} does not "
+                    "advertise it (model.FUSED_DECODE)"
+                )
+            if self.pipelined:
+                raise ValueError(
+                    "fused_decode='whole_step' is not composed with "
+                    "pipeline parallelism — the walk owns the whole "
+                    "layer stack"
+                )
+            from ..core.mesh import MODEL_AXIS as _MODEL_AXIS
+
+            tp = self.mesh.shape.get(_MODEL_AXIS, 1)
+            if tp > 1 and (
+                cfg.num_attention_heads % tp
+                or cfg.num_key_value_heads % tp
+            ):
+                raise ValueError(
+                    "fused_decode='whole_step' on a TP mesh needs head "
+                    f"counts divisible by the model degree ({tp}): got "
+                    f"H={cfg.num_attention_heads}, "
+                    f"KV={cfg.num_key_value_heads} (MQA replicated "
+                    "caches are not composed with the manual TP walk)"
+                )
+            # capability gate: the family's weight-layout hook raises a
+            # named error for unstreamable layouts (MoE, ALiBi,
+            # weight-quantized params) — at construction, never mid-serve
+            model.whole_step_weight_layout(params, cfg)
+            self.whole_step_on = True
         if "rope_kv_write" in fused:
             if not self.paged:
                 raise ValueError(
@@ -712,6 +812,57 @@ class InferenceEngine:
                     "parallelism yet — use kv_layout='dense' with pipe>1"
                 )
         self.cache = self._alloc_cache()
+        if self.whole_step_on:
+            self._price_whole_step()
+
+    def _price_whole_step(self):
+        """VMEM pricing of the whole-step walk (single-shard meshes —
+        the TP walk is collective-explicit XLA, not one kernel): when
+        one grid step's working set (double-buffered weight blocks +
+        in/out pool slices + resident constants + intermediates,
+        serve/kernels.whole_step_vmem_bytes) exceeds the budget
+        (kernels.WHOLE_STEP_VMEM_BUDGET; FF_WHOLE_STEP_VMEM_MB
+        overrides), the walk cannot fit on chip and the engine FALLS
+        BACK to the PR-6 per-layer fused path — logged loudly, never a
+        silent downgrade. README "Whole-step decode megakernel" carries
+        the budget math; sub-block weight streaming is the lift
+        (ROADMAP 5b)."""
+        import os
+
+        from ..core.mesh import MODEL_AXIS
+        from . import kernels as _pk
+
+        if self.mesh.shape.get(MODEL_AXIS, 1) > 1:
+            return  # TP walk: per-layer XLA programs, no VMEM gate
+        budget = _pk.WHOLE_STEP_VMEM_BUDGET
+        env = os.environ.get("FF_WHOLE_STEP_VMEM_MB")
+        if env:
+            budget = int(float(env) * 1024 * 1024)
+        layer_arrays, head_arrays = self.model.whole_step_weight_layout(
+            self.params, self.cfg
+        )
+        R = self.num_slots
+        D = self.cfg.hidden_size
+        S_virt = self.serving.pages_per_slot * self.serving.page_size
+        x0 = np.zeros((R, 1, D), jnp.dtype(self.cfg.dtype))
+        mask = np.zeros((R, 1, S_virt), np.bool_)
+        est = _pk.whole_step_vmem_bytes(
+            layer_arrays, head_arrays, self.cache, x0, mask,
+            self.cfg.num_attention_heads,
+        )
+        self.whole_step_vmem_est = int(est)
+        if est > budget:
+            from ..logging_utils import get_logger
+
+            get_logger("serve").warning(
+                "whole_step: estimated per-layer VMEM working set "
+                "%.1f MB exceeds the %.1f MB budget — falling back to "
+                "the PR-6 per-layer fused decode path (raise "
+                "FF_WHOLE_STEP_VMEM_MB to override, or shrink the "
+                "pool/model; README 'Whole-step decode megakernel')",
+                est / 1e6, budget / 1e6,
+            )
+            self.whole_step_on = False
 
     @property
     def pipelined(self) -> bool:
@@ -1015,6 +1166,66 @@ class InferenceEngine:
             )
         return self._steps[key_id]
 
+    def _serve_whole_fn(self) -> Callable:
+        """model.serve_step_whole bound to this engine's static kwargs
+        (the whole-step layer walk — serve/kernels.whole_step_decode on
+        single-shard meshes, the collective-explicit TP walk
+        otherwise)."""
+        from ..core.mesh import MODEL_AXIS
+
+        tp = self.mesh.shape.get(MODEL_AXIS, 1)
+        return functools.partial(
+            self.model.serve_step_whole,
+            cfg=self.cfg,
+            cache_len=self.serving.cache_len,
+            kv_quant=self.serving.kv_quant,
+            tp_mesh=self.mesh if tp > 1 else None,
+            collective=self.collective_mode,
+        )
+
+    def _get_whole_step(self, with_logits: bool, sample_mode: str,
+                        topk_cap: int):
+        """The whole-step decode program (fused_decode=("whole_step",)):
+        token select (device feedback vs host) → the ONE-program layer
+        walk (model.serve_step_whole) → the sampling epilogue. Greedy
+        batches take the walk's in-kernel argmax head; other modes
+        sample from the walk's logits inside the same jitted program —
+        either way ONE dispatched program per decode step, with
+        strictly fewer kernel launches than the per-layer fused step
+        (:func:`program_launch_count` is the measured proxy)."""
+        key_id = ("whole_step", sample_mode, topk_cap, with_logits)
+        if key_id not in self._steps:
+            from .sampling import sample_tokens
+
+            fn = self._serve_whole_fn()
+            mode = sample_mode or "full"
+
+            def step(params, cache, last_tokens, host_tokens, use_last,
+                     positions, logits_idx, key, greedy, temperature,
+                     topp, topk, page_table=None):
+                first = jnp.where(use_last, last_tokens, host_tokens[:, 0])
+                tokens = first[:, None]
+                logits, gtoks, cache = fn(
+                    params, cache, tokens, positions, logits_idx,
+                    page_table,
+                )
+                if mode == "greedy":
+                    toks = gtoks  # the walk's fused argmax head
+                else:
+                    toks = sample_tokens(
+                        logits, key,
+                        greedy=greedy, temperature=temperature, topp=topp,
+                        topk_arr=topk, mode=mode, topk_cap=topk_cap,
+                    )
+                if with_logits:
+                    return toks, logits, cache
+                return toks, cache
+
+            self._steps[key_id] = self._jit(
+                step, key=key_id, donate_argnums=(1,)
+            )
+        return self._steps[key_id]
+
     def run_mixed(self, last_tokens, host_tokens, use_last, positions,
                   logits_idx, key, greedy, temperature, topp, topk,
                   with_logits: bool = False):
@@ -1026,6 +1237,14 @@ class InferenceEngine:
         if self.paged:
             kw["page_table"] = self.page_table_device()
         host_tokens = np.asarray(host_tokens)
+        if self.whole_step_on and host_tokens.shape[1] == 1:
+            # the whole-step megakernel owns the C==1 decode step; the
+            # sampling epilogue is part of the walk's contract
+            return self._run_whole(
+                last_tokens, host_tokens, use_last, positions,
+                logits_idx, key, greedy, temperature, topp, topk,
+                with_logits, kw,
+            )
         mode, cap = None, 0
         if "sampling" in self.serving.fused_decode:
             from .sampling import choose_sample_mode
@@ -1067,6 +1286,45 @@ class InferenceEngine:
         self._poison_donated(
             donated, ("mixed_fused", host_tokens.shape[1], with_logits)
         )
+        return toks
+
+    def _run_whole(self, last_tokens, host_tokens, use_last, positions,
+                   logits_idx, key, greedy, temperature, topp, topk,
+                   with_logits, kw):
+        """Dispatch ONE whole-step decode program (run_mixed's C==1
+        route with fused_decode=("whole_step",)): same argument
+        contract, same pinned-dtype conversion, same donation — the
+        step key is mode-tagged like the fused sampling head's."""
+        from .sampling import choose_sample_mode
+
+        mode, cap = choose_sample_mode(
+            greedy, topp, topk, self.cfg.vocab_size
+        )
+        donated = self.cache
+        self.count_dispatch("whole_step")
+        with _set_mesh(self.mesh):
+            step = self._get_whole_step(with_logits, mode, cap)
+            out = step(
+                self.params,
+                self.cache,
+                last_tokens,
+                jnp.asarray(host_tokens, dtype=jnp.int32),
+                jnp.asarray(use_last, dtype=jnp.bool_),
+                jnp.asarray(positions, dtype=jnp.int32),
+                jnp.asarray(logits_idx, dtype=jnp.int32),
+                key,
+                jnp.asarray(greedy, dtype=jnp.bool_),
+                jnp.asarray(temperature, dtype=jnp.float32),
+                jnp.asarray(topp, dtype=jnp.float32),
+                jnp.asarray(topk, dtype=jnp.int32),
+                **kw,
+            )
+        if with_logits:
+            toks, logits, self.cache = out
+            self._poison_donated(donated, ("whole_step", mode, cap))
+            return toks, logits
+        toks, self.cache = out
+        self._poison_donated(donated, ("whole_step", mode, cap))
         return toks
 
     def run_decode(self, last_tokens, host_tokens, use_last, positions,
@@ -1135,6 +1393,24 @@ class InferenceEngine:
         if self.serving.inference_debugging:
             with _set_mesh(self.mesh):
                 self._dump_debug(bc)
+        if (
+            self.whole_step_on
+            and bc.chunk == 1
+            and bc.mask is None
+            and bc.cache_positions is None
+        ):
+            # pure decode sync step: same whole-step program (and step
+            # key) the pipelined path compiles — use_last all-False
+            # feeds the host tokens through the same token select
+            R = self.num_slots
+            kw = {}
+            if self.paged:
+                kw["page_table"] = self.page_table_device()
+            return self._run_whole(
+                jnp.zeros((R,), jnp.int32), np.asarray(bc.tokens),
+                np.zeros((R,), bool), bc.positions, bc.logits_idx,
+                key, greedy, temperature, topp, topk, with_logits, kw,
+            )
         mode, cap = choose_sample_mode(
             greedy, topp, topk, self.cfg.vocab_size
         )
@@ -1545,3 +1821,49 @@ class InferenceEngine:
         built over the old allocator is invalidated with it — managers
         are expected to be rebuilt alongside an engine reset."""
         self.cache = self._alloc_cache()
+
+
+def program_launch_count(fn, *args, **kwargs) -> int:
+    """Structural kernel-launch proxy of one step program: ``fn`` is
+    traced to a jaxpr and its equations counted recursively — each
+    primitive equation is one launch-site execution, ``scan`` bodies
+    multiply by their trip count, call-like primitives (pjit /
+    shard_map / custom calls / remat) recurse into their subjaxprs,
+    ``cond`` counts its largest branch. Not an HLO kernel count (XLA
+    fuses elementwise chains), but a faithful ORDER comparison: the
+    PR-6 fused decode step executes O(L) launch sites (one scan
+    iteration per layer, each with its projections, Pallas kernel and
+    MLP), the whole-step walk O(1) — ONE pallas_call whose grid walks
+    the layers. bench serve_megakernel and tests/test_whole_step.py
+    assert the strict inequality on this measure."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs).jaxpr
+
+    def count(jx, mult: int) -> int:
+        total = 0
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == "scan":
+                total += count(
+                    eqn.params["jaxpr"].jaxpr,
+                    mult * int(eqn.params["length"]),
+                )
+            elif name == "while":
+                total += count(eqn.params["cond_jaxpr"].jaxpr, mult)
+                total += count(eqn.params["body_jaxpr"].jaxpr, mult)
+            elif name == "cond":
+                total += max(
+                    count(b.jaxpr, mult) for b in eqn.params["branches"]
+                )
+            else:
+                sub = None
+                for k in ("jaxpr", "call_jaxpr"):
+                    if k in eqn.params:
+                        sub = eqn.params[k]
+                        break
+                if sub is not None:
+                    total += count(getattr(sub, "jaxpr", sub), mult)
+                else:
+                    total += mult
+        return total
+
+    return count(jaxpr, 1)

@@ -88,7 +88,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -865,7 +865,8 @@ def ring_ragged_paged_attention(
     scale: Optional[float] = None,
     k_scale: Optional[jnp.ndarray] = None,  # (rows, KV) f32 (quant pool)
     v_scale: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
+    fused: Optional[dict] = None,
+):
     """Context-parallel ragged paged attention over a sequence-sharded
     page pool (see the section comment above): per-shard resident-page
     partials + ``ppermute`` stat rotation + online-softmax merge in
@@ -873,7 +874,20 @@ def ring_ragged_paged_attention(
     shard_map — other mesh axes stay under GSPMD); pool rows (and the
     quant scale rows) shard over ``seq``, q/table/mask replicate.
     Returns (R, C, H, dk). ``mesh.shape[seq] == 1`` degenerates to the
-    XLA fallback (nothing to rotate)."""
+    XLA fallback (nothing to rotate).
+
+    ``fused`` (the PR-6 ``rope_kv_write`` prologue, lifted onto
+    seq-sharded meshes): a dict ``{k_new, v_new, cos, sin, phys, off}``
+    — ``q``/``k_new`` arrive PRE-RoPE and each shard rotates them
+    in-body (op-for-op :func:`_rope_rotate` == the XLA ``apply_rope``)
+    and commits the fresh K/V lines to its OWN resident rows
+    (non-resident lines drop via an out-of-bounds scatter, exactly the
+    rows a GSPMD scatter would route elsewhere) before attending — the
+    separate XLA rope + replicated-index scatter leave the step
+    program. Returns ``(out, k_pool, v_pool)``. ``cos``/``sin`` may be
+    None (no-RoPE families: the prologue is just the commit).
+    Full-precision pools only — the quantized ring commit (per-shard
+    scale ownership) is still excluded at validation."""
     from jax import lax
 
     from ..core.mesh import SEQ_AXIS, shard_map_unchecked
@@ -881,6 +895,27 @@ def ring_ragged_paged_attention(
 
     n = mesh.shape[SEQ_AXIS]
     if n <= 1:
+        if fused is not None:
+            # degenerate single-shard layout: the unfused composition IS
+            # the reference math (same ops the fused body mirrors)
+            cos, sin = fused.get("cos"), fused.get("sin")
+            qr, kr = q, fused["k_new"]
+            if cos is not None:
+                qr = _rope_rotate(q, cos[:, :, None, :], sin[:, :, None, :])
+                kr = _rope_rotate(
+                    fused["k_new"], cos[:, :, None, :], sin[:, :, None, :]
+                )
+            k_pool = k_pool.at[fused["phys"], fused["off"]].set(
+                kr.astype(k_pool.dtype)
+            )
+            v_pool = v_pool.at[fused["phys"], fused["off"]].set(
+                fused["v_new"].astype(v_pool.dtype)
+            )
+            out = ring_ragged_paged_attention_xla(
+                qr, k_pool, v_pool, page_table, mask,
+                scale=scale, k_scale=k_scale, v_scale=v_scale,
+            )
+            return out, k_pool, v_pool
         return ring_ragged_paged_attention_xla(
             q, k_pool, v_pool, page_table, mask,
             scale=scale, k_scale=k_scale, v_scale=v_scale,
@@ -893,13 +928,41 @@ def ring_ragged_paged_attention(
             f"divisible by the seq degree ({n}) — the engine pads the "
             "pool with unreferenced rows to align the shard slices"
         )
+    if fused is not None and k_scale is not None:
+        raise NotImplementedError(
+            "the fused rope_kv_write prologue is not composed with "
+            "quantized pools on a sequence-sharded mesh — the per-page "
+            "amax scale update is not shard-local; drop the fusion or "
+            "kv_quant (ServingConfig.validate_long_context names this)"
+        )
     rows_local = rows // n
     G = H // KV
     quant = k_scale is not None
     scale_f = scale if scale is not None else 1.0 / math.sqrt(dk)
+    has_rope = fused is not None and fused.get("cos") is not None
 
-    def body(q_, kp, vp, pt, mk, *scales):
+    def body(q_, kp, vp, pt, mk, *rest):
         i = lax.axis_index(SEQ_AXIS)
+        if fused is not None:
+            if has_rope:
+                kn, vn, cos_, sin_, fph, fof = rest[-6:]
+                q_ = _rope_rotate(
+                    q_, cos_[:, :, None, :], sin_[:, :, None, :]
+                )
+                kn = _rope_rotate(
+                    kn, cos_[:, :, None, :], sin_[:, :, None, :]
+                )
+            else:
+                kn, vn, fph, fof = rest[-4:]
+            # commit each fresh line on its OWNING shard only:
+            # non-resident lines redirect out of bounds and drop — the
+            # same rows a GSPMD scatter over the sharded pool routes to
+            # other shards, so pool bytes stay bitwise the unfused
+            # step's.
+            res_line = (fph // rows_local) == i          # (R, C)
+            lph = jnp.where(res_line, fph % rows_local, rows_local)
+            kp = kp.at[lph, fof].set(kn.astype(kp.dtype), mode="drop")
+            vp = vp.at[lph, fof].set(vn.astype(vp.dtype), mode="drop")
         # translate the GLOBAL table to this shard's rows: resident
         # pages keep their local row, everything else reads local row 0
         # and is masked out of the partial (the caller's mask already
@@ -908,7 +971,7 @@ def ring_ragged_paged_attention(
         resident = (pt // rows_local) == i          # (R, NP)
         lpt = jnp.where(resident, pt % rows_local, 0)
         if quant:
-            ks_, vs_ = scales
+            ks_, vs_ = rest[0], rest[1]
             k_virt = dequant_pages(kp, ks_, lpt, q_.dtype)
             v_virt = dequant_pages(vp, vs_, lpt, q_.dtype)
         else:
@@ -956,13 +1019,17 @@ def ring_ragged_paged_attention(
         l0 = jnp.zeros_like(l_loc)
         o, m, l = lax.fori_loop(0, n, merge_j, (o0, m0, l0))
         out = o / jnp.maximum(l, 1e-20)[..., None]
-        return out.astype(q_.dtype).reshape(R, C, H, dk)
+        out = out.astype(q_.dtype).reshape(R, C, H, dk)
+        if fused is not None:
+            return out, kp, vp
+        return out
 
     rep = P(None, None, None, None)
+    pool_spec = P(SEQ_AXIS, None, None, None)
     in_specs = [
         rep,                                  # q
-        P(SEQ_AXIS, None, None, None),        # k_pool rows
-        P(SEQ_AXIS, None, None, None),        # v_pool rows
+        pool_spec,                            # k_pool rows
+        pool_spec,                            # v_pool rows
         P(None, None),                        # page table (global)
         P(None, None, None),                  # mask
     ]
@@ -972,8 +1039,23 @@ def ring_ragged_paged_attention(
         operands += [
             k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)
         ]
+    out_specs: Any = rep
+    if fused is not None:
+        # the prologue's operands replicate (every shard sees every
+        # fresh line and keeps only its resident ones); the updated
+        # pools come back seq-sharded exactly as they went in
+        in_specs += [P(None, None, None, None), P(None, None, None, None)]
+        operands += [fused["k_new"], fused["v_new"]]
+        if has_rope:
+            in_specs += [P(None, None, None), P(None, None, None)]
+            operands += [fused["cos"], fused["sin"]]
+        in_specs += [P(None, None), P(None, None)]
+        operands += [
+            fused["phys"].astype(jnp.int32), fused["off"].astype(jnp.int32)
+        ]
+        out_specs = (rep, pool_spec, pool_spec)
     fn = shard_map_unchecked(
-        body, mesh, tuple(in_specs), rep, manual_axes={SEQ_AXIS}
+        body, mesh, tuple(in_specs), out_specs, manual_axes={SEQ_AXIS}
     )
     # partial-manual shard_map has no eager impl on jax 0.4.x — jit the
     # call (a no-op inside the engine's already-jitted step programs,
@@ -1127,3 +1209,276 @@ def fused_rope_paged_attention(
         return out.reshape(R, C, H, dk), k_pool, v_pool, ks, vs
     out, k_pool, v_pool = outs
     return out.reshape(R, C, H, dk), k_pool, v_pool, None, None
+
+
+# ---------------------------------------------------------------------------
+# Whole-step decode megakernel (ServingConfig.fused_decode=("whole_step",);
+# MPK "Mega-Kernelizing Tensor Programs", PAPERS.md). PR 6 collapsed the
+# decode step to ONE dispatched program, but inside that program XLA
+# still runs L independent layer kernels, each round-tripping the (R, D)
+# hidden state and re-fetching its weights from HBM per step.
+# :func:`whole_step_decode` is the next multiple: ONE persistent
+# ``pallas_call`` whose GRID WALKS THE LAYERS — grid step l computes
+# layer l's full block (QKV projections, RoPE + KV page commit, ragged
+# paged attention over the table, out-projection, MLP) with the hidden
+# state carried in VMEM scratch, and the final grid step runs the
+# epilogue (final norm, LM head, greedy argmax). Layer l's weights are
+# delivered by BlockSpec index maps over the stacked (L, ...) parameter
+# arrays, which is exactly Pallas's pipelined-grid contract: while grid
+# step l computes, the DMA engines prefetch grid step l+1's blocks into
+# the revolving VMEM buffers — double-buffered HBM→VMEM weight
+# streaming without hand-written semaphores. The KV pool's per-layer
+# slices stream the same way and alias their outputs, so only layer l's
+# pages are resident at a time.
+#
+# Division of labor: THIS builder owns the grid, the streaming
+# BlockSpecs, the aliasing, the hidden-state carry and the epilogue;
+# the model family supplies ``block_fn``/``head_fn`` — closures over
+# the SAME per-layer math its unfused XLA step runs
+# (models/*.serve_step_paged's block body, op for op). That sharing is
+# the bitwise contract: given identical inputs the kernel body executes
+# identical operations, so whole-step decode is BITWISE the unfused XLA
+# step on the same backend (fp and int8 pools; int4 under the PR-7
+# packed-nibble tolerance documented in README) — the same way PR 6's
+# fusions anchor on the XLA step as the CPU-parity reference.
+#
+# VMEM budget: one grid step must hold 2× (double buffer) each layer's
+# weight blocks + 2× its K/V pool slice (in + aliased out) + the
+# resident constants (lm_head, mask, embed when tied) + the scratch
+# carry + attention intermediates. :func:`whole_step_vmem_bytes` prices
+# this; the engine compares it against WHOLE_STEP_VMEM_BUDGET (~a TPU
+# core's usable VMEM, overridable via FF_WHOLE_STEP_VMEM_MB) and FALLS
+# BACK to the PR-6 per-layer fusions when it does not fit (big models
+# need weight sub-block streaming — ROADMAP item 5b). README
+# "Whole-step decode megakernel" carries the math.
+
+
+#: bytes of VMEM one grid step of the whole-step program may occupy
+#: before the engine falls back to the PR-6 per-layer fusion path;
+#: ~16 MB is a TPU core's VMEM (pallas_guide.md), minus headroom.
+WHOLE_STEP_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def whole_step_vmem_bytes(
+    layer_arrays: Dict[str, jnp.ndarray],
+    head_arrays: Dict[str, jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],
+    x0: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_heads: int,
+) -> int:
+    """Estimate the per-grid-step VMEM working set of
+    :func:`whole_step_decode` (see the section comment): 2× the layer
+    weight blocks and 2× the per-layer pool slices (stream double
+    buffering + aliased outputs), the resident constants, the f32
+    hidden-state intermediates and the (R, C, H, S_virt) f32 attention
+    score/probability pair."""
+    per_layer = sum(
+        int(a.nbytes) // a.shape[0] for a in layer_arrays.values()
+    )
+    pool = sum(int(a.nbytes) // a.shape[0] for a in cache.values())
+    const = sum(int(a.nbytes) for a in head_arrays.values())
+    const += int(x0.nbytes) + int(mask.nbytes)
+    R, C, S = mask.shape
+    scores = 2 * 4 * R * C * num_heads * S        # scores + probs, f32
+    hidden = 6 * 4 * R * C * x0.shape[-1]         # f32 block temporaries
+    return 2 * per_layer + 2 * pool + const + scores + hidden
+
+
+def whole_step_decode(
+    layer_arrays: Dict[str, jnp.ndarray],  # each (L, ...): streamed blocks
+    head_arrays: Dict[str, jnp.ndarray],   # resident epilogue params
+    x0: jnp.ndarray,            # (R, C, D) embedded step input
+    cos: Optional[jnp.ndarray],  # (R, C, rot) f32, or None (no-RoPE family)
+    sin: Optional[jnp.ndarray],
+    cache: Dict[str, jnp.ndarray],  # k/v (L, P+1, ps, KV, dkp) [+ scales]
+    page_table: jnp.ndarray,    # (R, NP) int32
+    phys: jnp.ndarray,          # (R, C) int32 physical page per new line
+    off: jnp.ndarray,           # (R, C) int32 in-page offset per new line
+    mask: jnp.ndarray,          # (R, C, NP*ps) bool
+    logits_idx: jnp.ndarray,    # (R,) int32
+    *,
+    block_fn: Callable,
+    head_fn: Callable,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """ONE persistent Pallas program for the FULL decode step (see the
+    section comment above): grid = (L,), layer weights and KV pool
+    slices streamed per grid step (double-buffered by the Pallas
+    pipeline), hidden state carried in VMEM scratch, epilogue fused
+    into the last grid step.
+
+    ``block_fn(p_l, x, cos, sin, mask, k, v, ks, vs, phys, off,
+    page_table) -> (x, k, v, ks, vs)`` runs one layer on VALUES —
+    the model family passes the same math its unfused XLA step runs.
+    ``head_fn(head, x, logits_idx) -> (R, V) f32`` is the epilogue.
+
+    Returns ``(logits (R, V) f32, greedy_tokens (R,) int32,
+    new_cache)`` — the greedy tokens are the fused sampling epilogue's
+    argmax head (``sample_tokens`` mode="greedy", in-kernel); non-greedy
+    batches sample from the returned logits in the same jitted program.
+    """
+    L = cache["k"].shape[0]
+    R, C, D = x0.shape
+    quant = "k_scale" in cache
+    has_rope = cos is not None
+    layer_names = sorted(layer_arrays)
+    head_names = sorted(head_arrays)
+
+    def _const(spec_shape):
+        nd = len(spec_shape)
+        return pl.BlockSpec(
+            spec_shape, lambda l, _nd=nd: (0,) * _nd
+        )
+
+    in_specs = []
+    operands = []
+    # streamed per-layer weight blocks: index map walks the layer dim —
+    # the Pallas pipeline prefetches step l+1's blocks during step l
+    for name in layer_names:
+        a = layer_arrays[name]
+        if a.shape[0] != L:
+            raise ValueError(
+                f"whole_step_decode: layer array {name!r} leading dim "
+                f"{a.shape[0]} != num layers {L}"
+            )
+        nd = a.ndim - 1
+        in_specs.append(pl.BlockSpec(
+            (1,) + a.shape[1:], lambda l, _nd=nd: (l,) + (0,) * _nd
+        ))
+        operands.append(a)
+    # streamed + aliased KV pool slices (and quant scale rows)
+    pool_names = ["k", "v"] + (["k_scale", "v_scale"] if quant else [])
+    pool_in_idx = {}
+    for name in pool_names:
+        a = cache[name]
+        nd = a.ndim - 1
+        pool_in_idx[name] = len(operands)
+        in_specs.append(pl.BlockSpec(
+            (1,) + a.shape[1:], lambda l, _nd=nd: (l,) + (0,) * _nd
+        ))
+        operands.append(a)
+    # resident (constant index map) operands
+    const_ops = [x0]
+    const_specs = [_const((R, C, D))]
+    if has_rope:
+        const_ops += [cos, sin]
+        const_specs += [_const(cos.shape), _const(sin.shape)]
+    const_ops += [
+        page_table.astype(jnp.int32), phys.astype(jnp.int32),
+        off.astype(jnp.int32), logits_idx.astype(jnp.int32), mask,
+    ]
+    const_specs += [
+        _const(page_table.shape), _const(phys.shape), _const(off.shape),
+        _const(logits_idx.shape), _const(mask.shape),
+    ]
+    for name in head_names:
+        const_ops.append(head_arrays[name])
+        const_specs.append(_const(head_arrays[name].shape))
+    in_specs += const_specs
+    operands += const_ops
+
+    # epilogue output shapes: probe the head on abstract values
+    head_abs = {n: head_arrays[n] for n in head_names}
+    V = jax.eval_shape(
+        lambda h, x, li: head_fn(h, x, li),
+        head_abs, jnp.zeros((R, C, D), x0.dtype),
+        logits_idx.astype(jnp.int32),
+    ).shape[-1]
+
+    out_shapes = [
+        jax.ShapeDtypeStruct((R, V), jnp.float32),       # logits
+        jax.ShapeDtypeStruct((R,), jnp.int32),           # greedy tokens
+    ]
+    out_specs = [_const((R, V)), _const((R,))]
+    aliases = {}
+    for j, name in enumerate(pool_names):
+        a = cache[name]
+        nd = a.ndim - 1
+        out_shapes.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+        out_specs.append(pl.BlockSpec(
+            (1,) + a.shape[1:], lambda l, _nd=nd: (l,) + (0,) * _nd
+        ))
+        aliases[pool_in_idx[name]] = 2 + j
+
+    def kernel(*refs):
+        i = 0
+        p_l = {}
+        for name in layer_names:
+            p_l[name] = refs[i][0]
+            i += 1
+        pool_refs = {}
+        for name in pool_names:
+            pool_refs[name] = refs[i]
+            i += 1
+        x0_ref = refs[i]; i += 1
+        if has_rope:
+            cos_ref = refs[i]; i += 1
+            sin_ref = refs[i]; i += 1
+        pt_ref = refs[i]; i += 1
+        ph_ref = refs[i]; i += 1
+        of_ref = refs[i]; i += 1
+        li_ref = refs[i]; i += 1
+        mask_ref = refs[i]; i += 1
+        head_vals = {}
+        for name in head_names:
+            head_vals[name] = refs[i][...]
+            i += 1
+        logits_ref = refs[i]; i += 1
+        tok_ref = refs[i]; i += 1
+        pool_out = {}
+        for name in pool_names:
+            pool_out[name] = refs[i]
+            i += 1
+        x_scr = refs[i]
+
+        l = pl.program_id(0)
+
+        @pl.when(l == 0)
+        def _():
+            x_scr[:] = x0_ref[...]
+
+        x = x_scr[:]
+        cs = cos_ref[...] if has_rope else None
+        sn = sin_ref[...] if has_rope else None
+        kb = pool_refs["k"][0]
+        vb = pool_refs["v"][0]
+        ks = pool_refs["k_scale"][0] if quant else None
+        vs = pool_refs["v_scale"][0] if quant else None
+        x, kb, vb, ks, vs = block_fn(
+            p_l, x, cs, sn, mask_ref[...], kb, vb, ks, vs,
+            ph_ref[...], of_ref[...], pt_ref[...],
+        )
+        pool_out["k"][0] = kb
+        pool_out["v"][0] = vb
+        if quant:
+            pool_out["k_scale"][0] = ks
+            pool_out["v_scale"][0] = vs
+        x_scr[:] = x
+
+        @pl.when(l == L - 1)
+        def _():
+            logits = head_fn(head_vals, x, li_ref[...])
+            logits_ref[...] = logits
+            # fused sampling epilogue, greedy head: op-for-op
+            # serve/sampling.sample_tokens mode="greedy" (logits are
+            # already f32 — the astype there is a no-op)
+            tok_ref[...] = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(L,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[pltpu.VMEM((R, C, D), x0.dtype)],
+        ),
+        input_output_aliases=aliases,
+        interpret=_interpret(),
+    )(*operands)
+    logits, toks = outs[0], outs[1]
+    new_cache = dict(cache)
+    for j, name in enumerate(pool_names):
+        new_cache[name] = outs[2 + j]
+    return logits, toks, new_cache

@@ -965,10 +965,15 @@ class RequestManager:
         if last is None:
             last = jnp.zeros((R,), jnp.int32)
         self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
         toks = self.engine.run_decode(
             last, host_tokens, use_last, positions, sub, greedy, temp, topp,
             topk,
         )
+        # decode_step_ms (bench serve_megakernel; ROADMAP 5b): the
+        # engine call's host wall time — dispatch cost on this
+        # pipelined path (the device runs ahead; no sync is added)
+        self.stats.note_decode_step_ms((time.perf_counter() - t0) * 1e3)
         self._mirror_dispatch(
             last, host_tokens, use_last, positions,
             np.zeros((R,), np.int32), sub, greedy, temp, topp, topk,
@@ -1268,8 +1273,11 @@ class RequestManager:
             return bool(self.pending)
         prefilling = self._active(RequestStatus.PREFILLING)
         decoding = self._active(RequestStatus.DECODING)
+        decode_only = bool(decoding) and not prefilling
+        t0 = time.perf_counter()
+        fused = self.engine.serving.fused_decode
         if (
-            "sampling" in self.engine.serving.fused_decode
+            ("sampling" in fused or "whole_step" in fused)
             and self.supports_fused_sampling
         ):
             # fused sampling epilogue: ONE dispatched program per sync
@@ -1287,6 +1295,12 @@ class RequestManager:
         else:
             logits = self._run_batch(bc)
             sampled = self._sample(logits)
+        if decode_only:
+            # decode_step_ms, sync path: the full blocking step wall
+            # time (dispatch + fetch — this path syncs by design)
+            self.stats.note_decode_step_ms(
+                (time.perf_counter() - t0) * 1e3
+            )
         for req in decoding:
             req.n_cached += 1
             req.n_sched = req.n_cached

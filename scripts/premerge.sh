@@ -42,6 +42,12 @@
 #                           scale_out warm joins / scale_in drains
 #                           leak-free / set_pools under traffic,
 #                           replica-death + manager-death chaos
+#  12. whole-step megakernel — the one-program layer walk bitwise the
+#                           unfused XLA step over fp/int8/int4 pools,
+#                           TP2 exact-collective bitwise + int8
+#                           EQuARX tolerance, strictly-fewer-launches,
+#                           VMEM fallback, ring fused-prologue lift,
+#                           whole-step retrace churn
 #
 # Exits non-zero at the first failing gate. Full tier-1 (ROADMAP.md
 # "Tier-1 verify") is the merge bar; this is the fast inner loop.
@@ -50,49 +56,49 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== premerge 1/11: ffcheck (static hazard lint)" >&2
+echo "== premerge 1/12: ffcheck (static hazard lint)" >&2
 python scripts/ffcheck.py
 
-echo "== premerge 2/11: family serve-API re-exports" >&2
+echo "== premerge 2/12: family serve-API re-exports" >&2
 python scripts/check_family_reexports.py
 
-echo "== premerge 3/11: fused decode parity + retrace guard" >&2
+echo "== premerge 3/12: fused decode parity + retrace guard" >&2
 # unfiltered: runs the interpret-mode Pallas e2e tests that tier-1
 # slow-marks for time-budget reasons
 python -m pytest tests/test_fused_decode.py tests/test_retrace_guard.py \
     -q -p no:cacheprovider
 
-echo "== premerge 4/11: hierarchical KV cache (int4 + host spill)" >&2
+echo "== premerge 4/12: hierarchical KV cache (int4 + host spill)" >&2
 # Pallas/XLA nibble-unpack parity, bitwise cold/warm/spilled-readmit
 # generation parity over fp+int8+int4 pools, spill-tier bookkeeping
 python -m pytest tests/test_kv_hierarchy.py -q -p no:cacheprovider
 
-echo "== premerge 5/11: cluster serving (router + migration)" >&2
+echo "== premerge 5/12: cluster serving (router + migration)" >&2
 # router units, cluster-vs-bare-engine bitwise parity, disaggregated
 # prefill→decode migration over fp/int8/int4, shed-is-terminal
 python -m pytest tests/test_cluster.py -q -p no:cacheprovider
 
-echo "== premerge 6/11: fault-tolerant cluster serving" >&2
+echo "== premerge 6/12: fault-tolerant cluster serving" >&2
 # health state machine + circuit breaker, deterministic FaultPlan
 # injection, replica-death failover bitwise vs the fault-free run,
 # seeded chaos (every request terminal, zero leaks on survivors),
 # migration queue back-pressure, pool-death fallbacks
 python -m pytest tests/test_cluster_faults.py -q -p no:cacheprovider
 
-echo "== premerge 7/11: adaptive speculation" >&2
+echo "== premerge 7/12: adaptive speculation" >&2
 # tree-shaping controller units, spec==incremental bitwise parity over
 # fp/int8/int4 pools + prefix-cache hits + continuous-batching churn,
 # early-exit self-draft, cluster SSM-mirror smoke
 python -m pytest tests/test_adaptive_spec.py -q -p no:cacheprovider
 
-echo "== premerge 8/11: context-parallel long-context serving" >&2
+echo "== premerge 8/12: context-parallel long-context serving" >&2
 # striped allocator invariants, CP-vs-single-shard bitwise parity
 # (fp/int8; int4 at tolerance), chunked prefill across shards, spill/
 # readmit + preemption under CP, ring shard_map kernel parity on a
 # seq=2 mesh, CP retrace churn (one program per step key)
 python -m pytest tests/test_long_context.py -q -p no:cacheprovider
 
-echo "== premerge 9/11: replica RPC transport + warm standbys" >&2
+echo "== premerge 9/12: replica RPC transport + warm standbys" >&2
 # unfiltered: runs the int8/int4 loopback parity params and the
 # subprocess replica-server tests that tier-1 slow-marks — wire-codec
 # byte-exactness, loopback cluster bitwise the in-process PR-8/9
@@ -101,7 +107,7 @@ echo "== premerge 9/11: replica RPC transport + warm standbys" >&2
 # gaps + the one-observation-per-step guard, warm-standby adoption
 python -m pytest tests/test_transport.py -q -p no:cacheprovider
 
-echo "== premerge 10/11: observability (tracing + export + recorder)" >&2
+echo "== premerge 10/12: observability (tracing + export + recorder)" >&2
 # unfiltered: runs the subprocess-replica envelope-shipping test and
 # the trace-determinism re-run that tier-1 slow-marks — stitched
 # fault-injected loopback timeline (one trace id across both replicas
@@ -113,7 +119,7 @@ echo "== premerge 10/11: observability (tracing + export + recorder)" >&2
 # dispatched-programs-per-step)
 python -m pytest tests/test_observability.py -q -p no:cacheprovider
 
-echo "== premerge 11/11: elastic control plane (journal + reconfigure)" >&2
+echo "== premerge 11/12: elastic control plane (journal + reconfigure)" >&2
 # unfiltered: runs the int8 kill-restart, subprocess reconnect and
 # sigkill-chaos tests that tier-1 slow-marks — journal round-trip +
 # torn-tail truncation + compaction, manager kill-restart bitwise the
@@ -122,5 +128,14 @@ echo "== premerge 11/11: elastic control plane (journal + reconfigure)" >&2
 # under traffic bitwise vs static membership, seeded replica+manager
 # death chaos
 python -m pytest tests/test_elastic.py -q -p no:cacheprovider
+
+echo "== premerge 12/12: whole-step decode megakernel" >&2
+# unfiltered: runs the quantized e2e generation-parity params, the
+# TP2 int8-collective generation run and the whole-step retrace churn
+# that tier-1 slow-marks — collectives units (exact == psum bitwise,
+# int8 tolerance), the fp/int8/int4 whole-vs-unfused bitwise matrix,
+# TP2 exact bitwise, launch accounting, VMEM fallback, and the lifted
+# rope_kv_write × kv_shard='context' ring prologue
+python -m pytest tests/test_whole_step.py -q -p no:cacheprovider
 
 echo "premerge: all gates passed" >&2
