@@ -3582,17 +3582,23 @@ def serve_megakernel_bench(on_tpu, kernels):
       base        fused_decode=()                   step + host decode head
       pr6         ("rope_kv_write", "sampling")     the PR-6 per-layer fusions
       whole_step  ("whole_step",)                   ONE layer-walking program
+      whole_step_sub  whole_step under a squeezed FF_WHOLE_STEP_VMEM_MB
+                    budget: the engine must pick a SUB-BLOCK tile count
+                    (weight column streaming) instead of falling back
       whole_step+q  whole_step × quantized_allreduce="int8" on a TP2 mesh
                     (EQuARX collectives; skipped below 2 devices)
 
     Reports decode_step_ms p50/p99 (now sourced from SchedulerStats —
     the scheduler's own reservoir, derived decode_step_ms_p50 summary),
-    dispatched programs per decode step, and the program_launch_count
-    structural launch proxy. Asserts BITWISE output parity of base /
-    pr6 / whole_step, greedy parity of the quantized-collective arm vs
+    dispatched programs per decode AND mixed step, and the
+    program_launch_count structural launch proxy for both step shapes.
+    Asserts BITWISE output parity of base / pr6 / whole_step /
+    whole_step_sub, greedy parity of the quantized-collective arm vs
     its exact twin, zero steady-state recompiles everywhere, whole_step
-    at ONE dispatched program per decode step, and STRICTLY fewer
-    kernel launches than the PR-6 fused step.
+    at ONE dispatched program per decode step, the sub-block arm at
+    tiles>1 with whole_step_fallbacks == 0, ONE dispatched program per
+    mixed step, and STRICTLY fewer kernel launches than the PR-6 fused
+    decode step / the unfused mixed step.
 
     Measurement caveat (CPU): the whole-step walk runs interpret-mode
     Pallas off-TPU, so its decode_step_ms is an interpreter artifact —
@@ -3601,6 +3607,7 @@ def serve_megakernel_bench(on_tpu, kernels):
     serve_fused's rope_kv_write row). pr6/base run kernels=xla off-TPU
     for the same reason."""
     import functools
+    import os
 
     import jax
     import jax.numpy as jnp
@@ -3649,26 +3656,48 @@ def serve_megakernel_bench(on_tpu, kernels):
         eng = InferenceEngine(llama, cfg, params, sc, mesh=mesh)
         return RequestManager(eng)
 
-    def run(fused, mesh=None, collective=None, kern=None):
-        rm = make_rm(fused, mesh, collective, kern)
+    def run(fused, mesh=None, collective=None, kern=None, env_mb=None):
+        # env_mb: FF_WHOLE_STEP_VMEM_MB override scoped to ENGINE
+        # CONSTRUCTION (the VMEM gate prices once, at __init__) — the
+        # sub-block ablation squeezes the budget to force tiles>1
+        old = os.environ.get("FF_WHOLE_STEP_VMEM_MB")
+        if env_mb is not None:
+            os.environ["FF_WHOLE_STEP_VMEM_MB"] = repr(env_mb)
+        try:
+            rm = make_rm(fused, mesh, collective, kern)
+        finally:
+            if env_mb is not None:
+                if old is None:
+                    os.environ.pop("FF_WHOLE_STEP_VMEM_MB", None)
+                else:
+                    os.environ["FF_WHOLE_STEP_VMEM_MB"] = old
         rm.supports_fast_decode = False  # sync: true per-step wall time
         rm.generate(prompts, max_new_tokens=2)   # warm every step key
         rm.stats = type(rm.stats)()
         eng = rm.engine
         rids = [rm.submit(p, max_new_tokens=n_new) for p in prompts]
         decode_dispatches, n_decode = 0, 0
+        mixed_dispatches, n_mixed = 0, 0
         t0 = time.perf_counter()
         while True:
             decode_only = (
                 rm._active(RequestStatus.DECODING)
                 and not rm._active(RequestStatus.PREFILLING)
+                and not rm.pending
             )
+            # admission happens INSIDE step(): a step with queued or
+            # half-prefilled requests is a prefill/mixed step
+            mixed = bool(rm.pending
+                         or rm._active(RequestStatus.PREFILLING))
             d0 = eng.dispatch_count
             if not rm.step():
                 break
             if decode_only:
                 decode_dispatches += eng.dispatch_count - d0
                 n_decode += 1
+            elif mixed:
+                mixed_dispatches += eng.dispatch_count - d0
+                n_mixed += 1
         rm.drain()
         wall = time.perf_counter() - t0
         outs = [list(rm.requests[r].output_tokens) for r in rids]
@@ -3683,8 +3712,16 @@ def serve_megakernel_bench(on_tpu, kernels):
             "p99_ms": stats["decode_step_ms_p99"],
             "dispatches_per_step": decode_dispatches / max(1, n_decode),
             "decode_steps": n_decode,
+            "mixed_dispatches_per_step": mixed_dispatches / max(1, n_mixed),
+            "mixed_steps": n_mixed,
             "retraces": stats["retraces"],
-            "whole_step_on": getattr(rm.engine, "whole_step_on", False),
+            "whole_step_on": getattr(eng, "whole_step_on", False),
+            "whole_step_mixed_on": getattr(eng, "whole_step_mixed_on",
+                                           False),
+            "tiles": getattr(eng, "whole_step_tiles", 1),
+            "mixed_tiles": getattr(eng, "whole_step_mixed_tiles", 1),
+            "fallbacks": getattr(eng, "whole_step_fallbacks", 0),
+            "vmem_est": getattr(eng, "whole_step_vmem_est", 0),
         }
 
     res = {
@@ -3695,6 +3732,57 @@ def serve_megakernel_bench(on_tpu, kernels):
     }
     assert res["whole_step"]["whole_step_on"], (
         "whole_step fell back — VMEM pricing tripped on the bench shape"
+    )
+
+    # sub-block ablation: price the walk exactly the way the engine's
+    # VMEM gate does, then squeeze FF_WHOLE_STEP_VMEM_MB between the
+    # untiled working set and the first sub-block tiling so the engine
+    # MUST stream weight column sub-tiles (tiles>1) — not fall back
+    from flexflow_tpu.serve import kernels as _pk
+    probe = make_rm(()).engine
+    layer_arrays, head_arrays = llama.whole_step_weight_layout(
+        params, cfg
+    )
+    roles = llama.whole_step_tile_roles(cfg)
+    S_virt = probe.serving.pages_per_slot * probe.serving.page_size
+    Cm = probe.serving.prefill_chunk
+
+    def est(tiles, C):
+        x0 = np.zeros((n_slots, C, cfg.hidden_size),
+                      jnp.dtype(cfg.dtype))
+        m = np.zeros((n_slots, C, S_virt), np.bool_)
+        return _pk.whole_step_vmem_bytes(
+            layer_arrays, head_arrays, probe.cache, x0, m,
+            cfg.num_attention_heads, tiles=tiles, tile_roles=roles,
+        )
+
+    force = next(
+        t for t in _pk.whole_step_tile_candidates(layer_arrays, roles)
+        if t > 1
+    )
+    lo = max(est(force, 1), est(force, Cm))   # tiles=force must fit...
+    hi = est(1, 1)                            # ...untiled decode must not
+    assert lo < hi, (
+        f"bench shape can't isolate sub-block streaming: tiles={force} "
+        f"floor {lo} >= untiled working set {hi}"
+    )
+    del probe
+    res["whole_step_sub"] = run(
+        ("whole_step",), env_mb=(lo + hi) / 2 / (1024 * 1024)
+    )
+    sub = res["whole_step_sub"]
+    assert sub["whole_step_on"] and sub["fallbacks"] == 0, (
+        "sub-block ablation fell back — the squeezed budget must yield "
+        f"a tile count, not a fallback (fallbacks={sub['fallbacks']})"
+    )
+    assert sub["tiles"] > 1, (
+        "sub-block ablation picked tiles=1 — the squeezed budget "
+        "failed to force weight sub-block streaming"
+    )
+    assert sub["whole_step_mixed_on"] and sub["mixed_tiles"] > 1, (
+        "sub-block ablation must run the WHOLE-STEP MIXED walk with "
+        f"sub-block streaming (mixed_on={sub['whole_step_mixed_on']}, "
+        f"mixed_tiles={sub['mixed_tiles']})"
     )
     tp_ok = len(jax.devices()) >= 2
     if tp_ok:
@@ -3710,7 +3798,7 @@ def serve_megakernel_bench(on_tpu, kernels):
              "quantized-allreduce ablation")
 
     base = res["base"]
-    for name in ("base", "pr6", "whole_step"):
+    for name in ("base", "pr6", "whole_step", "whole_step_sub"):
         r = res[name]
         assert r["outputs"] == base["outputs"], (
             f"{name} generations diverged — whole-step decode must be "
@@ -3720,10 +3808,17 @@ def serve_megakernel_bench(on_tpu, kernels):
         assert r["retraces"] == 0, (
             f"{name}: {r['retraces']} steady-state recompiles"
         )
-    assert res["whole_step"]["dispatches_per_step"] == 1.0, (
-        "whole-step decode must stay ONE dispatched program: "
-        f"{res['whole_step']['dispatches_per_step']:.2f}"
-    )
+    for name in ("whole_step", "whole_step_sub"):
+        assert res[name]["dispatches_per_step"] == 1.0, (
+            f"{name} decode must stay ONE dispatched program: "
+            f"{res[name]['dispatches_per_step']:.2f}"
+        )
+        assert res[name]["mixed_dispatches_per_step"] == 1.0, (
+            f"{name} mixed steps must be ONE dispatched program "
+            "(the whole-step mixed walk): "
+            f"{res[name]['mixed_dispatches_per_step']:.2f} over "
+            f"{res[name]['mixed_steps']} steps"
+        )
     assert (res["whole_step"]["dispatches_per_step"]
             <= res["pr6"]["dispatches_per_step"] + 1e-9)
     assert (res["whole_step"]["dispatches_per_step"]
@@ -3757,6 +3852,38 @@ def serve_megakernel_bench(on_tpu, kernels):
         "whole-step must execute strictly fewer kernel launches than "
         f"the PR-6 fused step: {n_whole} vs {n_pr6}"
     )
+    # the sub-block walk stays ONE program: the counter recurses into
+    # the kernel body (the tiled walk's slicing adds INTERNAL eqns) but
+    # the O(L)-vs-O(1) launch-site ordering vs the per-layer step holds
+    n_whole_sub = program_launch_count(
+        functools.partial(llama.serve_step_whole, cfg=cfg, cache_len=cl,
+                          tiles=force),
+        params, cache, toks, pos, lidx, pt,
+    )
+    assert n_whole_sub < n_pr6, (
+        "the sub-block walk must keep strictly fewer launch sites than "
+        f"the PR-6 per-layer fused step: {n_whole_sub} vs {n_pr6}"
+    )
+    # mixed step shape: the whole-step MIXED walk vs the unfused
+    # per-layer mixed step at the scheduler's prefill chunk
+    toks_m = jnp.zeros((R, Cm), jnp.int32)
+    pos_m = jnp.broadcast_to(
+        jnp.arange(Cm, dtype=jnp.int32)[None, :], (R, Cm)
+    )
+    n_whole_mixed = program_launch_count(
+        functools.partial(llama.serve_step_whole, cfg=cfg, cache_len=cl),
+        params, cache, toks_m, pos_m, lidx, pt,
+    )
+    n_unfused_mixed = program_launch_count(
+        functools.partial(llama.serve_step_paged, cfg=cfg, cache_len=cl,
+                          kernels="xla"),
+        params, cache, toks_m, pos_m, lidx, None, None, pt,
+    )
+    assert n_whole_mixed < n_unfused_mixed, (
+        "the whole-step mixed walk must execute strictly fewer kernel "
+        f"launches than the unfused mixed step: {n_whole_mixed} vs "
+        f"{n_unfused_mixed}"
+    )
 
     detail = {}
     for name, r in res.items():
@@ -3766,6 +3893,9 @@ def serve_megakernel_bench(on_tpu, kernels):
         detail[f"{name}_dispatches_per_step"] = round(
             r["dispatches_per_step"], 2
         )
+        detail[f"{name}_mixed_dispatches_per_step"] = round(
+            r["mixed_dispatches_per_step"], 2
+        )
     emit(
         "whole_step_launches_per_decode_step",
         n_whole,
@@ -3773,6 +3903,9 @@ def serve_megakernel_bench(on_tpu, kernels):
         # <1: the walk's structural launch count vs the PR-6 fused step
         vs_baseline=n_whole / max(1, n_pr6),
         pr6_launches_per_step=n_pr6,
+        subblock_launches_per_step=n_whole_sub,
+        mixed_launches_per_step=n_whole_mixed,
+        unfused_mixed_launches_per_step=n_unfused_mixed,
         kernels=base_kernels,
         platform=_platform(),
     )
@@ -3798,6 +3931,13 @@ def serve_megakernel_bench(on_tpu, kernels):
         n_slots=n_slots,
         new_tokens_per_request=n_new,
         decode_steps_measured=res["whole_step"]["decode_steps"],
+        # sub-block streaming ablation: the squeezed budget forced a
+        # tile count (not a fallback), bitwise the unfused step
+        subblock_tiles=sub["tiles"],
+        subblock_mixed_tiles=sub["mixed_tiles"],
+        subblock_whole_step_fallbacks=sub["fallbacks"],
+        subblock_vmem_est_bytes=sub["vmem_est"],
+        whole_step_vmem_est_bytes=res["whole_step"]["vmem_est"],
         **detail,
         platform=_platform(),
     )

@@ -1244,11 +1244,78 @@ def _whole_head_fn(cfg: LLaMAConfig, head, x, logits_idx):
     return jnp.matmul(x, hm, preferred_element_type=jnp.float32)[:, 0]
 
 
+def whole_step_tile_roles(
+    cfg: LLaMAConfig,
+) -> Dict[str, Tuple[str, Optional[str]]]:
+    """Sub-block streaming roles for this family
+    (serve/kernels._whole_step_decode_tiled): which per-layer weight
+    each canonical column-tiled role names, plus its bias (LLaMA
+    projections are bias-free). w1 gates, w3 lifts, w2 closes — the
+    SwiGLU naming of :func:`_block_paged_xla`."""
+    return {
+        "q": ("wq", None), "k": ("wk", None), "v": ("wv", None),
+        "o": ("wo", None), "gate": ("w1", None), "up": ("w3", None),
+        "down": ("w2", None),
+    }
+
+
+def _whole_tile_plan(cfg: LLaMAConfig, qmax):
+    """Closure bundle for the sub-block streaming walk — the SAME ops
+    :func:`_block_paged_xla` runs, split at the projection boundaries
+    so the kernel can column-tile each matmul (the elementwise and
+    residual pieces act slice-locally, so the tiled walk stays bitwise
+    the unfused step)."""
+    from ..serve import kernels as _pk
+
+    def pre_fn(p, x):
+        return _rms(x, p["attn_norm"], cfg.rms_norm_eps)
+
+    def attend_fn(p, q, k, v, cs, sn, mask, kb, vb, ks, vs, ph, of, pt):
+        dk = cfg.head_dim
+        R, C, _ = q.shape
+        q = q.reshape(R, C, -1, dk)
+        k = k.reshape(R, C, -1, dk)
+        v = v.reshape(R, C, -1, dk)
+        q = apply_rope(q, cs, sn)
+        k = apply_rope(k, cs, sn)
+        if qmax is not None:
+            from ..serve.kv_quant import quant_line_write
+
+            kb, ks = quant_line_write(kb, ks, ph, of, k, qmax)
+            vb, vs = quant_line_write(vb, vs, ph, of, v, qmax)
+        else:
+            kb = kb.at[ph, of].set(k.astype(kb.dtype))
+            vb = vb.at[ph, of].set(v.astype(vb.dtype))
+        if qmax is not None:
+            k_virt = _pk.dequant_pages(kb, ks, pt, q.dtype)
+            v_virt = _pk.dequant_pages(vb, vs, pt, q.dtype)
+        else:
+            k_virt = _pk.gather_pages(kb, pt)
+            v_virt = _pk.gather_pages(vb, pt)
+        attn = _attend_paged_xla(cfg, q, k_virt, v_virt, mask)
+        return attn, kb, vb, ks, vs
+
+    def mid_fn(p, x, h, x2):
+        return _rms(x2, p["ffn_norm"], cfg.rms_norm_eps)
+
+    def act_fn(g, u):
+        return jax.nn.silu(g) * u
+
+    return {
+        "roles": whole_step_tile_roles(cfg),
+        "mm_fn": _mm,
+        "pre_fn": pre_fn,
+        "attend_fn": attend_fn,
+        "mid_fn": mid_fn,
+        "act_fn": act_fn,
+    }
+
+
 def serve_step_whole(
     params: Dict[str, Any],
     cache: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,      # (R, 1) int32 — decode rows only
-    positions: jnp.ndarray,   # (R, 1) int32
+    tokens: jnp.ndarray,      # (R, C) int32 — C=1 decode, C>1 mixed
+    positions: jnp.ndarray,   # (R, C) int32
     logits_idx: jnp.ndarray,  # (R,) int32 (zeros at C=1)
     page_table: jnp.ndarray,  # (R, NP) int32
     *,
@@ -1257,14 +1324,21 @@ def serve_step_whole(
     kv_quant: Optional[str] = None,
     tp_mesh=None,
     collective: str = "exact",
+    tiles: int = 1,
 ):
-    """The WHOLE decode step as one program (ROADMAP 5b, MPK-style):
-    embedding, all L layers (QKV → RoPE + KV page commit → ragged paged
-    attention → out-proj → MLP), final norm, LM head and the greedy
-    sampling epilogue. Single-shard meshes run it as ONE persistent
+    """The WHOLE serving step as one program (ROADMAP 5a/5b,
+    MPK-style): embedding, all L layers (QKV → RoPE + KV page commit →
+    ragged paged attention → out-proj → MLP), final norm, LM head and
+    the greedy sampling epilogue. ``C == 1`` is the decode step;
+    ``C > 1`` is the whole-step MIXED step — chunked prefill and decode
+    rows walk the same persistent program, each row's head read at its
+    own ``logits_idx``. Single-shard meshes run it as ONE persistent
     Pallas program whose grid walks the layers with double-buffered
-    weight streaming (serve/kernels.whole_step_decode); TP meshes run
-    the collective-explicit walk — the same per-layer body under a
+    weight streaming (serve/kernels.whole_step_decode); ``tiles > 1``
+    (the engine's VMEM gate, for layers whose working set exceeds the
+    budget) streams each projection weight in output-column sub-tiles
+    over an inner grid dimension instead of falling back. TP meshes
+    run the collective-explicit walk — the same per-layer body under a
     manual ``model``-axis shard_map with ONE
     ``serve/collectives.tp_allreduce`` per row-parallel matmul
     (quantized EQuARX codes when ``collective="int8"``, literally
@@ -1274,7 +1348,8 @@ def serve_step_whole(
     new_cache)``. Bitwise contract: logits, greedy tokens and
     non-scratch pool bytes are identical to
     :func:`serve_step_paged`(kernels="xla") on the same backend (exact
-    collective mode; "int8" is a documented-tolerance trade)."""
+    collective mode; "int8" is a documented-tolerance trade) — at any
+    tile count, because tiles split only matmul OUTPUT columns."""
     R, C = tokens.shape
     ps = cache["k"].shape[2]
     x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
@@ -1289,6 +1364,12 @@ def serve_step_whole(
     from ..core.mesh import MODEL_AXIS
 
     if tp_mesh is not None and tp_mesh.shape.get(MODEL_AXIS, 1) > 1:
+        if tiles > 1:
+            raise ValueError(
+                "whole-step sub-block streaming (tiles > 1) is not "
+                "composed with the TP walk — the collective-explicit "
+                "path is per-layer XLA, not one kernel"
+            )
         return _serve_step_whole_tp(
             params, cache, x, cos, sin, mask, phys, off, page_table,
             logits_idx, cfg=cfg, qmax=qmax, mesh=tp_mesh,
@@ -1305,10 +1386,11 @@ def serve_step_whole(
     def head_fn(head, xv, li):
         return _whole_head_fn(cfg, head, xv, li)
 
+    plan = _whole_tile_plan(cfg, qmax) if tiles > 1 else None
     return _pk.whole_step_decode(
         layer_arrays, head_arrays, x, cos, sin, cache, page_table,
         phys, off, mask, logits_idx.astype(jnp.int32),
-        block_fn=block_fn, head_fn=head_fn,
+        block_fn=block_fn, head_fn=head_fn, tiles=tiles, tile_plan=plan,
     )
 
 

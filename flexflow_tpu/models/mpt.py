@@ -34,6 +34,7 @@ from .transformer import (  # noqa: F401  (engine serving protocol)
     serve_step,
     serve_step_paged,
     serve_step_whole,
+    whole_step_tile_roles,
     whole_step_weight_layout,
 )
 from .hf_utils import linear_w, stack, to_np

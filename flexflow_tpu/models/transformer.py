@@ -1421,11 +1421,93 @@ def _whole_head_fn(cfg: DecoderConfig, head, x, logits_idx):
     return logits[:, 0]
 
 
+def whole_step_tile_roles(
+    cfg: DecoderConfig,
+) -> Dict[str, Tuple[str, Optional[str]]]:
+    """Sub-block streaming roles for the generic decoder
+    (serve/kernels._whole_step_decode_tiled): the canonical
+    column-tiled projection roles mapped to this family's weight and
+    bias names — biases ride per cfg flag, "gate" only for GLU MLPs."""
+    roles = {
+        "q": ("wq", "bq" if cfg.qkv_bias else None),
+        "k": ("wk", "bk" if cfg.qkv_bias else None),
+        "v": ("wv", "bv" if cfg.qkv_bias else None),
+        "o": ("wo", "bo" if cfg.out_bias else None),
+        "up": ("w_up", "b_up" if cfg.mlp_bias else None),
+        "down": ("w_down", "b_down" if cfg.mlp_bias else None),
+    }
+    if cfg.glu:
+        roles["gate"] = ("w_gate", "b_gate" if cfg.mlp_bias else None)
+    return roles
+
+
+def _whole_tile_plan(cfg: DecoderConfig, qmax):
+    """Closure bundle for the sub-block streaming walk — the SAME ops
+    :func:`_block_paged_xla` runs, split at the projection boundaries
+    (see the llama twin). ``mid_fn`` carries the parallel-block norm
+    routing: parallel blocks feed the MLP the pre-attention norm (or
+    their second norm), sequential blocks norm the post-attention
+    residual."""
+    from ..serve import kernels as _pk
+
+    def pre_fn(p, x):
+        return _norm(cfg, x, p["attn_norm_scale"],
+                     p.get("attn_norm_bias"))
+
+    def attend_fn(p, q, k, v, cs, sn, mask, kb, vb, ks, vs, ph, of, pt):
+        dk = cfg.head_dim
+        R, C, _ = q.shape
+        q = q.reshape(R, C, -1, dk)
+        k = k.reshape(R, C, -1, dk)
+        v = v.reshape(R, C, -1, dk)
+        if cs is not None:
+            q, k = apply_rope(q, cs, sn), apply_rope(k, cs, sn)
+        if qmax is not None:
+            from ..serve.kv_quant import quant_line_write
+
+            kb, ks = quant_line_write(kb, ks, ph, of, k, qmax)
+            vb, vs = quant_line_write(vb, vs, ph, of, v, qmax)
+        else:
+            kb = kb.at[ph, of].set(k.astype(kb.dtype))
+            vb = vb.at[ph, of].set(v.astype(vb.dtype))
+        if qmax is not None:
+            k_virt = _pk.dequant_pages(kb, ks, pt, q.dtype)
+            v_virt = _pk.dequant_pages(vb, vs, pt, q.dtype)
+        else:
+            k_virt = _pk.gather_pages(kb, pt)
+            v_virt = _pk.gather_pages(vb, pt)
+        attn = _attend_paged_xla(cfg, q, k_virt, v_virt, None, mask)
+        return attn, kb, vb, ks, vs
+
+    def mid_fn(p, x, h, x2):
+        if cfg.parallel_block:
+            if cfg.parallel_two_norms:
+                return _norm(cfg, x, p["mlp_norm_scale"],
+                             p.get("mlp_norm_bias"))
+            return h
+        return _norm(cfg, x2, p["mlp_norm_scale"],
+                     p.get("mlp_norm_bias"))
+
+    def act_fn(g, u):
+        if g is not None:
+            return _activation(cfg, g) * u
+        return _activation(cfg, u)
+
+    return {
+        "roles": whole_step_tile_roles(cfg),
+        "mm_fn": _mm,
+        "pre_fn": pre_fn,
+        "attend_fn": attend_fn,
+        "mid_fn": mid_fn,
+        "act_fn": act_fn,
+    }
+
+
 def serve_step_whole(
     params: Dict[str, Any],
     cache: Dict[str, jnp.ndarray],
-    tokens: jnp.ndarray,      # (R, 1) int32 — decode rows only
-    positions: jnp.ndarray,   # (R, 1) int32
+    tokens: jnp.ndarray,      # (R, C) int32 — C=1 decode, C>1 mixed
+    positions: jnp.ndarray,   # (R, C) int32
     logits_idx: jnp.ndarray,  # (R,) int32
     page_table: jnp.ndarray,  # (R, NP) int32
     *,
@@ -1434,12 +1516,16 @@ def serve_step_whole(
     kv_quant: Optional[str] = None,
     tp_mesh=None,
     collective: str = "exact",
+    tiles: int = 1,
 ):
-    """The WHOLE decode step as one program — the generic-decoder twin
+    """The WHOLE serving step as one program — the generic-decoder twin
     of models/llama.serve_step_whole (same contract: returns
     ``(logits, greedy_tokens, new_cache)``, bitwise the unfused
     kernels="xla" step on the same backend under the "exact"
-    collective)."""
+    collective). ``C == 1`` is the decode step, ``C > 1`` the
+    whole-step mixed step; ``tiles > 1`` streams each projection
+    weight in output-column sub-tiles (the engine's VMEM gate picks
+    the count — see the llama twin)."""
     from ..serve.kernels import paged_serve_mask
 
     R, C = tokens.shape
@@ -1458,6 +1544,12 @@ def serve_step_whole(
     from ..core.mesh import MODEL_AXIS
 
     if tp_mesh is not None and tp_mesh.shape.get(MODEL_AXIS, 1) > 1:
+        if tiles > 1:
+            raise ValueError(
+                "whole-step sub-block streaming (tiles > 1) is not "
+                "composed with the TP walk — the collective-explicit "
+                "path is per-layer XLA, not one kernel"
+            )
         return _serve_step_whole_tp(
             params, cache, x, rope, mask, phys, off, page_table,
             logits_idx, cfg=cfg, qmax=qmax, mesh=tp_mesh,
@@ -1477,10 +1569,11 @@ def serve_step_whole(
     def head_fn(head, xv, li):
         return _whole_head_fn(cfg, head, xv, li)
 
+    plan = _whole_tile_plan(cfg, qmax) if tiles > 1 else None
     return _pk.whole_step_decode(
         layer_arrays, head_arrays, x, cos, sin, cache, page_table,
         phys, off, mask, logits_idx.astype(jnp.int32),
-        block_fn=block_fn, head_fn=head_fn,
+        block_fn=block_fn, head_fn=head_fn, tiles=tiles, tile_plan=plan,
     )
 
 

@@ -109,10 +109,12 @@ SCHED_COUNTERS = frozenset({
     "prefix_evictions", "prefix_cows",
     "spills", "readmits", "host_hit_tokens",
     "spec_rounds", "spec_drafted", "spec_accepted", "spec_resizes",
-    "ring_steps", "compiles", "retraces",
+    "ring_steps", "compiles", "retraces", "whole_step_fallbacks",
 })
 #: SchedulerStats fields exported verbatim as gauges.
-SCHED_GAUGES = frozenset({"host_bytes", "cp_shards", "shard_balance"})
+SCHED_GAUGES = frozenset({
+    "host_bytes", "cp_shards", "shard_balance", "whole_step_vmem_est",
+})
 #: SchedulerStats fields NOT exported verbatim — each maps to the
 #: derived snapshot() gauge that replaces it on the scrape surface.
 SCHED_EXCLUDED = {

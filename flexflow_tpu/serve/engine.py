@@ -686,10 +686,19 @@ class InferenceEngine:
                     f"unknown fused_decode entry {name!r} (expected "
                     "'rope_kv_write', 'sampling' and/or 'whole_step')"
                 )
-        # Whole-step decode megakernel (serve/kernels.whole_step_decode):
-        # capability-gated at construction; whole_step_on may still flip
-        # to False below if the VMEM pricing says the walk cannot fit.
+        # Whole-step megakernel (serve/kernels.whole_step_decode):
+        # capability-gated at construction. The VMEM gate below picks a
+        # sub-block tile count per step shape (1 = untiled walk);
+        # whole_step_on only flips to False when even the finest legal
+        # tiling cannot fit the budget (whole_step_fallbacks counts
+        # those, mirrored into SchedulerStats). whole_step_mixed_on
+        # extends the walk to the C>1 mixed/chunked-prefill step.
         self.whole_step_on = False
+        self.whole_step_tiles = 1
+        self.whole_step_mixed_on = False
+        self.whole_step_mixed_tiles = 1
+        self.whole_step_fallbacks = 0
+        self.whole_step_vmem_est = 0
         from .collectives import resolve_mode as _resolve_collective
 
         self.collective_mode = _resolve_collective(
@@ -813,56 +822,123 @@ class InferenceEngine:
                 )
         self.cache = self._alloc_cache()
         if self.whole_step_on:
-            self._price_whole_step()
+            self._whole_step_vmem_gate()
 
-    def _price_whole_step(self):
-        """VMEM pricing of the whole-step walk (single-shard meshes —
-        the TP walk is collective-explicit XLA, not one kernel): when
-        one grid step's working set (double-buffered weight blocks +
-        in/out pool slices + resident constants + intermediates,
-        serve/kernels.whole_step_vmem_bytes) exceeds the budget
-        (kernels.WHOLE_STEP_VMEM_BUDGET; FF_WHOLE_STEP_VMEM_MB
-        overrides), the walk cannot fit on chip and the engine FALLS
-        BACK to the PR-6 per-layer fused path — logged loudly, never a
-        silent downgrade. README "Whole-step decode megakernel" carries
-        the budget math; sub-block weight streaming is the lift
-        (ROADMAP 5b)."""
+    @staticmethod
+    def _whole_step_vmem_budget() -> int:
+        """Resolve the whole-step VMEM budget: the kernel default
+        (kernels.WHOLE_STEP_VMEM_BUDGET) unless FF_WHOLE_STEP_VMEM_MB
+        overrides it. A malformed override raises a ValueError NAMING
+        the env var — never an unhandled float() traceback mid-
+        construction."""
         import os
 
+        from . import kernels as _pk
+
+        env = os.environ.get("FF_WHOLE_STEP_VMEM_MB")
+        if not env:
+            return _pk.WHOLE_STEP_VMEM_BUDGET
+        try:
+            mb = float(env)
+        except ValueError:
+            raise ValueError(
+                f"FF_WHOLE_STEP_VMEM_MB={env!r} is not a number — set "
+                "the whole-step VMEM budget override in megabytes "
+                "(e.g. FF_WHOLE_STEP_VMEM_MB=14), or unset it for the "
+                "kernel default"
+            ) from None
+        if mb <= 0:
+            raise ValueError(
+                f"FF_WHOLE_STEP_VMEM_MB={env!r} must be positive — "
+                "the whole-step VMEM budget is a size in megabytes"
+            )
+        return int(mb * 1024 * 1024)
+
+    def _whole_step_vmem_gate(self):
+        """VMEM gate of the whole-step walk (single-shard meshes — the
+        TP walk is collective-explicit XLA, not one kernel): for each
+        step shape the walk serves (the C=1 decode step; the C=
+        mixed-chunk mixed step) pick the SMALLEST sub-block tile count
+        whose priced working set (serve/kernels.whole_step_vmem_bytes)
+        fits the budget (kernels.WHOLE_STEP_VMEM_BUDGET;
+        FF_WHOLE_STEP_VMEM_MB overrides). Geometries whose layer does
+        not fit untiled get a tile count, NOT a fallback — the walk's
+        projection weights stream in output-column sub-tiles
+        (serve/kernels._whole_step_decode_tiled), so the footprint is
+        bounded by the tile size. The only remaining fallback is a
+        budget below the walk's irreducible floor (pool slices +
+        resident constants + accumulators), which no tiling can shrink;
+        that flips the path off loudly and bumps
+        ``whole_step_fallbacks`` (mirrored into SchedulerStats /
+        ClusterStats). README "Whole-step decode megakernel" carries
+        the budget math."""
         from ..core.mesh import MODEL_AXIS
         from . import kernels as _pk
+        from ..logging_utils import get_logger
 
         if self.mesh.shape.get(MODEL_AXIS, 1) > 1:
             return  # TP walk: per-layer XLA programs, no VMEM gate
-        budget = _pk.WHOLE_STEP_VMEM_BUDGET
-        env = os.environ.get("FF_WHOLE_STEP_VMEM_MB")
-        if env:
-            budget = int(float(env) * 1024 * 1024)
+        budget = self._whole_step_vmem_budget()
         layer_arrays, head_arrays = self.model.whole_step_weight_layout(
             self.params, self.cfg
         )
+        tile_roles = self.model.whole_step_tile_roles(self.cfg)
         R = self.num_slots
         D = self.cfg.hidden_size
         S_virt = self.serving.pages_per_slot * self.serving.page_size
-        x0 = np.zeros((R, 1, D), jnp.dtype(self.cfg.dtype))
-        mask = np.zeros((R, 1, S_virt), np.bool_)
-        est = _pk.whole_step_vmem_bytes(
-            layer_arrays, head_arrays, self.cache, x0, mask,
-            self.cfg.num_attention_heads,
-        )
-        self.whole_step_vmem_est = int(est)
-        if est > budget:
-            from ..logging_utils import get_logger
 
+        def pick(C):
+            x0 = np.zeros((R, C, D), jnp.dtype(self.cfg.dtype))
+            mask = np.zeros((R, C, S_virt), np.bool_)
+            return _pk.whole_step_pick_tiles(
+                layer_arrays, head_arrays, self.cache, x0, mask,
+                self.cfg.num_attention_heads,
+                tile_roles=tile_roles, budget=budget,
+            )
+
+        tiles, est = pick(1)
+        self.whole_step_vmem_est = int(est)
+        if tiles is None:
+            self.whole_step_fallbacks += 1
             get_logger("serve").warning(
-                "whole_step: estimated per-layer VMEM working set "
-                "%.1f MB exceeds the %.1f MB budget — falling back to "
-                "the PR-6 per-layer fused decode path (raise "
-                "FF_WHOLE_STEP_VMEM_MB to override, or shrink the "
-                "pool/model; README 'Whole-step decode megakernel')",
+                "whole_step: even the finest sub-block tiling prices "
+                "%.1f MB against the %.1f MB budget (the pool slices + "
+                "resident constants + accumulators floor) — falling "
+                "back to the PR-6 per-layer fused decode path (raise "
+                "FF_WHOLE_STEP_VMEM_MB, or shrink the pool/model; "
+                "README 'Whole-step decode megakernel')",
                 est / 1e6, budget / 1e6,
             )
             self.whole_step_on = False
+            return
+        self.whole_step_tiles = int(tiles)
+        if tiles > 1:
+            get_logger("serve").info(
+                "whole_step: layer working set over budget untiled — "
+                "streaming weight sub-blocks at tiles=%d (%.1f MB "
+                "priced vs %.1f MB budget)",
+                tiles, est / 1e6, budget / 1e6,
+            )
+        # the whole-step MIXED step: the same walk over the (R, C)
+        # chunked-prefill step shape, priced at the widest chunk the
+        # scheduler dispatches
+        C = self.serving.prefill_chunk
+        if C <= 1:
+            self.whole_step_mixed_on = True
+            self.whole_step_mixed_tiles = self.whole_step_tiles
+            return
+        mtiles, mest = pick(C)
+        if mtiles is None:
+            self.whole_step_fallbacks += 1
+            get_logger("serve").warning(
+                "whole_step: the C=%d mixed step prices %.1f MB "
+                "against the %.1f MB budget at every tiling — decode "
+                "keeps the walk, mixed steps keep the per-layer path",
+                C, mest / 1e6, budget / 1e6,
+            )
+            return
+        self.whole_step_mixed_on = True
+        self.whole_step_mixed_tiles = int(mtiles)
 
     @property
     def pipelined(self) -> bool:
@@ -1166,11 +1242,12 @@ class InferenceEngine:
             )
         return self._steps[key_id]
 
-    def _serve_whole_fn(self) -> Callable:
+    def _serve_whole_fn(self, tiles: int = 1) -> Callable:
         """model.serve_step_whole bound to this engine's static kwargs
         (the whole-step layer walk — serve/kernels.whole_step_decode on
         single-shard meshes, the collective-explicit TP walk
-        otherwise)."""
+        otherwise). ``tiles`` is the VMEM gate's sub-block tile count
+        for the step shape being compiled (1 = untiled walk)."""
         from ..core.mesh import MODEL_AXIS
 
         tp = self.mesh.shape.get(MODEL_AXIS, 1)
@@ -1181,30 +1258,44 @@ class InferenceEngine:
             kv_quant=self.serving.kv_quant,
             tp_mesh=self.mesh if tp > 1 else None,
             collective=self.collective_mode,
+            tiles=tiles,
         )
 
     def _get_whole_step(self, with_logits: bool, sample_mode: str,
-                        topk_cap: int):
-        """The whole-step decode program (fused_decode=("whole_step",)):
+                        topk_cap: int, chunk: int = 1):
+        """The whole-step program (fused_decode=("whole_step",)):
         token select (device feedback vs host) → the ONE-program layer
-        walk (model.serve_step_whole) → the sampling epilogue. Greedy
-        batches take the walk's in-kernel argmax head; other modes
-        sample from the walk's logits inside the same jitted program —
-        either way ONE dispatched program per decode step, with
-        strictly fewer kernel launches than the per-layer fused step
-        (:func:`program_launch_count` is the measured proxy)."""
-        key_id = ("whole_step", sample_mode, topk_cap, with_logits)
+        walk (model.serve_step_whole) → the sampling epilogue.
+        ``chunk == 1`` is the decode step; ``chunk > 1`` the whole-step
+        MIXED step (chunked prefill + decode in the same walk — the
+        columns past the token select ride through like the fused
+        mixed step's). Greedy batches take the walk's in-kernel argmax
+        head; other modes sample from the walk's logits inside the
+        same jitted program — either way ONE dispatched program per
+        step, with strictly fewer kernel launches than the per-layer
+        path (:func:`program_launch_count` is the measured proxy). The
+        step key carries the chunk and the gate's tile count, so each
+        (shape, tiling) compiles exactly once."""
+        tiles = (self.whole_step_tiles if chunk == 1
+                 else self.whole_step_mixed_tiles)
+        key_id = ("whole_step", chunk, tiles, sample_mode, topk_cap,
+                  with_logits)
         if key_id not in self._steps:
             from .sampling import sample_tokens
 
-            fn = self._serve_whole_fn()
+            fn = self._serve_whole_fn(tiles)
             mode = sample_mode or "full"
 
             def step(params, cache, last_tokens, host_tokens, use_last,
                      positions, logits_idx, key, greedy, temperature,
                      topp, topk, page_table=None):
                 first = jnp.where(use_last, last_tokens, host_tokens[:, 0])
-                tokens = first[:, None]
+                if chunk == 1:
+                    tokens = first[:, None]
+                else:
+                    tokens = jnp.concatenate(
+                        [first[:, None], host_tokens[:, 1:]], axis=1
+                    )
                 logits, gtoks, cache = fn(
                     params, cache, tokens, positions, logits_idx,
                     page_table,
@@ -1237,9 +1328,13 @@ class InferenceEngine:
         if self.paged:
             kw["page_table"] = self.page_table_device()
         host_tokens = np.asarray(host_tokens)
-        if self.whole_step_on and host_tokens.shape[1] == 1:
-            # the whole-step megakernel owns the C==1 decode step; the
-            # sampling epilogue is part of the walk's contract
+        if self.whole_step_on and (
+            host_tokens.shape[1] == 1 or self.whole_step_mixed_on
+        ):
+            # the whole-step megakernel owns the C==1 decode step AND —
+            # when the VMEM gate priced the chunked shape — the C>1
+            # mixed step; the sampling epilogue is part of the walk's
+            # contract
             return self._run_whole(
                 last_tokens, host_tokens, use_last, positions,
                 logits_idx, key, greedy, temperature, topp, topk,
@@ -1291,19 +1386,24 @@ class InferenceEngine:
     def _run_whole(self, last_tokens, host_tokens, use_last, positions,
                    logits_idx, key, greedy, temperature, topp, topk,
                    with_logits, kw):
-        """Dispatch ONE whole-step decode program (run_mixed's C==1
-        route with fused_decode=("whole_step",)): same argument
+        """Dispatch ONE whole-step program (run_mixed's route with
+        fused_decode=("whole_step",) — the C==1 decode walk, or the
+        C>1 mixed walk when the gate enabled it): same argument
         contract, same pinned-dtype conversion, same donation — the
         step key is mode-tagged like the fused sampling head's."""
         from .sampling import choose_sample_mode
 
+        host_tokens = np.asarray(host_tokens)
+        chunk = int(host_tokens.shape[1])
         mode, cap = choose_sample_mode(
             greedy, topp, topk, self.cfg.vocab_size
         )
         donated = self.cache
-        self.count_dispatch("whole_step")
+        self.count_dispatch(
+            "whole_step" if chunk == 1 else "whole_step_mixed"
+        )
         with _set_mesh(self.mesh):
-            step = self._get_whole_step(with_logits, mode, cap)
+            step = self._get_whole_step(with_logits, mode, cap, chunk)
             out = step(
                 self.params,
                 self.cache,
@@ -1321,10 +1421,10 @@ class InferenceEngine:
             )
         if with_logits:
             toks, logits, self.cache = out
-            self._poison_donated(donated, ("whole_step", mode, cap))
+            self._poison_donated(donated, ("whole_step", chunk, mode, cap))
             return toks, logits
         toks, self.cache = out
-        self._poison_donated(donated, ("whole_step", mode, cap))
+        self._poison_donated(donated, ("whole_step", chunk, mode, cap))
         return toks
 
     def run_decode(self, last_tokens, host_tokens, use_last, positions,
@@ -1395,13 +1495,15 @@ class InferenceEngine:
                 self._dump_debug(bc)
         if (
             self.whole_step_on
-            and bc.chunk == 1
+            and (bc.chunk == 1 or self.whole_step_mixed_on)
             and bc.mask is None
             and bc.cache_positions is None
         ):
-            # pure decode sync step: same whole-step program (and step
-            # key) the pipelined path compiles — use_last all-False
-            # feeds the host tokens through the same token select
+            # sync decode step — or sync chunked-prefill/mixed step
+            # when the gate enabled the mixed walk: same whole-step
+            # program (and step key) the pipelined path compiles —
+            # use_last all-False feeds the host tokens through the
+            # same token select
             R = self.num_slots
             kw = {}
             if self.paged:

@@ -1210,6 +1210,15 @@ class RequestManager:
             self.stats.cp_shards = cp
             self.stats.ring_steps += cp - 1
             self.stats.shard_balance = self.engine.pager.shard_balance()
+        # whole-step VMEM gate telemetry (engine._whole_step_vmem_gate)
+        # mirrored the same way, so BENCH_r*.json and the Prometheus
+        # scrape track when the walk is actually taken vs fallen back
+        self.stats.whole_step_fallbacks = getattr(
+            self.engine, "whole_step_fallbacks", 0
+        )
+        self.stats.whole_step_vmem_est = getattr(
+            self.engine, "whole_step_vmem_est", 0
+        )
         if self._step_counter % 200 == 0:
             self._log.debug("%s", self.stats.report())
 

@@ -75,6 +75,7 @@ SERVE_API = (
     # the hook at construction to gate capability and price VMEM)
     "serve_step_whole",
     "whole_step_weight_layout",
+    "whole_step_tile_roles",
     # triage + params
     "serve_debug_activations",
     "forward",

@@ -48,6 +48,14 @@
 #                           EQuARX tolerance, strictly-fewer-launches,
 #                           VMEM fallback, ring fused-prologue lift,
 #                           whole-step retrace churn
+#  13. sub-block streaming  — the VMEM-gated sub-block weight walk:
+#                           FF_WHOLE_STEP_VMEM_MB parse hardening,
+#                           tile pricing/selection units, tiled-walk
+#                           bitwise parity over fp/int8/int4, the
+#                           whole-step MIXED walk one-dispatch, gate
+#                           telemetry through SchedulerStats/Cluster-
+#                           Stats, 7B-class over-budget geometry auto-
+#                           picking tiles, tile-count retrace churn
 #
 # Exits non-zero at the first failing gate. Full tier-1 (ROADMAP.md
 # "Tier-1 verify") is the merge bar; this is the fast inner loop.
@@ -56,49 +64,49 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== premerge 1/12: ffcheck (static hazard lint)" >&2
+echo "== premerge 1/13: ffcheck (static hazard lint)" >&2
 python scripts/ffcheck.py
 
-echo "== premerge 2/12: family serve-API re-exports" >&2
+echo "== premerge 2/13: family serve-API re-exports" >&2
 python scripts/check_family_reexports.py
 
-echo "== premerge 3/12: fused decode parity + retrace guard" >&2
+echo "== premerge 3/13: fused decode parity + retrace guard" >&2
 # unfiltered: runs the interpret-mode Pallas e2e tests that tier-1
 # slow-marks for time-budget reasons
 python -m pytest tests/test_fused_decode.py tests/test_retrace_guard.py \
     -q -p no:cacheprovider
 
-echo "== premerge 4/12: hierarchical KV cache (int4 + host spill)" >&2
+echo "== premerge 4/13: hierarchical KV cache (int4 + host spill)" >&2
 # Pallas/XLA nibble-unpack parity, bitwise cold/warm/spilled-readmit
 # generation parity over fp+int8+int4 pools, spill-tier bookkeeping
 python -m pytest tests/test_kv_hierarchy.py -q -p no:cacheprovider
 
-echo "== premerge 5/12: cluster serving (router + migration)" >&2
+echo "== premerge 5/13: cluster serving (router + migration)" >&2
 # router units, cluster-vs-bare-engine bitwise parity, disaggregated
 # prefill→decode migration over fp/int8/int4, shed-is-terminal
 python -m pytest tests/test_cluster.py -q -p no:cacheprovider
 
-echo "== premerge 6/12: fault-tolerant cluster serving" >&2
+echo "== premerge 6/13: fault-tolerant cluster serving" >&2
 # health state machine + circuit breaker, deterministic FaultPlan
 # injection, replica-death failover bitwise vs the fault-free run,
 # seeded chaos (every request terminal, zero leaks on survivors),
 # migration queue back-pressure, pool-death fallbacks
 python -m pytest tests/test_cluster_faults.py -q -p no:cacheprovider
 
-echo "== premerge 7/12: adaptive speculation" >&2
+echo "== premerge 7/13: adaptive speculation" >&2
 # tree-shaping controller units, spec==incremental bitwise parity over
 # fp/int8/int4 pools + prefix-cache hits + continuous-batching churn,
 # early-exit self-draft, cluster SSM-mirror smoke
 python -m pytest tests/test_adaptive_spec.py -q -p no:cacheprovider
 
-echo "== premerge 8/12: context-parallel long-context serving" >&2
+echo "== premerge 8/13: context-parallel long-context serving" >&2
 # striped allocator invariants, CP-vs-single-shard bitwise parity
 # (fp/int8; int4 at tolerance), chunked prefill across shards, spill/
 # readmit + preemption under CP, ring shard_map kernel parity on a
 # seq=2 mesh, CP retrace churn (one program per step key)
 python -m pytest tests/test_long_context.py -q -p no:cacheprovider
 
-echo "== premerge 9/12: replica RPC transport + warm standbys" >&2
+echo "== premerge 9/13: replica RPC transport + warm standbys" >&2
 # unfiltered: runs the int8/int4 loopback parity params and the
 # subprocess replica-server tests that tier-1 slow-marks — wire-codec
 # byte-exactness, loopback cluster bitwise the in-process PR-8/9
@@ -107,7 +115,7 @@ echo "== premerge 9/12: replica RPC transport + warm standbys" >&2
 # gaps + the one-observation-per-step guard, warm-standby adoption
 python -m pytest tests/test_transport.py -q -p no:cacheprovider
 
-echo "== premerge 10/12: observability (tracing + export + recorder)" >&2
+echo "== premerge 10/13: observability (tracing + export + recorder)" >&2
 # unfiltered: runs the subprocess-replica envelope-shipping test and
 # the trace-determinism re-run that tier-1 slow-marks — stitched
 # fault-injected loopback timeline (one trace id across both replicas
@@ -119,7 +127,7 @@ echo "== premerge 10/12: observability (tracing + export + recorder)" >&2
 # dispatched-programs-per-step)
 python -m pytest tests/test_observability.py -q -p no:cacheprovider
 
-echo "== premerge 11/12: elastic control plane (journal + reconfigure)" >&2
+echo "== premerge 11/13: elastic control plane (journal + reconfigure)" >&2
 # unfiltered: runs the int8 kill-restart, subprocess reconnect and
 # sigkill-chaos tests that tier-1 slow-marks — journal round-trip +
 # torn-tail truncation + compaction, manager kill-restart bitwise the
@@ -129,7 +137,7 @@ echo "== premerge 11/12: elastic control plane (journal + reconfigure)" >&2
 # death chaos
 python -m pytest tests/test_elastic.py -q -p no:cacheprovider
 
-echo "== premerge 12/12: whole-step decode megakernel" >&2
+echo "== premerge 12/13: whole-step decode megakernel" >&2
 # unfiltered: runs the quantized e2e generation-parity params, the
 # TP2 int8-collective generation run and the whole-step retrace churn
 # that tier-1 slow-marks — collectives units (exact == psum bitwise,
@@ -137,5 +145,15 @@ echo "== premerge 12/12: whole-step decode megakernel" >&2
 # TP2 exact bitwise, launch accounting, VMEM fallback, and the lifted
 # rope_kv_write × kv_shard='context' ring prologue
 python -m pytest tests/test_whole_step.py -q -p no:cacheprovider
+
+echo "== premerge 13/13: whole-step sub-block weight streaming" >&2
+# unfiltered: runs the quantized tiled-walk params, the 7B-class
+# over-budget geometry matrix and the tile-count retrace churn that
+# tier-1 slow-marks — FF_WHOLE_STEP_VMEM_MB parse hardening, tile
+# candidate/pricing units, forced-tiles bitwise parity, the
+# whole-step mixed walk's one-dispatch-per-step accounting, VMEM-gate
+# telemetry mirroring, and the default-budget auto-pick on >12 MB/
+# layer geometry (the shape PR 15 used to fall back on)
+python -m pytest tests/test_whole_step_subblock.py -q -p no:cacheprovider
 
 echo "premerge: all gates passed" >&2
