@@ -230,6 +230,13 @@ _PHASES = [
     # bytes / rpc-retry counters and zero steady-state recompiles on
     # every untripped replica
     ("serve_transport", 700, 500, True, True),
+    # concurrent cluster stepping: N=3 loopback replicas behind
+    # threaded transports with an injected per-RPC link delay d —
+    # serial drive loop (~N·d per cluster step) vs the multiplexed
+    # fan-out (~d per step), speedup >= 2.5x asserted with outputs
+    # bitwise identical; cluster_step_ms + per-replica RTT percentiles
+    # and in-flight depth reported, zero steady-state recompiles
+    ("serve_cluster_async", 700, 500, True, True),
     # adaptive speculation: acceptance-driven W×D tree shaping vs the
     # fixed tree (drafted accept rate >=3x asserted) + the early-exit
     # self-draft's tokens/sec vs non-speculative continuous batching
@@ -516,6 +523,30 @@ def orchestrate(which):
             output_parity=d.get("output_parity"),
             platform=d.get("platform"),
         )
+
+    # Derived: the cluster step's round-trip cost under concurrent
+    # stepping — with N replicas fanned out a step costs ~one RTT, not
+    # N — so BENCH_r*.json tracks the O(RTT) drive-loop contract (and
+    # the serial baseline it beat) across rounds.
+    rec = _RESULTS.get("cluster_async_step_speedup")
+    if rec:
+        d = rec.get("detail") or {}
+        if d.get("concurrent_cluster_step_ms_p50") is not None:
+            emit(
+                "cluster_step_rtt_ms",
+                d["concurrent_cluster_step_ms_p50"],
+                "ms",
+                vs_baseline=rec.get("vs_baseline"),
+                source=rec["metric"],
+                serial_cluster_step_ms_p50=d.get(
+                    "serial_cluster_step_ms_p50"),
+                injected_rpc_delay_ms=d.get("injected_rpc_delay_ms"),
+                rpc_rtt_ms_p50=d.get("rpc_rtt_ms_p50"),
+                rpc_inflight_peak=d.get("rpc_inflight_peak"),
+                replicas=d.get("replicas"),
+                output_parity=d.get("output_parity"),
+                platform=d.get("platform"),
+            )
 
     # Derived: decode-step latency, so BENCH_r*.json tracks step time
     # across rounds. The serve_fused phase measures it fused AND
@@ -3403,6 +3434,159 @@ def serve_transport_bench(on_tpu, kernels):
     return warm["post_hit_rate"]
 
 
+def serve_cluster_async_bench(on_tpu, kernels):
+    """Concurrent cluster stepping (serve/cluster/transport.py
+    multiplexed call-tag RPCs + manager.py fan-out drive loop,
+    ``ServingConfig.concurrent_stepping``): N=3 loopback replicas
+    behind THREADED transports with an injected per-RPC link delay d —
+    the regime where the wire, not the compute, dominates a cluster
+    step.
+
+    Two arms on the SAME prompts: (a) SERIAL — the pre-multiplexing
+    drive loop blocks on each replica's step RPC in turn, so a cluster
+    step costs ~N·d on top of the compute; (b) CONCURRENT — every step
+    RPC issues before any harvests, so the N delays overlap and the
+    step costs ~d. ASSERTED: outputs bitwise identical across arms
+    (the determinism contract — completion order never changes cluster
+    behavior), speedup (serial cluster_step_ms p50 / concurrent p50)
+    >= 2.5x at N=3, step RPCs genuinely overlapped
+    (rpc_inflight_peak >= replicas), zero rpc errors, zero
+    steady-state recompiles per replica (strict retrace sanitizer),
+    zero page leaks. Reported: per-arm cluster_step_ms p50/p99, the
+    injected delay, per-RPC RTT p50/p99 and the in-flight depth peak.
+
+    The injected delay is calibrated from the warmup's own measured
+    step time (d = max(60ms, 6× compute) — large enough that the
+    serial arm's N·d separates cleanly from the concurrent arm's d,
+    small enough to keep the phase inside its budget), so the phase is
+    meaningful on CPU and TPU alike: the speedup measures the drive
+    loop's round-trip structure, which is platform-independent."""
+    import time as _time
+
+    import jax
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import ClusterManager, ServingConfig
+
+    cfg = _llm_cfg(on_tpu)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_replicas = 3
+    n_new = 16 if on_tpu else 8
+    prompt_len = 48 if on_tpu else 20
+    if not on_tpu and kernels == "pallas":
+        _log("serve_cluster_async: forcing kernels=xla off-TPU")
+        kernels = "xla"
+
+    prompts = [
+        [(i * 53 + j * 17 + 11) % cfg.vocab_size
+         for j in range(prompt_len)]
+        for i in range(2 * n_replicas)
+    ]
+
+    def build(concurrent):
+        sc = ServingConfig(
+            max_requests_per_batch=4,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=16 if on_tpu else 8,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=64 if on_tpu else 8,
+            replicas=n_replicas,
+            router_policy="round_robin",
+            replica_transport="loopback",
+            concurrent_stepping=concurrent,
+            sanitizers=("retrace",),
+        )
+        return ClusterManager.build(llama, cfg, params, sc)
+
+    def run(concurrent, delay):
+        cm = build(concurrent)
+        # warm: compiles + the sanitizer's steady-state baseline, and
+        # (first arm only) the compute-time estimate the injected
+        # delay is calibrated from
+        cm.generate(prompts, max_new_tokens=n_new)
+        warm_step_ms = cm.stats.cluster_step_ms_p50
+        if delay is None:
+            delay = max(0.06, 6.0 * warm_step_ms / 1000.0)
+        # measured window starts clean: drop the warmup's samples and
+        # switch every link to the threaded worker with the real delay
+        cm.stats.cluster_step_ms_samples.clear()
+        for samples in cm.stats.rpc_rtt_ms_samples.values():
+            samples.clear()
+        for rep in cm.replicas:
+            rep.transport.threaded = True
+            rep.transport.delay_s = delay
+        t0 = _time.perf_counter()
+        outs = [
+            list(r.output_tokens)
+            for r in cm.generate(prompts, max_new_tokens=n_new)
+        ]
+        wall = _time.perf_counter() - t0
+        st = cm.cluster_stats()
+        for pos, rep in enumerate(cm.replicas):
+            assert rep.rm.stats.retraces == 0, (
+                f"replica {pos}: {rep.rm.stats.retraces} steady-state "
+                "recompiles under the delayed link"
+            )
+        cm.check_no_leaks()
+        for rep in cm.replicas:
+            rep.close()
+        return {
+            "outs": outs,
+            "delay": delay,
+            "step_ms_p50": st["cluster_step_ms_p50"],
+            "step_ms_p99": st["cluster_step_ms_p99"],
+            "wall": wall,
+            "stats": st,
+        }
+
+    serial = run(concurrent=False, delay=None)
+    conc = run(concurrent=True, delay=serial["delay"])
+
+    assert conc["outs"] == serial["outs"], (
+        "concurrent stepping changed greedy outputs — the completion-"
+        "order determinism contract is broken"
+    )
+    cs = conc["stats"]
+    assert cs["rpc_errors"] == 0 and serial["stats"]["rpc_errors"] == 0
+    assert cs["rpc_inflight_peak"] >= n_replicas, (
+        f"step RPCs never overlapped (peak {cs['rpc_inflight_peak']})"
+    )
+    speedup = serial["step_ms_p50"] / conc["step_ms_p50"]
+    assert speedup >= 2.5, (
+        f"concurrent stepping {speedup:.2f}x vs serial at "
+        f"N={n_replicas}, injected delay "
+        f"{serial['delay'] * 1000:.0f}ms — the fan-out should "
+        "approach one round-trip per step (>=2.5x)"
+    )
+    emit(
+        "cluster_async_step_speedup",
+        round(speedup, 3),
+        "x",
+        vs_baseline=round(speedup, 3),
+        kernels=kernels,
+        replicas=n_replicas,
+        injected_rpc_delay_ms=round(serial["delay"] * 1000.0, 1),
+        serial_cluster_step_ms_p50=round(serial["step_ms_p50"], 3),
+        serial_cluster_step_ms_p99=round(serial["step_ms_p99"], 3),
+        concurrent_cluster_step_ms_p50=round(conc["step_ms_p50"], 3),
+        concurrent_cluster_step_ms_p99=round(conc["step_ms_p99"], 3),
+        rpc_rtt_ms_p50=round(cs["rpc_rtt_ms_p50"], 3),
+        rpc_rtt_ms_p99=round(cs["rpc_rtt_ms_p99"], 3),
+        rpc_inflight_peak=cs["rpc_inflight_peak"],
+        serial_wall_s=round(serial["wall"], 2),
+        concurrent_wall_s=round(conc["wall"], 2),
+        output_parity=1,
+        errors=0,
+        steady_state_recompiles=0,
+        model_params_b=round(llama.num_params(cfg) / 1e9, 3),
+        platform=_platform(),
+    )
+    return speedup
+
+
 def serve_fused_bench(on_tpu, kernels):
     """Megakernel decode step (serve/kernels.py fused prologue +
     serve/sampling.py fused epilogue, ``ServingConfig.fused_decode``):
@@ -4132,6 +4316,8 @@ def child_main(phase, platform, kernels):
         serve_elastic_bench(on_tpu, kernels)
     elif phase == "serve_transport":
         serve_transport_bench(on_tpu, kernels)
+    elif phase == "serve_cluster_async":
+        serve_cluster_async_bench(on_tpu, kernels)
     elif phase == "serve_7b":
         serve_7b_bench(on_tpu, kernels)
     else:
@@ -4147,7 +4333,8 @@ def main():
                  "serve_paged", "serve_continuous", "serve_prefix",
                  "serve_paged_q", "serve_kv_hierarchy",
                  "serve_long_context", "serve_cluster",
-                 "serve_faults", "serve_elastic", "serve_transport", "serve_fused",
+                 "serve_faults", "serve_elastic", "serve_transport",
+                 "serve_cluster_async", "serve_fused",
                  "serve_megakernel", "serve_int8", "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )

@@ -111,6 +111,30 @@ HOT_ROOTS = {
     "_heartbeat_remote",
     "_check_gap",
     "_observe_failure",
+    # concurrent cluster stepping (multiplexed transport + fan-out
+    # drive loop): the async issue/harvest pair, the per-connection
+    # reader/worker loops that complete futures off-thread, and the
+    # manager's concurrent step — ALL of it is the cluster's
+    # once-per-step critical path, and a blocking device transfer in
+    # an issue phase serializes the very RPCs the fan-out exists to
+    # overlap
+    "call_async",
+    "step_async",
+    "finish_step",
+    "heartbeat_async",
+    "finish_heartbeat",
+    "prefix_score_async",
+    "finish_prefix_score",
+    "prefix_score",
+    "wait",
+    "result",
+    "_prefix_scores",
+    "_step_replicas_serial",
+    "_step_replicas_concurrent",
+    "_apply_step_failure",
+    "_reader_loop",
+    "_worker_loop",
+    "_fail_pending",
     "dispatch",
     "_m_step",
     "_m_heartbeat",

@@ -372,6 +372,22 @@ class ClusterStats:
     standby_adoptions: int = 0
     wire_bytes_sent: int = 0
     wire_bytes_received: int = 0
+    # Concurrent cluster stepping (serve/cluster/manager.py): the
+    # high-water mark of RPCs in flight inside one step's fan-out
+    # (gauge — 0 under the serial reference loop), plus bounded
+    # reservoirs of whole-cluster-step wall time and per-replica
+    # step-RPC round-trip time in milliseconds. The raw sample lists
+    # stay out of Prometheus; the derived ``cluster_step_ms_p50/p99``
+    # and ``rpc_rtt_ms_p50/p99`` properties export as gauges, and
+    # per-replica RTT percentiles ride the snapshot under
+    # ``rpc_rtt_ms_per_replica``.
+    rpc_inflight_peak: int = 0
+    cluster_step_ms_samples: List[float] = dataclasses.field(
+        default_factory=list
+    )
+    rpc_rtt_ms_samples: Dict[int, List[float]] = dataclasses.field(
+        default_factory=dict
+    )
     # Elastic control plane (serve/cluster/{journal,reconfigure}.py):
     # committed reconfigurations by kind (replicas added live, replicas
     # drained + retired, prefill/decode pool flips), journal traffic
@@ -391,6 +407,61 @@ class ClusterStats:
         self.placements[how] = self.placements.get(how, 0) + 1
         if how == "affinity":
             self.affinity_hits += 1
+
+    def note_cluster_step_ms(self, ms: float) -> None:
+        """Record one whole-cluster-step wall sample (bounded
+        reservoir, same trim discipline as decode_step_ms)."""
+        s = self.cluster_step_ms_samples
+        s.append(float(ms))
+        if len(s) > _DECODE_MS_CAP:
+            del s[: len(s) - _DECODE_MS_CAP]
+
+    def note_rpc_rtt_ms(self, replica: int, ms: float) -> None:
+        """Record one step-RPC round-trip sample for ``replica``
+        (bounded per-replica reservoir)."""
+        s = self.rpc_rtt_ms_samples.setdefault(int(replica), [])
+        s.append(float(ms))
+        if len(s) > _DECODE_MS_CAP:
+            del s[: len(s) - _DECODE_MS_CAP]
+
+    @staticmethod
+    def _pct(samples: Sequence[float], q: float) -> float:
+        if not samples:
+            return 0.0
+        ordered = sorted(samples)
+        idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+        return ordered[idx]
+
+    @property
+    def cluster_step_ms_p50(self) -> float:
+        return self._pct(self.cluster_step_ms_samples, 0.50)
+
+    @property
+    def cluster_step_ms_p99(self) -> float:
+        return self._pct(self.cluster_step_ms_samples, 0.99)
+
+    def _all_rtt(self) -> List[float]:
+        return [
+            ms for s in self.rpc_rtt_ms_samples.values() for ms in s
+        ]
+
+    @property
+    def rpc_rtt_ms_p50(self) -> float:
+        return self._pct(self._all_rtt(), 0.50)
+
+    @property
+    def rpc_rtt_ms_p99(self) -> float:
+        return self._pct(self._all_rtt(), 0.99)
+
+    def rpc_rtt_ms_per_replica(self) -> Dict[int, Dict[str, float]]:
+        """Per-replica RTT p50/p99 over the bounded reservoirs."""
+        return {
+            idx: {
+                "p50": round(self._pct(s, 0.50), 3),
+                "p99": round(self._pct(s, 0.99), 3),
+            }
+            for idx, s in sorted(self.rpc_rtt_ms_samples.items())
+        }
 
     def snapshot(
         self, replicas: Sequence["SchedulerStats"] = ()
@@ -460,6 +531,12 @@ class ClusterStats:
             "standby_adoptions": self.standby_adoptions,
             "wire_bytes_sent": self.wire_bytes_sent,
             "wire_bytes_received": self.wire_bytes_received,
+            "rpc_inflight_peak": self.rpc_inflight_peak,
+            "cluster_step_ms_p50": round(self.cluster_step_ms_p50, 3),
+            "cluster_step_ms_p99": round(self.cluster_step_ms_p99, 3),
+            "rpc_rtt_ms_p50": round(self.rpc_rtt_ms_p50, 3),
+            "rpc_rtt_ms_p99": round(self.rpc_rtt_ms_p99, 3),
+            "rpc_rtt_ms_per_replica": self.rpc_rtt_ms_per_replica(),
             "scale_outs": self.scale_outs,
             "scale_ins": self.scale_ins,
             "pool_flips": self.pool_flips,
@@ -487,6 +564,10 @@ class ClusterStats:
             f"failover={s['failovers']} migq={s['migration_queue_depth']} "
             f"rpc_err={s['rpc_errors']} rpc_retry={s['rpc_retries']} "
             f"hb_gaps={s['heartbeat_gaps']} reconn={s['reconnects']} "
+            f"inflight^={s['rpc_inflight_peak']} "
+            f"cstep_ms={s['cluster_step_ms_p50']:.2f}/"
+            f"{s['cluster_step_ms_p99']:.2f} "
+            f"rtt_ms={s['rpc_rtt_ms_p50']:.2f}/{s['rpc_rtt_ms_p99']:.2f} "
             f"standby={s['standby_adoptions']} "
             f"scale+{s['scale_outs']}/-{s['scale_ins']} "
             f"flip={s['pool_flips']} jrnl={s['journal_records']}r/"

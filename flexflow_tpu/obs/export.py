@@ -143,11 +143,24 @@ CLUSTER_COUNTERS = frozenset({
     "journal_replayed",
 })
 CLUSTER_GAUGES = frozenset({
-    "migration_queue_depth", "migration_queue_peak",
+    "migration_queue_depth", "migration_queue_peak", "rpc_inflight_peak",
 })
 #: ``placements`` is a by-how dict — exported as ONE labeled counter
-#: series rather than a scalar field.
-CLUSTER_EXCLUDED = {"placements": "flexflow_cluster_placements{how=...}"}
+#: series rather than a scalar field. The RTT/step-time reservoirs are
+#: host-side sample storage; the scrape surface carries their derived
+#: percentile properties (and per-replica RTT rides the labeled
+#: ``flexflow_cluster_rpc_rtt_ms`` series).
+CLUSTER_EXCLUDED = {
+    "placements": "flexflow_cluster_placements{how=...}",
+    "cluster_step_ms_samples": "cluster_step_ms_p50",
+    "rpc_rtt_ms_samples": "rpc_rtt_ms_p50",
+}
+#: Derived ClusterStats properties exported as gauges alongside the
+#: raw counters (the percentile halves of the excluded reservoirs).
+CLUSTER_DERIVED = (
+    "cluster_step_ms_p50", "cluster_step_ms_p99",
+    "rpc_rtt_ms_p50", "rpc_rtt_ms_p99",
+)
 
 #: ProfileInfo numeric fields aggregated to ``_sum`` counters over the
 #: finished requests handed to the exporter.
@@ -280,12 +293,16 @@ def prometheus_text(
         for field in sorted(CLUSTER_COUNTERS):
             out.add(f"flexflow_cluster_{field}", "counter",
                     getattr(cluster, field))
-        for field in sorted(CLUSTER_GAUGES):
+        for field in sorted(CLUSTER_GAUGES) + list(CLUSTER_DERIVED):
             out.add(f"flexflow_cluster_{field}", "gauge",
                     getattr(cluster, field))
         for how, n in sorted(cluster.placements.items()):
             out.add("flexflow_cluster_placements", "counter", n,
                     {"how": str(how)})
+        for idx, pcts in cluster.rpc_rtt_ms_per_replica().items():
+            for q, v in sorted(pcts.items()):
+                out.add("flexflow_cluster_rpc_rtt_ms", "gauge", v,
+                        {"replica": str(idx), "quantile": q})
     if profiles:
         out.add("flexflow_requests_total", "counter", len(profiles))
         for field in sorted(PROFILE_SUMS):

@@ -46,7 +46,12 @@ heartbeat gaps (counted in deterministic cluster steps) feed the same
 health machine; ``FaultPlan`` grows transport kinds (drop/delay/
 disconnect/partition) injected at the transport; and warm standbys
 (``ServingConfig.standby_replicas``) adopt a DOWN replica's prefix
-families over the wire before taking its routing position.
+families over the wire before taking its routing position. The
+transport is MULTIPLEXED (``ServingConfig.concurrent_stepping``, on by
+default): the drive loop fans every replica's step RPC out at once and
+applies completions in replica-index order — a cluster step costs one
+round-trip instead of N, and completion order provably never changes
+health transitions, failover order or journal contents.
 
 Telemetry: :class:`flexflow_tpu.metrics.ClusterStats` (router counters
 + failover/health/migration-queue counters + rpc/heartbeat/wire-byte/
@@ -86,6 +91,7 @@ from .transport import (
     FrameError,
     LoopbackTransport,
     RemoteError,
+    RpcFuture,
     SocketTransport,
     TransportError,
 )
@@ -123,6 +129,7 @@ __all__ = [
     "ConnectionLost",
     "DeadlineExceeded",
     "RemoteError",
+    "RpcFuture",
     "LoopbackTransport",
     "SocketTransport",
 ]

@@ -1447,29 +1447,13 @@ class ClusterManager:
             return
         self._check_gap(pos, rep, step_no)
 
-    def step(self) -> bool:
-        """One cluster step: advance every steppable replica under the
-        health monitor (remote replicas additionally heartbeat when
-        idle, with gap detection in cluster steps), settle
-        prefill→decode migrations, then run any due failover
-        re-admissions. Returns False when no replica has work left and
-        nothing is pending recovery."""
-        self._step_counter += 1
-        step_no = self._step_counter
-        if self.fault_injector is not None:
-            # scripted manager death (FaultPlan "manager_crash"): the
-            # checkpoint-kill raises HERE, before any replica steps —
-            # the test/bench recovers from the journal where a real
-            # SIGKILL would restart the process
-            self.fault_injector.on_cluster_step(self)
-        tr = self.tracer
-        if tr.enabled and self._pending_trace:
-            # recovery ran before a tracer could attach — its
-            # recover/replay events flush on the first traced step
-            for name, kw in self._pending_trace:
-                tr.event(name, **kw)
-            self._pending_trace = []
-        self._failed_obs = set()
+    def _step_replicas_serial(self, step_no: int) -> bool:
+        """The original one-RPC-at-a-time drive loop — kept verbatim as
+        the reference arm (``ServingConfig.concurrent_stepping=False``,
+        and what the in-process cluster runs): the concurrent loop's
+        contract is to be indistinguishable from THIS, and the
+        ``serve_cluster_async`` bench measures the two against each
+        other."""
         progressed = False
         for pos in range(len(self.replicas)):
             rep = self.replicas[pos]
@@ -1507,11 +1491,197 @@ class ClusterManager:
                 continue
             if remote:
                 rep.last_contact_step = step_no
+                self.stats.note_rpc_rtt_ms(
+                    rep.index, (time.perf_counter() - t0) * 1000.0
+                )
             latency = (time.perf_counter() - t0) + rep.injected_latency_s
             self._note_transition(
                 pos, h.record_success(latency, step_no, had_work=True)
             )
             progressed = stepped or progressed
+        return progressed
+
+    def _step_replicas_concurrent(self, step_no: int) -> bool:
+        """Fan-out drive loop: ISSUE every routable replica's step RPC
+        (and every due idle-replica heartbeat) without blocking, then
+        HARVEST and apply results in replica-index order — N wire
+        round-trips overlap into one (O(RTT), not O(N·RTT)).
+
+        Determinism contract: completion order NEVER changes cluster
+        behavior. Issue runs in replica-index order and only touches
+        per-replica state (fault kinds fire at the serial loop's call
+        site; ``has_work``/heartbeat-due reads are position-local, and
+        nothing the apply phase mutates — health transitions, failover
+        enqueues, migration queues — feeds back into another position's
+        issue decision inside the same step; those all settle AFTER the
+        loop, exactly as in the serial arm). Apply runs in
+        replica-index order on the manager's thread, so the PR-9 health
+        machine, the one-observation-per-step guard, failover order and
+        journal semantics see the SAME sequence of observations the
+        serial loop produced, no matter how responses interleaved on
+        the wire."""
+        progressed = False
+        plan: list = []  # (pos, rep, kind, payload) in replica order
+        inflight = 0
+        for pos in range(len(self.replicas)):
+            rep = self.replicas[pos]
+            h = self.health[pos]
+            if h.state is HealthState.DOWN:
+                if h.maybe_probe(step_no):
+                    self.stats.probes += 1
+                    if self.tracer.enabled:
+                        self.tracer.event("probe", replica=rep.index,
+                                          backoff=h.backoff_steps)
+                    self._log.warning(
+                        "replica %d probing (circuit half-open after "
+                        "%d-step backoff)", rep.index, h.backoff_steps,
+                    )
+                    progressed = True
+                else:
+                    continue
+            remote = getattr(rep, "is_remote", False)
+            if not rep.has_work():
+                if remote:
+                    due = (
+                        step_no - rep.last_contact_step
+                        >= self.serving.heartbeat_interval_steps
+                    )
+                    if due:
+                        plan.append(
+                            (pos, rep, "hb", rep.heartbeat_async())
+                        )
+                        inflight += 1
+                    else:
+                        plan.append((pos, rep, "gap", None))
+                continue
+            t0 = time.perf_counter()
+            if not remote:
+                # no wire to overlap — the local step runs where the
+                # serial loop ran it, its outcome applies in order
+                try:
+                    stepped = rep.step()
+                except Exception as exc:
+                    plan.append((pos, rep, "step_fail", exc))
+                else:
+                    lat = (
+                        (time.perf_counter() - t0)
+                        + rep.injected_latency_s
+                    )
+                    plan.append((pos, rep, "step_done", (stepped, lat)))
+                continue
+            try:
+                call = rep.step_async()
+            except Exception as exc:
+                # replica-kind fault / abandon replay failed at issue —
+                # the serial loop's step() raised at the same point
+                plan.append((pos, rep, "step_fail", exc))
+            else:
+                plan.append((pos, rep, "step", (t0, call)))
+                inflight += 1
+        if inflight > self.stats.rpc_inflight_peak:
+            self.stats.rpc_inflight_peak = inflight
+        for pos, rep, kind, payload in plan:
+            if kind == "gap":
+                self._check_gap(pos, rep, step_no)
+            elif kind == "hb":
+                if rep.finish_heartbeat(payload):
+                    rep.last_contact_step = step_no
+                else:
+                    self._check_gap(pos, rep, step_no)
+            elif kind == "step_fail":
+                progressed = self._apply_step_failure(
+                    pos, rep, payload, step_no
+                ) or progressed
+            elif kind == "step_done":
+                stepped, latency = payload
+                self._note_transition(
+                    pos,
+                    self.health[pos].record_success(
+                        latency, step_no, had_work=True
+                    ),
+                )
+                progressed = stepped or progressed
+            else:  # "step" — harvest the remote ticket
+                t0, call = payload
+                try:
+                    stepped = rep.finish_step(call)
+                except Exception as exc:
+                    progressed = self._apply_step_failure(
+                        pos, rep, exc, step_no
+                    ) or progressed
+                    continue
+                rep.last_contact_step = step_no
+                done = (
+                    call.completed_at if call.completed_at is not None
+                    else time.perf_counter()
+                )
+                self.stats.note_rpc_rtt_ms(
+                    rep.index, max(0.0, done - t0) * 1000.0
+                )
+                latency = max(0.0, done - t0) + rep.injected_latency_s
+                self._note_transition(
+                    pos,
+                    self.health[pos].record_success(
+                        latency, step_no, had_work=True
+                    ),
+                )
+                progressed = stepped or progressed
+        return progressed
+
+    def _apply_step_failure(self, pos: int, rep, exc: BaseException,
+                            step_no: int) -> bool:
+        """The serial loop's step-exception arm, shared by the
+        concurrent loop's issue and harvest phases — one failure
+        observation (guarded per step), plus the gap check for a
+        still-installed remote that is not yet DOWN."""
+        self.stats.step_faults += 1
+        self._observe_failure(pos, exc, step_no)
+        if (
+            getattr(rep, "is_remote", False)
+            and rep is self.replicas[pos]
+            and self.health[pos].state is not HealthState.DOWN
+        ):
+            self._check_gap(pos, rep, step_no)
+        return True
+
+    def step(self) -> bool:
+        """One cluster step: advance every steppable replica under the
+        health monitor (remote replicas additionally heartbeat when
+        idle, with gap detection in cluster steps), settle
+        prefill→decode migrations, then run any due failover
+        re-admissions. Returns False when no replica has work left and
+        nothing is pending recovery.
+
+        With ``ServingConfig.concurrent_stepping`` (the default) and
+        any remote members, the per-replica RPCs fan out concurrently
+        and the step costs ~one round-trip; results still apply in
+        replica-index order (see :meth:`_step_replicas_concurrent` for
+        the determinism contract)."""
+        t_step = time.perf_counter()
+        self._step_counter += 1
+        step_no = self._step_counter
+        if self.fault_injector is not None:
+            # scripted manager death (FaultPlan "manager_crash"): the
+            # checkpoint-kill raises HERE, before any replica steps —
+            # the test/bench recovers from the journal where a real
+            # SIGKILL would restart the process
+            self.fault_injector.on_cluster_step(self)
+        tr = self.tracer
+        if tr.enabled and self._pending_trace:
+            # recovery ran before a tracer could attach — its
+            # recover/replay events flush on the first traced step
+            for name, kw in self._pending_trace:
+                tr.event(name, **kw)
+            self._pending_trace = []
+        self._failed_obs = set()
+        concurrent = (
+            getattr(self.serving, "concurrent_stepping", True)
+            and any(getattr(r, "is_remote", False) for r in self.replicas)
+        )
+        if concurrent:
+            progressed = self._step_replicas_concurrent(step_no)
+        else:
+            progressed = self._step_replicas_serial(step_no)
         if self.disaggregated:
             self._queue_migrations()
             progressed = self._drain_migration_queue() or progressed
@@ -1525,6 +1695,9 @@ class ClusterManager:
         # journal sync point: flushed-token deltas + newly terminal
         # records batch into ONE buffered write + file flush per step
         self._journal_sync()
+        self.stats.note_cluster_step_ms(
+            (time.perf_counter() - t_step) * 1000.0
+        )
         if step_no % 200 == 0:
             self._log.debug(
                 "%s", self.stats.report([r.rm.stats for r in self.replicas])

@@ -62,7 +62,158 @@ from ...obs.tracer import NULL_TRACER
 from ..batch_config import GenerationConfig, ProfileInfo
 from ..request_manager import TERMINAL_STATUSES, RequestStatus
 from .server import gen_to_wire
-from .transport import RemoteError, Transport, TransportError
+from .transport import RemoteError, RpcFuture, Transport, TransportError
+
+
+class _AsyncCall:
+    """One logical RPC in flight: the seq is assigned and attempt 0
+    issued (without blocking on the response) at CONSTRUCTION — the
+    call site in the concurrent drive loop is where the serial loop
+    would have blocked. :meth:`wait` harvests the response, and on the
+    rare failure path drives attempts 1..N SYNCHRONOUSLY with exactly
+    the serial ``_rpc`` semantics — per-attempt fault consults, seq
+    reuse (the server's response cache keeps retries at-most-once),
+    ``rpc_retries``/``rpc_errors`` accounting, exponential backoff on
+    real links, ``rpc_retry``/``rpc`` tracer events. The sync ``_rpc``
+    is literally ``_AsyncCall(...).wait()``, so there is ONE retry
+    state machine for both drive loops."""
+
+    __slots__ = ("owner", "method", "args", "seq", "deadline", "retries",
+                 "retries_spent", "t0", "completed_at", "future",
+                 "_pre_exc")
+
+    def __init__(self, owner: "RemoteReplica", method: str,
+                 args: Dict[str, Any], retryable: bool = True):
+        self.owner = owner
+        self.method = method
+        self.args = args
+        self.seq = next(owner._seq)  # ONE seq per logical call, reused
+        # across retries — the server's response cache de-duplicates
+        self.deadline = owner.serving.rpc_deadline_s
+        self.retries = owner.serving.rpc_retries if retryable else 0
+        self.retries_spent = 0
+        self.t0 = time.perf_counter() if owner.tracer.enabled else 0.0
+        #: perf_counter stamp of the final successful attempt's
+        #: completion (set by the transport's resolving thread for the
+        #: in-flight fast path) — the manager derives RTT from it
+        self.completed_at: Optional[float] = None
+        self.future: Optional[RpcFuture] = None
+        self._pre_exc: Optional[TransportError] = None
+        try:
+            self._consult_faults(attempt=0)
+        except TransportError as exc:
+            # the injected fault consumed attempt 0 WITHOUT touching
+            # the transport — wait() resumes at attempt 1, exactly the
+            # serial loop's flow
+            self._pre_exc = exc
+            return
+        self.future = owner.transport.call_async(
+            self.seq, method, args, self.deadline
+        )
+
+    def _consult_faults(self, attempt: int) -> None:
+        owner = self.owner
+        if owner.fault_injector is None:
+            return
+        extra = owner.fault_injector.on_rpc(
+            owner.index, owner.steps_taken, self.method, attempt
+        )
+        if extra:
+            if extra >= self.deadline:
+                from .transport import DeadlineExceeded
+
+                raise DeadlineExceeded(
+                    f"injected delay {extra}s exceeds the "
+                    f"{self.deadline}s rpc deadline ({self.method})"
+                )
+            # a slow-but-alive link: the health machine sees it as
+            # step latency, same as the in-process "latency" fault kind
+            owner.injected_latency_s += extra
+
+    def wait(self) -> Any:
+        """Harvest the response (or exhaust the retry budget and raise
+        the final :class:`TransportError`; a :class:`RemoteError` —
+        the server executed and raised — propagates immediately,
+        never retried)."""
+        owner = self.owner
+        tr = owner.tracer
+        owner._last_call_retries = 0
+        last_exc: Optional[TransportError] = None
+        if self._pre_exc is not None:
+            last_exc = self._pre_exc
+            self._note_attempt_failed(0, last_exc)
+        else:
+            try:
+                result = self.future.result()
+                self.completed_at = self.future.completed_at
+                self._note_ok(attempts=1)
+                return result
+            except TransportError as exc:
+                last_exc = exc
+                self._note_attempt_failed(0, exc)
+        for attempt in range(1, self.retries + 1):
+            self.retries_spent += 1
+            owner._last_call_retries += 1
+            st = owner.stats
+            if st is not None:
+                st.rpc_retries += 1
+            if tr.enabled:
+                # retries/backoff are part of the request's wire
+                # story — each is its own event on the wire lane
+                tr.event(
+                    "rpc_retry", method=self.method, attempt=attempt,
+                    replica=owner.index,
+                    error=type(last_exc).__name__,
+                )
+            if owner.transport.needs_backoff:
+                time.sleep(
+                    owner.serving.rpc_backoff_s * (2 ** (attempt - 1))
+                )
+            try:
+                self._consult_faults(attempt)
+                result = owner.transport.call(
+                    self.seq, self.method, self.args, self.deadline
+                )
+                self.completed_at = time.perf_counter()
+                self._note_ok(attempts=attempt + 1)
+                return result
+            except TransportError as exc:
+                last_exc = exc
+                self._note_attempt_failed(attempt, exc)
+                continue
+        st = owner.stats
+        if st is not None:
+            st.rpc_errors += 1
+        assert last_exc is not None
+        self.completed_at = time.perf_counter()
+        if tr.enabled:
+            tr.event(
+                "rpc", t=self.t0, dur=time.perf_counter() - self.t0,
+                method=self.method, replica=owner.index,
+                attempts=self.retries + 1, ok=False,
+                error=type(last_exc).__name__,
+            )
+        raise last_exc
+
+    def _note_ok(self, attempts: int) -> None:
+        owner = self.owner
+        tr = owner.tracer
+        if tr.enabled:
+            tr.event(
+                "rpc", t=self.t0, dur=time.perf_counter() - self.t0,
+                method=self.method, replica=owner.index,
+                attempts=attempts, ok=True,
+            )
+
+    def _note_attempt_failed(self, attempt: int,
+                             exc: TransportError) -> None:
+        owner = self.owner
+        if getattr(exc, "kind", None) == "disconnect":
+            owner.transport.drop_connection()
+        owner._log.debug(
+            "rpc %s to replica %d attempt %d failed: %s",
+            self.method, owner.index, attempt, exc,
+        )
 
 
 class HeartbeatGap(RuntimeError):
@@ -342,78 +493,10 @@ class RemoteReplica:
 
     def _rpc(self, method: str, args: Dict[str, Any],
              retryable: bool = True) -> Any:
-        seq = next(self._seq)  # ONE seq per logical call, reused across
-        # retries — the server's response cache makes retries idempotent
-        deadline = self.serving.rpc_deadline_s
-        retries = self.serving.rpc_retries if retryable else 0
-        self._last_call_retries = 0
-        last_exc: Optional[TransportError] = None
-        tr = self.tracer
-        t0 = time.perf_counter() if tr.enabled else 0.0
-        for attempt in range(retries + 1):
-            if attempt:
-                self._last_call_retries += 1
-                st = self.stats
-                if st is not None:
-                    st.rpc_retries += 1
-                if tr.enabled:
-                    # retries/backoff are part of the request's wire
-                    # story — each is its own event on the wire lane
-                    tr.event(
-                        "rpc_retry", method=method, attempt=attempt,
-                        replica=self.index,
-                        error=type(last_exc).__name__,
-                    )
-                if self.transport.needs_backoff:
-                    time.sleep(
-                        self.serving.rpc_backoff_s * (2 ** (attempt - 1))
-                    )
-            try:
-                if self.fault_injector is not None:
-                    extra = self.fault_injector.on_rpc(
-                        self.index, self.steps_taken, method, attempt
-                    )
-                    if extra:
-                        if extra >= deadline:
-                            from .transport import DeadlineExceeded
-
-                            raise DeadlineExceeded(
-                                f"injected delay {extra}s exceeds the "
-                                f"{deadline}s rpc deadline ({method})"
-                            )
-                        # a slow-but-alive link: the health machine sees
-                        # it as step latency, same as the in-process
-                        # "latency" fault kind
-                        self.injected_latency_s += extra
-                result = self.transport.call(seq, method, args, deadline)
-                if tr.enabled:
-                    tr.event(
-                        "rpc", t=t0, dur=time.perf_counter() - t0,
-                        method=method, replica=self.index,
-                        attempts=attempt + 1, ok=True,
-                    )
-                return result
-            except TransportError as exc:
-                last_exc = exc
-                kind = getattr(exc, "kind", None)
-                if kind == "disconnect":
-                    self.transport.drop_connection()
-                self._log.debug(
-                    "rpc %s to replica %d attempt %d failed: %s",
-                    method, self.index, attempt, exc,
-                )
-                continue
-        st = self.stats
-        if st is not None:
-            st.rpc_errors += 1
-        assert last_exc is not None
-        if tr.enabled:
-            tr.event(
-                "rpc", t=t0, dur=time.perf_counter() - t0, method=method,
-                replica=self.index, attempts=retries + 1, ok=False,
-                error=type(last_exc).__name__,
-            )
-        raise last_exc
+        # issue-then-immediately-harvest: on an inline transport this
+        # IS the pre-async serial exchange, bit for bit — one retry
+        # state machine serves both drive loops (see _AsyncCall)
+        return _AsyncCall(self, method, args, retryable=retryable).wait()
 
     def _apply_envelope(self, result: Dict[str, Any]) -> None:
         tel = result.get("telemetry")
@@ -534,6 +617,81 @@ class RemoteReplica:
         self._apply_envelope(res)
         self._spread_step_retries()
         return bool(res.get("progressed", False))
+
+    # ------------------------------------------------------------------
+    # async issue/finish pairs — the concurrent drive loop's surface.
+    # ISSUE methods run everything the serial path ran BEFORE its
+    # blocking exchange (fault kinds, abandon replay, bookkeeping) and
+    # may raise exactly what the serial path raised there; FINISH
+    # methods harvest the response and apply the envelope→mirror
+    # update. The manager issues in replica-index order, then finishes
+    # in replica-index order — so every mirror/stats/tracer mutation
+    # happens on the MANAGER's thread in a deterministic order no
+    # matter how completions interleave on the wire.
+
+    def step_async(self) -> "_AsyncCall":
+        """Issue this replica's step RPC without waiting. Replica-kind
+        faults fire here (issue time is the serial loop's call site) —
+        may raise InjectedFault/TransportError exactly like
+        :meth:`step`'s pre-exchange half."""
+        self.steps_taken += 1
+        self.injected_latency_s = 0.0
+        if self.fault_injector is not None:
+            self.fault_injector.on_step(self)  # may raise InjectedFault
+        self._flush_pending_abandon()
+        return _AsyncCall(self, "step", {})
+
+    def finish_step(self, call: "_AsyncCall") -> bool:
+        """Harvest a :meth:`step_async` ticket: envelope→mirror, retry
+        spread, progressed flag. Raises the final TransportError on
+        retry exhaustion — the manager feeds it to the health machine
+        like a serial step failure."""
+        res = call.wait()
+        self._apply_envelope(res)
+        self._spread_step_retries()
+        return bool(res.get("progressed", False))
+
+    def heartbeat_async(self) -> Optional["_AsyncCall"]:
+        """Issue a liveness+telemetry exchange without waiting. Returns
+        None when the pending-abandon replay (which must precede any
+        exchange) could not be delivered — the heartbeat is already a
+        failure."""
+        try:
+            self._flush_pending_abandon()
+        except (TransportError, RemoteError):
+            return None
+        return _AsyncCall(self, "heartbeat", {})
+
+    def finish_heartbeat(self, call: Optional["_AsyncCall"]) -> bool:
+        if call is None:
+            return False
+        try:
+            res = call.wait()
+        except (TransportError, RemoteError):
+            return False
+        self._apply_envelope(res)
+        return True
+
+    def prefix_score_async(self,
+                           tokens: Sequence[int]) -> Optional["_AsyncCall"]:
+        """Issue a prefix-cache peek without waiting (None for prompts
+        too short to score — the serial fast path)."""
+        if len(tokens) < 2:
+            return None
+        return _AsyncCall(
+            self, "prefix_score", {"tokens": [int(t) for t in tokens]}
+        )
+
+    def finish_prefix_score(self, call: Optional["_AsyncCall"]) -> int:
+        if call is None:
+            return 0
+        try:
+            return int(call.wait()["score"])
+        except (TransportError, RemoteError):
+            # an unreachable replica scores 0 — routing falls elsewhere
+            # and the health machinery catches the outage via its own
+            # step/heartbeat observations
+            return 0
 
     def drain(self) -> None:
         self._flush_pending_abandon()

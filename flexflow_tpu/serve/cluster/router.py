@@ -53,8 +53,10 @@ class Router:
 
     The entries may be in-process :class:`~.replica.Replica` or
     :class:`~.remote.RemoteReplica` — for a remote one,
-    ``prefix_score`` is a read-only RPC (an unreachable replica scores
-    0 and the health machinery owns the outage) while
+    ``prefix_score`` is a read-only RPC, broadcast CONCURRENTLY across
+    the candidates (one round-trip per placement, not N serial peeks;
+    an unreachable replica scores 0 and the health machinery owns the
+    outage) while
     ``queue_delay_s``/``load`` read the heartbeat-fed client mirror,
     so a scoring pass never blocks on a slow link. The list is LIVE:
     the manager swaps a warm standby into a dead replica's position
@@ -128,6 +130,30 @@ class Router:
             ),
         )
 
+    def _prefix_scores(self, tokens: Sequence[int],
+                       positions: Sequence[int]) -> list:
+        """Score every candidate's cached-prefix match, CONCURRENTLY
+        where the replica speaks the async RPC surface: all peek RPCs
+        are issued first, then harvested in position order — a
+        placement over N remote replicas costs one round-trip, not N
+        serial peeks, and the scored list is identical to the serial
+        broadcast's (issue/harvest order is position order, and each
+        score is position-local)."""
+        issued = []
+        for p in positions:
+            rep = self.replicas[p]
+            if hasattr(rep, "prefix_score_async"):
+                issued.append((p, rep, rep.prefix_score_async(tokens)))
+            else:  # in-process replica: the probe is a local tree read
+                issued.append((p, rep, None))
+        scored = []
+        for p, rep, call in issued:
+            if hasattr(rep, "finish_prefix_score"):
+                scored.append((rep.finish_prefix_score(call), p))
+            else:
+                scored.append((rep.prefix_score(tokens), p))
+        return scored
+
     def route(
         self,
         tokens: Sequence[int],
@@ -172,8 +198,7 @@ class Router:
                 pos, how = cand, "affinity"
         if pos is None:
             if self.policy == "prefix":
-                scored = [(self.replicas[p].prefix_score(tokens), p)
-                          for p in eligible]
+                scored = self._prefix_scores(tokens, eligible)
                 best_score = max(s for s, _ in scored)
                 if best_score > 0:
                     ties = [p for s, p in scored if s == best_score]

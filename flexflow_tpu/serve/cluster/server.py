@@ -41,6 +41,7 @@ import dataclasses
 import json
 import socket
 import sys
+import threading
 from typing import Any, Dict
 
 from ...logging_utils import get_logger
@@ -85,6 +86,16 @@ class ReplicaServerCore:
         self._responses: "collections.OrderedDict[int, Dict]" = (
             collections.OrderedDict()
         )
+        # At-most-once is only as strong as the atomicity of
+        # cache-check → execute → cache-write. The subprocess socket
+        # server is a serial loop, but an embedded (loopback) core can
+        # be reached from two threads at once — the manager thread's
+        # sync retry racing the transport worker still holding the
+        # original attempt. Both would miss the seq cache and
+        # double-execute (donated engine buffers make that a
+        # deleted-array crash, not just a logic bug), so dispatch
+        # serializes behind this lock and the loser replays the cache.
+        self._dispatch_lock = threading.Lock()
         self.shutdown_requested = False
 
     # ------------------------------------------------------------------
@@ -272,7 +283,10 @@ class ReplicaServerCore:
         """One decoded request frame → one response dict. Never raises:
         application exceptions become ``ok=False`` error responses
         (and are cached like successes — a retried failing call must
-        not re-execute either)."""
+        not re-execute either). Thread-safe: concurrent callers
+        serialize behind the per-core dispatch lock, so a duplicate
+        seq racing the original executes exactly once and replays the
+        cached response."""
         if not isinstance(request, dict) or "method" not in request:
             return {
                 "seq": None, "ok": False,
@@ -280,33 +294,35 @@ class ReplicaServerCore:
                           "msg": f"malformed rpc request: {request!r}"},
             }
         seq = request.get("seq")
-        if seq is not None and seq in self._responses:
-            self._responses.move_to_end(seq)
-            return self._responses[seq]
-        method = str(request["method"])
-        handler = getattr(self, f"_m_{method}", None)
-        if handler is None:
-            response: Dict[str, Any] = {
-                "seq": seq, "ok": False,
-                "error": {"type": "FrameError",
-                          "msg": f"unknown rpc method {method!r}"},
-            }
-        else:
-            try:
-                response = {
-                    "seq": seq, "ok": True,
-                    "result": handler(request.get("args") or {}),
-                }
-            except Exception as exc:
-                response = {
+        with self._dispatch_lock:
+            if seq is not None and seq in self._responses:
+                self._responses.move_to_end(seq)
+                return self._responses[seq]
+            method = str(request["method"])
+            handler = getattr(self, f"_m_{method}", None)
+            if handler is None:
+                response: Dict[str, Any] = {
                     "seq": seq, "ok": False,
-                    "error": {"type": type(exc).__name__, "msg": str(exc)},
+                    "error": {"type": "FrameError",
+                              "msg": f"unknown rpc method {method!r}"},
                 }
-        if seq is not None:
-            self._responses[seq] = response
-            while len(self._responses) > _SEQ_CACHE_SIZE:
-                self._responses.popitem(last=False)
-        return response
+            else:
+                try:
+                    response = {
+                        "seq": seq, "ok": True,
+                        "result": handler(request.get("args") or {}),
+                    }
+                except Exception as exc:
+                    response = {
+                        "seq": seq, "ok": False,
+                        "error": {"type": type(exc).__name__,
+                                  "msg": str(exc)},
+                    }
+            if seq is not None:
+                self._responses[seq] = response
+                while len(self._responses) > _SEQ_CACHE_SIZE:
+                    self._responses.popitem(last=False)
+            return response
 
 
 # ---------------------------------------------------------------------------
@@ -392,11 +408,18 @@ def build_replica_from_spec(spec: Dict[str, Any]) -> Replica:
 def serve_forever(core: ReplicaServerCore, port: int = 0,
                   host: str = "127.0.0.1",
                   announce=None) -> None:
-    """Accept loop: one client at a time (the cluster front-end is the
-    only caller and drives RPCs serially), frames in / frames out. A
-    malformed frame closes that CONNECTION with a logged warning and
-    the server keeps accepting — a corrupt or hostile client cannot
-    take the replica down. Returns after a ``shutdown`` RPC."""
+    """Accept loop: one client at a time, frames in / frames out in
+    ARRIVAL order. The multiplexing client may PIPELINE many tagged
+    requests onto the connection before reading anything — the
+    serial read→dispatch→respond loop composes with that unchanged,
+    because every response carries its request's ``seq`` call-tag and
+    the client demultiplexes (the replica executes one RPC at a time
+    either way; it owns a single JAX runtime). A malformed frame — or
+    a client that vanished mid-exchange (e.g. its deadline expired and
+    it dropped the connection) — closes that CONNECTION with a logged
+    warning and the server keeps accepting — a corrupt, hostile or
+    impatient client cannot take the replica down. Returns after a
+    ``shutdown`` RPC."""
     listener = socket.create_server((host, port))
     actual_port = listener.getsockname()[1]
     if announce is not None:
@@ -420,7 +443,18 @@ def serve_forever(core: ReplicaServerCore, port: int = 0,
                             core.replica.index, exc,
                         )
                         break
-                    conn.sendall(encode_frame(core.dispatch(request)))
+                    try:
+                        conn.sendall(encode_frame(core.dispatch(request)))
+                    except OSError as exc:
+                        # the client dropped the connection between our
+                        # read and this write (deadline expiry on its
+                        # side) — the response is already in the seq
+                        # cache for the retry; keep serving
+                        _log.warning(
+                            "replica server %d: client went away "
+                            "mid-response (%s)", core.replica.index, exc,
+                        )
+                        break
             finally:
                 try:
                     conn.close()

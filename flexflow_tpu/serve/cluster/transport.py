@@ -28,9 +28,14 @@ KV page bytes without base64 inflation):
   retries; the server caches recent responses by ``seq`` and replays
   a duplicate instead of re-executing — which is what makes retrying
   a ``step``/``submit`` whose RESPONSE was lost safe (at-most-once
-  execution, at-least-once delivery).
+  execution, at-least-once delivery). The ``seq`` is ALSO the frame's
+  **call-tag**: every response names the call it answers, so one
+  connection can carry many in-flight RPCs and complete them out of
+  order — the client demultiplexes responses by tag into per-call
+  :class:`RpcFuture` slots (``call_async``), which is what lets the
+  cluster drive loop step N replicas in ONE round-trip instead of N.
 
-Two transports implement the same ``call`` surface:
+Two transports implement the same ``call``/``call_async`` surface:
 
 * :class:`LoopbackTransport` — in-process: every call is encoded to
   real frame bytes, decoded, dispatched against a local
@@ -38,12 +43,21 @@ Two transports implement the same ``call`` surface:
   the codec the same way. Tier-1 tests run the WHOLE cluster through
   it to prove a loopback-transported cluster is BITWISE the in-process
   PR-8/9 cluster — the serialization layer is exercised end to end
-  without sockets or subprocesses.
+  without sockets or subprocesses. ``call_async`` completes INLINE at
+  issue time by default (deterministic — the concurrent drive loop on
+  loopback is provably the serial loop), or on a per-transport worker
+  thread with an optional real link delay (``threaded``/``delay_s``)
+  so chaos tests and the bench can overlap real wall-clock latency
+  across replicas.
 * :class:`SocketTransport` — localhost TCP to a subprocess replica
-  server (``python -m flexflow_tpu.serve.cluster.server``). Blocking
-  reads carry the per-RPC deadline as the socket timeout; connection
-  loss marks the transport dead and the next call reconnects
-  (``reconnects`` counted into ClusterStats).
+  server (``python -m flexflow_tpu.serve.cluster.server``). A
+  per-connection WRITER LOCK serializes frame sends (and re-dials — a
+  racing pair of callers can neither interleave frame bytes nor
+  double-count ``reconnects``), while a READER THREAD demultiplexes
+  responses by call-tag into the pending futures, so many RPCs ride
+  one connection concurrently. Deadline expiry and connection loss
+  fail the affected futures with typed errors; a dead connection is
+  remembered and re-dialed by the next call.
 
 Deadlines, bounded retries and exponential backoff live one level up
 in :class:`~.remote.RemoteReplica` — the transports only move frames.
@@ -53,9 +67,13 @@ so both transports see identical scripted failures.
 """
 from __future__ import annotations
 
+import queue
+import select
 import socket
 import struct
-from typing import Any, Callable, Dict, Optional, Tuple
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import numpy as np
 
@@ -339,6 +357,84 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 # ---------------------------------------------------------------------------
 # transports
 
+#: ClusterStats is a plain dataclass shared by EVERY transport in the
+#: cluster; once responses can complete on reader/worker threads, the
+#: ``+=`` on its wire counters must be serialized or concurrent
+#: completions lose increments.
+_STATS_LOCK = threading.Lock()
+
+
+class RpcFuture:
+    """One in-flight RPC's completion slot. ``call_async`` returns one
+    immediately; the transport resolves it (result or typed transport/
+    remote error) when the tagged response arrives. Each future carries
+    its OWN deadline, anchored at issue time: :meth:`result` waits at
+    most the remaining budget and raises :class:`DeadlineExceeded` —
+    many futures with different deadlines can ride one connection.
+
+    The "wire" tracer event for the exchange is emitted from
+    :meth:`result` on the HARVESTING thread, never from the transport's
+    reader/worker thread — tracer timelines stay single-threaded per
+    lane (the FF108 contract) even though completions are concurrent.
+    """
+
+    __slots__ = ("seq", "method", "deadline_s", "sent_bytes",
+                 "received_bytes", "completed_at", "_t0", "_event",
+                 "_result", "_exc", "_on_deadline", "_tracer", "_traced")
+
+    def __init__(self, seq: int, method: str, deadline_s: float):
+        self.seq = seq
+        self.method = method
+        self.deadline_s = deadline_s
+        self.sent_bytes = 0
+        self.received_bytes = 0
+        #: ``time.perf_counter()`` stamp of the completing resolve/fail
+        #: — the manager derives per-replica RTT from it without a
+        #: clock read of its own racing the completion.
+        self.completed_at: Optional[float] = None
+        self._t0 = time.perf_counter()
+        self._event = threading.Event()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+        self._on_deadline: Optional[Callable[[], None]] = None
+        self._tracer = None
+        self._traced = False
+
+    def _resolve(self, result: Any) -> None:
+        self._result = result
+        self.completed_at = time.perf_counter()
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self.completed_at = time.perf_counter()
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self) -> Any:
+        """Wait out the remaining deadline budget and return the
+        response (or raise the typed failure). Idempotent after
+        completion."""
+        remaining = self.deadline_s - (time.perf_counter() - self._t0)
+        if not self._event.wait(max(0.0, remaining)):
+            on_deadline, self._on_deadline = self._on_deadline, None
+            if on_deadline is not None:
+                on_deadline()
+            raise DeadlineExceeded(
+                f"rpc {self.method!r} exceeded {self.deadline_s:g}s"
+            )
+        if self._exc is not None:
+            raise self._exc
+        tr = self._tracer
+        if tr is not None and not self._traced:
+            self._traced = True
+            tr.event("wire", method=self.method, sent=self.sent_bytes,
+                     received=self.received_bytes)
+        return self._result
+
+
 class Transport:
     """One replica's RPC channel. ``stats`` is a ClusterStats or a
     zero-arg callable returning one (the callable-stats pattern) —
@@ -368,22 +464,41 @@ class Transport:
         )
 
     def _count(self, sent: int = 0, received: int = 0) -> None:
-        self.bytes_sent += sent
-        self.bytes_received += received
-        st = self.stats
-        if st is not None:
-            st.wire_bytes_sent += sent
-            st.wire_bytes_received += received
+        with _STATS_LOCK:
+            self.bytes_sent += sent
+            self.bytes_received += received
+            st = self.stats
+            if st is not None:
+                st.wire_bytes_sent += sent
+                st.wire_bytes_received += received
 
     def _count_reconnect(self) -> None:
-        self.reconnects += 1
-        st = self.stats
-        if st is not None:
-            st.reconnects += 1
+        with _STATS_LOCK:
+            self.reconnects += 1
+            st = self.stats
+            if st is not None:
+                st.reconnects += 1
 
     def call(self, seq: int, method: str, args: Dict[str, Any],
              deadline_s: float) -> Any:
         raise NotImplementedError
+
+    def call_async(self, seq: int, method: str, args: Dict[str, Any],
+                   deadline_s: float) -> RpcFuture:
+        """Issue the RPC and return its :class:`RpcFuture` without
+        waiting for the response. NEVER raises a transport error —
+        issue-time failures come back as an already-failed future, so
+        a fan-out caller collects every outcome at harvest time.
+
+        The base implementation executes :meth:`call` inline and
+        returns an already-completed future — correct (and
+        deterministic) for any transport whose ``call`` is cheap."""
+        fut = RpcFuture(seq, method, deadline_s)
+        try:
+            fut._resolve(self.call(seq, method, args, deadline_s))
+        except (TransportError, RemoteError) as exc:
+            fut._fail(exc)
+        return fut
 
     def drop_connection(self) -> None:
         """Tear the link down (injected ``disconnect`` fault or a real
@@ -394,6 +509,14 @@ class Transport:
         pass
 
 
+#: Exactly one loopback dispatch at a time, cluster-wide: dispatch runs
+#: the replica's REAL scheduler/engine step, and JAX host-side state is
+#: not thread-safe. Worker threads overlap their injected link DELAYS
+#: freely (that is the concurrency the bench measures); the computes
+#: behind them serialize here, same as N processes sharing one chip.
+_LOOPBACK_DISPATCH_LOCK = threading.Lock()
+
+
 class LoopbackTransport(Transport):
     """In-process transport: requests and responses round-trip the REAL
     codec (encode → frame → decode on both legs) before/after hitting
@@ -401,13 +524,33 @@ class LoopbackTransport(Transport):
     response_dict`` (a :class:`~.server.ReplicaServerCore`). What the
     caller receives is exactly what a socket peer would have received,
     byte for byte, which is what lets tier-1 prove the transported
-    cluster bitwise against the in-process one without sockets."""
+    cluster bitwise against the in-process one without sockets.
+
+    ``call_async`` completes INLINE at issue time by default, so the
+    concurrent drive loop over loopback replicas is deterministic —
+    issue order IS completion order. Setting ``threaded = True``
+    (optionally with a ``delay_s`` link latency: a float, or a
+    ``callable(method) -> float``) moves async completions onto a
+    per-transport worker thread that sleeps the delay BEFORE
+    dispatching — real wall-clock latency that overlaps across
+    replicas, for the chaos tests and the ``serve_cluster_async``
+    bench. The sync :meth:`call` path always stays inline — but it
+    dispatches under the same global lock as the worker, so a sync
+    retry racing an in-flight threaded call serializes into the
+    core's seq cache instead of double-executing the RPC."""
 
     def __init__(self, dispatch: Callable[[Dict[str, Any]], Dict[str, Any]],
                  stats=None):
         super().__init__(stats)
         self.dispatch = dispatch
         self._connected = True
+        #: flip post-build to move async completions onto the worker
+        self.threaded = False
+        #: injected one-way link delay, paid once per RPC (threaded
+        #: mode only): seconds, or ``callable(method) -> seconds``
+        self.delay_s: Union[float, Callable[[str], float]] = 0.0
+        self._queue: Optional["queue.Queue"] = None
+        self._worker: Optional[threading.Thread] = None
 
     def call(self, seq: int, method: str, args: Dict[str, Any],
              deadline_s: float) -> Any:
@@ -418,7 +561,14 @@ class LoopbackTransport(Transport):
             self._count_reconnect()
         request = encode_frame({"seq": seq, "method": method, "args": args})
         self._count(sent=len(request))
-        response_frame = encode_frame(self.dispatch(decode_frame(request)))
+        # Same serialization as the worker loop: a sync call (e.g. a
+        # deadline-expiry retry) must not dispatch concurrently with a
+        # threaded async call still in flight — the core's seq cache
+        # dedupes re-execution only when dispatches serialize.
+        with _LOOPBACK_DISPATCH_LOCK:
+            response_frame = encode_frame(
+                self.dispatch(decode_frame(request))
+            )
         self._count(received=len(response_frame))
         tr = self.tracer
         if tr.enabled:
@@ -427,16 +577,99 @@ class LoopbackTransport(Transport):
         response = decode_frame(response_frame)
         return _unwrap_response(response, seq)
 
+    def call_async(self, seq: int, method: str, args: Dict[str, Any],
+                   deadline_s: float) -> RpcFuture:
+        if not self.threaded:
+            return super().call_async(seq, method, args, deadline_s)
+        # Issue-time bookkeeping stays on the CALLER thread in issue
+        # order — reconnect counting and sent-byte accounting are
+        # deterministic regardless of completion interleaving.
+        if not self._connected:
+            self._connected = True
+            self._count_reconnect()
+        request = encode_frame({"seq": seq, "method": method, "args": args})
+        self._count(sent=len(request))
+        fut = RpcFuture(seq, method, deadline_s)
+        fut.sent_bytes = len(request)
+        fut._tracer = self.tracer if self.tracer.enabled else None
+        self._ensure_worker().put((fut, request))
+        return fut
+
+    def _ensure_worker(self) -> "queue.Queue":
+        if self._queue is None:
+            self._queue = queue.Queue()
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True,
+                name="ff-loopback-rpc",
+            )
+            self._worker.start()
+        return self._queue
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            fut, request = item
+            delay = (
+                self.delay_s(fut.method) if callable(self.delay_s)
+                else self.delay_s
+            )
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                with _LOOPBACK_DISPATCH_LOCK:
+                    response_frame = encode_frame(
+                        self.dispatch(decode_frame(request))
+                    )
+                self._count(received=len(response_frame))
+                fut.received_bytes = len(response_frame)
+                result = _unwrap_response(decode_frame(response_frame),
+                                          fut.seq)
+            except (TransportError, RemoteError) as exc:
+                fut._fail(exc)
+            except Exception as exc:  # dispatch cores never raise; belt
+                fut._fail(FrameError(f"loopback dispatch failed: {exc}"))
+            else:
+                fut._resolve(result)
+
     def drop_connection(self) -> None:
         self._connected = False
 
+    def close(self) -> None:
+        if self._queue is not None:
+            self._queue.put(None)
+            self._queue = None
+            self._worker = None
+
 
 class SocketTransport(Transport):
-    """Localhost TCP transport to a subprocess replica server. One
-    connection, serial request/response exchanges (the cluster drive
-    loop is single-threaded); the per-call ``deadline_s`` becomes the
-    socket timeout for both the send and the response read. A dead
-    connection is remembered and re-dialed on the next call."""
+    """Localhost TCP transport to a subprocess replica server —
+    MULTIPLEXED: one connection carries many in-flight RPCs, completed
+    out of order and demultiplexed by the response's ``seq`` call-tag.
+
+    Concurrency model:
+
+    * a per-connection LOCK serializes dialing and frame sends, so two
+      racing callers can neither interleave frame bytes on the wire
+      nor double-dial (and double-count ``reconnects``) after a drop;
+    * a READER THREAD per connection (on a ``dup()`` of the socket, so
+      writer-side ``settimeout`` never races it) reads response frames
+      and resolves the matching pending :class:`RpcFuture`; a response
+      whose tag matches nothing (a late reply to a call that already
+      timed out and was retried under the same seq — the server's seq
+      cache replays for the retry) is dropped on the floor;
+    * per-call deadlines are enforced by :meth:`RpcFuture.result`
+      wall-clock waits, not socket timeouts — slow calls can't stall
+      fast ones sharing the connection. A deadline expiry harvested
+      through the sync :meth:`call` drops the connection, preserving
+      the pre-multiplexing contract (the response may still be in
+      flight; the retry re-dials and the seq cache de-duplicates).
+
+    Connection loss fails EVERY pending future with
+    :class:`ConnectionLost`; the dead link is remembered and re-dialed
+    by the next call.
+    """
 
     needs_backoff = True
 
@@ -448,8 +681,18 @@ class SocketTransport(Transport):
         self.connect_timeout_s = connect_timeout_s
         self._sock: Optional[socket.socket] = None
         self._ever_connected = False
+        #: serializes dial / send / pending-table mutation; reconnect
+        #: accounting happens inside, so a racing pair of callers
+        #: observing a dead link produce exactly ONE re-dial
+        self._lock = threading.Lock()
+        self._pending: Dict[int, RpcFuture] = {}
+        #: connection generation — a reader thread only tears down the
+        #: pending table of the connection it was spawned for
+        self._gen = 0
 
-    def _connect(self) -> socket.socket:
+    def _dial_locked(self) -> socket.socket:
+        """Dial and start this connection's reader. Caller holds
+        ``_lock``."""
         try:
             sock = socket.create_connection(
                 (self.host, self.port), timeout=self.connect_timeout_s
@@ -462,45 +705,145 @@ class SocketTransport(Transport):
         if self._ever_connected:
             self._count_reconnect()
         self._ever_connected = True
+        self._sock = sock
+        self._gen += 1
+        # The reader owns a dup'd socket object onto the same
+        # connection (dup shares the open file description, so the
+        # writer's per-send settimeout also flips the shared
+        # O_NONBLOCK — the reader therefore select()s for readability
+        # and only then reads, instead of blocking in recv). shutdown()
+        # on either handle wakes both sides.
+        rsock = sock.dup()
+        threading.Thread(
+            target=self._reader_loop, args=(rsock, self._gen), daemon=True,
+            name=f"ff-rpc-reader-{self.host}:{self.port}",
+        ).start()
         return sock
 
-    def call(self, seq: int, method: str, args: Dict[str, Any],
-             deadline_s: float) -> Any:
-        if self._sock is None:
-            self._sock = self._connect()
-        sock = self._sock
-        frame = encode_frame({"seq": seq, "method": method, "args": args})
-        size_out: list = []
+    def _reader_loop(self, rsock: socket.socket, gen: int) -> None:
         try:
-            sock.settimeout(deadline_s)
-            sock.sendall(frame)
-            self._count(sent=len(frame))
-            response = read_frame_from_socket(sock, size_out)
-        except TransportError:
-            self.drop_connection()
-            raise
-        except socket.timeout as exc:
-            self.drop_connection()
-            raise DeadlineExceeded(
-                f"rpc {method!r} exceeded {deadline_s}s"
-            ) from exc
-        except OSError as exc:
-            self.drop_connection()
-            raise ConnectionLost(f"rpc {method!r} failed: {exc}") from exc
-        self._count(received=size_out[0])
-        tr = self.tracer
-        if tr.enabled:
-            tr.event("wire", method=method, sent=len(frame),
-                     received=size_out[0])
-        return _unwrap_response(response, seq)
+            while True:
+                # idle tick: wait for a frame to START, and notice a
+                # torn-down connection (drop_connection's shutdown
+                # makes the socket readable-with-EOF immediately)
+                try:
+                    ready = select.select([rsock], [], [], 0.5)[0]
+                except (OSError, ValueError):
+                    self._fail_pending(
+                        gen, ConnectionLost("reader socket closed")
+                    )
+                    return
+                if not ready:
+                    with self._lock:
+                        if gen != self._gen or self._sock is None:
+                            return  # superseded or dropped — retire
+                    continue
+                size_out: list = []
+                try:
+                    # a frame's bytes follow its first byte promptly
+                    # (the server writes each response with one
+                    # sendall) — the generous timeout only bounds a
+                    # mid-frame peer stall
+                    rsock.settimeout(self.connect_timeout_s)
+                    response = read_frame_from_socket(rsock, size_out)
+                except TransportError as exc:
+                    self._fail_pending(gen, exc)
+                    return
+                seq = (
+                    response.get("seq") if isinstance(response, dict)
+                    else None
+                )
+                if not isinstance(seq, int):
+                    self._fail_pending(
+                        gen, FrameError(f"untagged rpc response: "
+                                        f"{type(response).__name__}")
+                    )
+                    return
+                with self._lock:
+                    fut = self._pending.pop(seq, None)
+                if fut is None:
+                    continue  # late reply to an abandoned/retried call
+                self._count(received=size_out[0])
+                fut.received_bytes = size_out[0]
+                try:
+                    result = _unwrap_response(response, fut.seq)
+                except (TransportError, RemoteError) as exc:
+                    fut._fail(exc)
+                else:
+                    fut._resolve(result)
+        finally:
+            try:
+                rsock.close()
+            except OSError:
+                pass
 
-    def drop_connection(self) -> None:
+    def _fail_pending(self, gen: int, exc: TransportError) -> None:
+        """The ``gen`` connection died: fail its pending futures and
+        mark the transport dead (unless a newer connection already took
+        over — then its reader owns the pending table)."""
+        with self._lock:
+            if gen != self._gen:
+                return
+            pending = list(self._pending.values())
+            self._pending.clear()
+            self._close_sock_locked()
+        for fut in pending:
+            fut._fail(exc)
+
+    def _close_sock_locked(self) -> None:
         if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
+
+    def call_async(self, seq: int, method: str, args: Dict[str, Any],
+                   deadline_s: float) -> RpcFuture:
+        fut = RpcFuture(seq, method, deadline_s)
+        fut._tracer = self.tracer if self.tracer.enabled else None
+        frame = encode_frame({"seq": seq, "method": method, "args": args})
+        fut.sent_bytes = len(frame)
+        with self._lock:
+            try:
+                sock = self._sock if self._sock is not None \
+                    else self._dial_locked()
+                self._pending[seq] = fut
+                sock.settimeout(deadline_s)
+                sock.sendall(frame)
+            except TransportError as exc:
+                self._pending.pop(seq, None)
+                fut._fail(exc)
+                return fut
+            except (socket.timeout, OSError) as exc:
+                self._pending.pop(seq, None)
+                self._close_sock_locked()
+                fut._fail(ConnectionLost(f"rpc {method!r} send failed: "
+                                         f"{exc}"))
+                return fut
+        self._count(sent=len(frame))
+        return fut
+
+    def call(self, seq: int, method: str, args: Dict[str, Any],
+             deadline_s: float) -> Any:
+        fut = self.call_async(seq, method, args, deadline_s)
+        # pre-multiplexing semantics: a sync caller that gives up on
+        # its deadline abandons the connection (the in-flight response
+        # would otherwise desynchronize a serial request/response view)
+        fut._on_deadline = self.drop_connection
+        return fut.result()
+
+    def drop_connection(self) -> None:
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+            self._close_sock_locked()
+        for fut in pending:
+            fut._fail(ConnectionLost("connection dropped"))
 
     def close(self) -> None:
         self.drop_connection()

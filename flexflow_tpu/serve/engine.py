@@ -293,6 +293,17 @@ class ServingConfig:
     # transport only — the loopback fails or succeeds instantly, and
     # all HEALTH accounting stays in deterministic cluster steps).
     rpc_backoff_s: float = 0.02
+    # Concurrent cluster stepping (the default): ClusterManager.step
+    # fans the per-replica step RPCs (and due idle heartbeats) out to
+    # every routable remote member at once and harvests them in
+    # replica-index order — a cluster step costs ~one round-trip
+    # instead of N. Completion order never changes behavior (health
+    # observations, failover order and journal records apply in
+    # replica-index order either way). False = the serial
+    # one-RPC-at-a-time reference loop, kept as the bench A/B arm and
+    # determinism oracle; in-process ("inproc") clusters always use it
+    # (there is no wire latency to overlap).
+    concurrent_stepping: bool = True
     # Elastic, crash-recoverable control plane (serve/cluster/
     # journal.py + reconfigure.py): a directory for the durable request
     # journal — an append-only, CRC-framed log of submissions,
