@@ -10,6 +10,7 @@ model.cc:4049-4200). The TPU framework's equivalents:
   serve        incremental decoding or SpecInfer over an HF checkpoint
                directory (or a tiny random model when omitted)
   search       Unity auto-parallel compile + strategy/dot export
+  serve-search offline ServingConfig search over the serving cost model
   bench        the headline benchmark (bench.py)
 
 Reference-style degree flags are accepted with either one or two
@@ -119,6 +120,14 @@ def cmd_serve(args):
         ),
         standby_replicas=args.standby_replicas,
         journal_dir=args.journal_dir,
+        autoscale=args.autoscale,
+        slo_ttft_s=args.slo_ttft_s,
+        slo_tpot_s=args.slo_tpot_s,
+        autoscale_cooldown_steps=args.autoscale_cooldown_steps,
+        autoscale_min_replicas=args.autoscale_min_replicas,
+        autoscale_max_replicas=(
+            args.autoscale_max_replicas or args.replicas
+        ),
     )
     ssms = []
     spec = None
@@ -224,6 +233,89 @@ def cmd_search(args):
     if args.export_dot:
         m.export_dot(args.export_dot)
         print("dot written to", args.export_dot)
+
+
+def cmd_serve_search(args):
+    from .serve.autotune import (
+        ModelGeometry,
+        TrafficProfile,
+        search_serving_config,
+    )
+
+    if args.model_dir:
+        import json
+        import types
+
+        with open(os.path.join(args.model_dir, "config.json")) as f:
+            geom = ModelGeometry.from_model_config(
+                types.SimpleNamespace(**json.load(f))
+            )
+    else:
+        geom = ModelGeometry(
+            hidden_size=args.hidden_size,
+            num_layers=args.num_layers,
+            num_heads=args.num_heads,
+            num_kv_heads=args.num_kv_heads or args.num_heads,
+            intermediate_size=args.intermediate_size,
+            vocab_size=args.vocab_size,
+            param_bytes=args.param_bytes,
+        )
+    traffic = TrafficProfile(
+        arrival_rate_rps=args.arrival_rate_rps,
+        prompt_len_p50=args.prompt_p50,
+        prompt_len_p99=args.prompt_p99 or 4 * args.prompt_p50,
+        output_len_p50=args.output_p50,
+        output_len_p99=args.output_p99 or 4 * args.output_p50,
+        prefix_share=args.prefix_share,
+        spec_accept_rate=args.spec_accept_rate,
+    )
+    best, report = search_serving_config(
+        geom, traffic,
+        chip_budget=args.chip_budget,
+        slo_ttft_s=args.slo_ttft_s,
+        slo_tpot_s=args.slo_tpot_s,
+        max_requests_per_batch=args.max_requests_per_batch,
+        max_sequence_length=args.max_sequence_length,
+        allow_disagg=not args.no_disagg,
+        top_k=args.top_k,
+    )
+    print(report.summary())
+    if best is None:
+        raise SystemExit(2)
+    for cand, pred in report.table:
+        print(
+            f"  tp={cand.tp} pp={cand.pp} replicas={cand.replicas} "
+            f"page={cand.page_size} kv={cand.kv_quant or 'fp'} "
+            f"spec={'on' if cand.speculation else 'off'} "
+            f"disagg={cand.prefill_replicas}p/{cand.decode_replicas}d "
+            f"-> {pred.tokens_per_s:.0f} tok/s "
+            f"ttft_p99={pred.ttft_s_p99 * 1e3:.1f}ms "
+            f"tpot_p99={pred.tpot_s_p99 * 1e3:.2f}ms "
+            f"{'feasible' if pred.feasible else pred.reason}"
+        )
+    sc = best.to_serving_config()
+    sc.validate_cluster()
+    flags = [
+        "--kv-layout paged",
+        f"--page-size {sc.page_size}",
+        f"--max-requests-per-batch {sc.max_requests_per_batch}",
+        f"--max-sequence-length {sc.max_sequence_length}",
+        f"--replicas {sc.replicas}",
+        f"--tensor-parallelism-degree {best.tp}",
+        f"--pipeline-parallelism-degree {best.pp}",
+    ]
+    if sc.kv_quant:
+        flags.append(f"--kv-quant {sc.kv_quant}")
+    if sc.prefill_replicas:
+        flags += [f"--prefill-replicas {sc.prefill_replicas}",
+                  f"--decode-replicas {sc.decode_replicas}"]
+    if "whole_step" in sc.fused_decode:
+        flags.append("--fused-decode whole_step --pallas")
+    if sc.quantized_allreduce:
+        flags.append(f"--quantized-allreduce {sc.quantized_allreduce}")
+    if best.speculation:
+        flags.append("--spec")
+    print("serve with: python -m flexflow_tpu serve " + " ".join(flags))
 
 
 def cmd_bench(args):
@@ -350,6 +442,34 @@ def main(argv=None):
                         "GenerationResult.error, never a hang) when "
                         "every replica's queue-delay estimate exceeds "
                         "this many seconds")
+    s.add_argument("--autoscale", choices=["drive", "advise"],
+                   default=None,
+                   help="self-driving serving (serve/autotune): a cost-"
+                        "model policy loop in the cluster drive loop — "
+                        "'drive' applies journaled scale_out/scale_in/"
+                        "retune decisions, 'advise' journals + counts "
+                        "every decision without applying (dry run); "
+                        "requires --slo-ttft-s and/or --slo-tpot-s and "
+                        "an --autoscale-max-replicas ceiling")
+    s.add_argument("--slo-ttft-s", type=float, default=None,
+                   help="autoscale objective: predicted time-to-first-"
+                        "token p99 SLO in seconds (admission wait on "
+                        "the routed pool + the prefill pass)")
+    s.add_argument("--slo-tpot-s", type=float, default=None,
+                   help="autoscale objective: predicted time-per-output-"
+                        "token p99 SLO in seconds (the decode-step "
+                        "interval)")
+    s.add_argument("--autoscale-cooldown-steps", type=int, default=64,
+                   help="minimum CLUSTER STEPS between applied "
+                        "autoscale actions (hysteresis floor; never "
+                        "wall clock, so replays reproduce decisions)")
+    s.add_argument("--autoscale-min-replicas", type=int, default=1,
+                   help="floor of the replica band the autoscaler may "
+                        "move within")
+    s.add_argument("--autoscale-max-replicas", type=int, default=0,
+                   help="ceiling of the replica band (required >= the "
+                        "floor when --autoscale is set — an unbounded "
+                        "scale_out is a cost bug)")
     s.add_argument("--migration-queue-budget", type=int, default=None,
                    help="disaggregated back-pressure: at most this many "
                         "finished prefills wait for decode-pool "
@@ -428,6 +548,56 @@ def main(argv=None):
     q.add_argument("--export-strategy", default=None)
     q.add_argument("--export-dot", default=None)
     q.set_defaults(fn=cmd_search)
+
+    ss = sub.add_parser(
+        "serve-search",
+        help="offline ServingConfig search over the serving cost model",
+        description="serve/autotune offline search: enumerate + refine "
+                    "serving candidates (TPxPP, replicas, page size, KV "
+                    "quant, disagg, speculation) through the analytical "
+                    "cost model for a model geometry and traffic "
+                    "profile, under a chip budget and optional TTFT/"
+                    "TPOT p99 SLO constraints; prints the leaderboard "
+                    "and the validated `serve` flags for the winner.")
+    ss.add_argument("--model-dir", default=None,
+                    help="derive geometry from DIR/config.json instead "
+                         "of the --hidden-size/... flags")
+    ss.add_argument("--hidden-size", type=int, default=128)
+    ss.add_argument("--num-layers", type=int, default=4)
+    ss.add_argument("--num-heads", type=int, default=8)
+    ss.add_argument("--num-kv-heads", type=int, default=0,
+                    help="0 = same as --num-heads (no GQA)")
+    ss.add_argument("--intermediate-size", type=int, default=344)
+    ss.add_argument("--vocab-size", type=int, default=512)
+    ss.add_argument("--param-bytes", type=float, default=2.0,
+                    help="bytes per weight (2=bf16, 1=int8, 0.5=int4)")
+    ss.add_argument("--arrival-rate-rps", type=float, default=1.0)
+    ss.add_argument("--prompt-p50", type=float, default=128.0)
+    ss.add_argument("--prompt-p99", type=float, default=0.0,
+                    help="0 = 4x the p50")
+    ss.add_argument("--output-p50", type=float, default=128.0)
+    ss.add_argument("--output-p99", type=float, default=0.0,
+                    help="0 = 4x the p50")
+    ss.add_argument("--prefix-share", type=float, default=0.0,
+                    help="fraction of prompt tokens expected to hit the "
+                         "prefix cache")
+    ss.add_argument("--spec-accept-rate", type=float, default=0.0,
+                    help="expected speculative acceptance rate (0 "
+                         "disables speculation candidates)")
+    ss.add_argument("--chip-budget", type=int, default=8,
+                    help="max chips = tp * pp * replicas")
+    ss.add_argument("--slo-ttft-s", type=float, default=None,
+                    help="TTFT p99 SLO constraint in seconds (breaching "
+                         "candidates are infeasible, not down-weighted)")
+    ss.add_argument("--slo-tpot-s", type=float, default=None,
+                    help="TPOT p99 SLO constraint in seconds")
+    ss.add_argument("--max-requests-per-batch", type=int, default=16)
+    ss.add_argument("--max-sequence-length", type=int, default=2048)
+    ss.add_argument("--no-disagg", action="store_true",
+                    help="exclude disaggregated prefill/decode pools")
+    ss.add_argument("--top-k", type=int, default=8,
+                    help="leaderboard rows to print")
+    ss.set_defaults(fn=cmd_serve_search)
 
     b = sub.add_parser("bench", help="headline benchmark (one JSON line)")
     b.set_defaults(fn=cmd_bench)

@@ -184,6 +184,26 @@ HOT_ROOTS = {
     "_run_whole",
     "_get_whole_step",
     "_serve_whole_fn",
+    # self-driving serving (serve/autotune/): the autoscaler's per-step
+    # hook, its evaluation + decision paths and the estimator's
+    # observation fold all run INSIDE ClusterManager.step — host-side
+    # counter arithmetic only, and a blocking device transfer smuggled
+    # into any of them would tax every cluster step. on_step is already
+    # a root (fault injection shares the name); these cover the rest of
+    # the policy/estimator drive-loop surface. observe/observe_cluster/
+    # profile fold the telemetry; predict prices a candidate; the
+    # _decide_* and _sweep_completions paths mutate cluster state.
+    "observe",
+    "observe_cluster",
+    "profile",
+    "predict",
+    "_evaluate",
+    "_decide_scale_out",
+    "_decide_scale_in",
+    "_maybe_retune",
+    "_sweep_completions",
+    "drain_completion_window",
+    "rate_snapshot",
 }
 
 # Calls that force a synchronous transfer / device round-trip.

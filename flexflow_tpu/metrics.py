@@ -402,6 +402,28 @@ class ClusterStats:
     journal_compactions: int = 0
     manager_recoveries: int = 0
     journal_replayed: int = 0
+    # Self-driving serving (serve/autotune): policy decisions taken
+    # (applied or advisory), speculation-bucket retunes advised, and
+    # the predicted-vs-measured throughput gauges the autoscaler
+    # refreshes every evaluation (tokens/sec — how far off the cost
+    # model is on this box). Per-replica arrival/completion counters
+    # and the bounded admission-time queue-delay reservoir feed the
+    # TrafficEstimator; the dict fields stay out of Prometheus (the
+    # derived ``queue_delay_s_p50/p99`` and the per-replica snapshot
+    # maps ride along instead).
+    autoscale_decisions: int = 0
+    retunes: int = 0
+    autoscale_predicted_tps: float = 0.0
+    autoscale_measured_tps: float = 0.0
+    arrivals_per_replica: Dict[int, int] = dataclasses.field(
+        default_factory=dict
+    )
+    completions_per_replica: Dict[int, int] = dataclasses.field(
+        default_factory=dict
+    )
+    queue_delay_s_samples: List[float] = dataclasses.field(
+        default_factory=list
+    )
 
     def record_placement(self, how: str) -> None:
         self.placements[how] = self.placements.get(how, 0) + 1
@@ -413,6 +435,31 @@ class ClusterStats:
         reservoir, same trim discipline as decode_step_ms)."""
         s = self.cluster_step_ms_samples
         s.append(float(ms))
+        if len(s) > _DECODE_MS_CAP:
+            del s[: len(s) - _DECODE_MS_CAP]
+
+    def note_arrival(self, replica: int) -> None:
+        """Count one first-time placement onto ``replica`` (failover
+        re-admissions are NOT arrivals — the request already counted)."""
+        r = int(replica)
+        self.arrivals_per_replica[r] = self.arrivals_per_replica.get(r, 0) + 1
+
+    def note_completion(self, replica: int) -> None:
+        """Count one successfully finished request against the replica
+        that first homed it (profile.replica_id — stable across
+        failovers, so arrivals and completions reconcile per home)."""
+        r = int(replica)
+        self.completions_per_replica[r] = (
+            self.completions_per_replica.get(r, 0) + 1
+        )
+
+    def note_queue_delay_s(self, delay_s: float) -> None:
+        """Record one admission-time queue-delay estimate (bounded
+        reservoir). Pre-envelope/cold-replica placements report 0.0 —
+        a real sample ("no estimated wait"), kept, not dropped: the
+        percentiles must reflect what admission actually saw."""
+        s = self.queue_delay_s_samples
+        s.append(max(0.0, float(delay_s)))
         if len(s) > _DECODE_MS_CAP:
             del s[: len(s) - _DECODE_MS_CAP]
 
@@ -439,6 +486,27 @@ class ClusterStats:
     @property
     def cluster_step_ms_p99(self) -> float:
         return self._pct(self.cluster_step_ms_samples, 0.99)
+
+    @property
+    def queue_delay_s_p50(self) -> float:
+        return self._pct(self.queue_delay_s_samples, 0.50)
+
+    @property
+    def queue_delay_s_p99(self) -> float:
+        return self._pct(self.queue_delay_s_samples, 0.99)
+
+    def arrivals_completions_per_replica(self) -> Dict[int, Dict[str, int]]:
+        """Per-replica arrival/completion reconciliation map — the
+        difference is the replica's live (or lost-to-error) load."""
+        out: Dict[int, Dict[str, int]] = {}
+        for idx in sorted(
+            set(self.arrivals_per_replica) | set(self.completions_per_replica)
+        ):
+            out[idx] = {
+                "arrivals": self.arrivals_per_replica.get(idx, 0),
+                "completions": self.completions_per_replica.get(idx, 0),
+            }
+        return out
 
     def _all_rtt(self) -> List[float]:
         return [
@@ -545,6 +613,15 @@ class ClusterStats:
             "journal_compactions": self.journal_compactions,
             "manager_recoveries": self.manager_recoveries,
             "journal_replayed": self.journal_replayed,
+            "autoscale_decisions": self.autoscale_decisions,
+            "retunes": self.retunes,
+            "autoscale_predicted_tps": self.autoscale_predicted_tps,
+            "autoscale_measured_tps": self.autoscale_measured_tps,
+            "queue_delay_s_p50": round(self.queue_delay_s_p50, 6),
+            "queue_delay_s_p99": round(self.queue_delay_s_p99, 6),
+            "arrivals_completions_per_replica": (
+                self.arrivals_completions_per_replica()
+            ),
             "replicas": agg,
             "per_replica": per,
         }
@@ -572,6 +649,9 @@ class ClusterStats:
             f"scale+{s['scale_outs']}/-{s['scale_ins']} "
             f"flip={s['pool_flips']} jrnl={s['journal_records']}r/"
             f"{s['journal_bytes']}B recov={s['manager_recoveries']} "
+            f"autoscale={s['autoscale_decisions']}d/{s['retunes']}rt "
+            f"qdelay_s={s['queue_delay_s_p50']:.3f}/"
+            f"{s['queue_delay_s_p99']:.3f} "
             f"wireB={s['wire_bytes_sent']}/{s['wire_bytes_received']} "
             f"pfx_hit_rate={agg.get('prefix_hit_rate', 0.0)} "
             f"adm={agg.get('admitted', 0)} "

@@ -140,10 +140,11 @@ CLUSTER_COUNTERS = frozenset({
     "standby_adoptions", "wire_bytes_sent", "wire_bytes_received",
     "scale_outs", "scale_ins", "pool_flips", "journal_records",
     "journal_bytes", "journal_compactions", "manager_recoveries",
-    "journal_replayed",
+    "journal_replayed", "autoscale_decisions", "retunes",
 })
 CLUSTER_GAUGES = frozenset({
     "migration_queue_depth", "migration_queue_peak", "rpc_inflight_peak",
+    "autoscale_predicted_tps", "autoscale_measured_tps",
 })
 #: ``placements`` is a by-how dict — exported as ONE labeled counter
 #: series rather than a scalar field. The RTT/step-time reservoirs are
@@ -154,12 +155,18 @@ CLUSTER_EXCLUDED = {
     "placements": "flexflow_cluster_placements{how=...}",
     "cluster_step_ms_samples": "cluster_step_ms_p50",
     "rpc_rtt_ms_samples": "rpc_rtt_ms_p50",
+    # per-replica maps ride the snapshot's reconciliation dict; the
+    # scalar scrape surface carries the summed counters + percentiles
+    "arrivals_per_replica": "arrivals_completions_per_replica",
+    "completions_per_replica": "arrivals_completions_per_replica",
+    "queue_delay_s_samples": "queue_delay_s_p50",
 }
 #: Derived ClusterStats properties exported as gauges alongside the
 #: raw counters (the percentile halves of the excluded reservoirs).
 CLUSTER_DERIVED = (
     "cluster_step_ms_p50", "cluster_step_ms_p99",
     "rpc_rtt_ms_p50", "rpc_rtt_ms_p99",
+    "queue_delay_s_p50", "queue_delay_s_p99",
 )
 
 #: ProfileInfo numeric fields aggregated to ``_sum`` counters over the

@@ -1,0 +1,50 @@
+"""Self-driving serving (ROADMAP item 2): a serving cost model, offline
+ServingConfig search, and a live journaled autoscaler.
+
+Four parts, layered bottom-up:
+
+* :mod:`cost_model` — an analytical serving cost model on top of
+  ``search/machine_model.py``'s chip rooflines and ring-collective
+  formulas: given model geometry + a candidate serving shape
+  (:class:`~.cost_model.ServingCandidate`) + a
+  :class:`~.cost_model.TrafficProfile`, predict tokens/sec, TTFT/TPOT
+  p50/p99 and HBM/page-pool occupancy. Decode steps are priced as
+  bandwidth-bound weight+KV streaming, prefill as compute-bound, TP
+  collectives through the machine model's link degrees.
+* :mod:`workload` — :class:`~.workload.TrafficEstimator`: fits a
+  TrafficProfile ONLINE from the cluster's own telemetry on the
+  deterministic cluster step clock (no wall clock — the same
+  observation sequence always fits the same profile).
+* :mod:`search` — offline pruned enumeration + coordinate-descent
+  refinement (the ``search/unity.py`` flavor) over the ServingConfig
+  space, maximizing predicted tokens/sec under TTFT/TPOT SLOs and
+  emitting a ready-to-run, ``validate_cluster``-clean ServingConfig.
+* :mod:`policy` — :class:`~.policy.Autoscaler`: the online loop in
+  ``ClusterManager.step`` that feeds the live estimator through the
+  cost model and DRIVES the PR-14 journaled reconfigurations
+  (scale_out / scale_in / set_pools / speculation-bucket retunes) with
+  hysteresis bands + cooldown windows counted in cluster steps.
+"""
+from .cost_model import (
+    ModelGeometry,
+    ServingCandidate,
+    ServingCostModel,
+    ServingPrediction,
+    TrafficProfile,
+)
+from .policy import AutoscaleDecision, Autoscaler
+from .search import ServingSearchReport, search_serving_config
+from .workload import TrafficEstimator
+
+__all__ = [
+    "AutoscaleDecision",
+    "Autoscaler",
+    "ModelGeometry",
+    "ServingCandidate",
+    "ServingCostModel",
+    "ServingPrediction",
+    "ServingSearchReport",
+    "TrafficEstimator",
+    "TrafficProfile",
+    "search_serving_config",
+]

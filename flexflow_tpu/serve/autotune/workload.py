@@ -1,0 +1,239 @@
+"""Online traffic estimation on the deterministic cluster step clock.
+
+:class:`TrafficEstimator` fits a :class:`~.cost_model.TrafficProfile`
+from the telemetry the cluster already produces — the PR-13
+ClusterStats counters and the per-replica queue-delay estimates — one
+observation per cluster step, with NO wall clock anywhere: rates are
+EMAs in per-STEP units, length distributions are fixed-boundary
+histograms, and percentiles are nearest-rank over those buckets. The
+same observation sequence therefore always fits bit-identical profiles
+(tests/test_autotune.py asserts it), which is what makes autoscaler
+decisions replayable: a journal replay that reconstructs the same
+counters reconstructs the same profile, the same predictions and the
+same decisions.
+
+Wall time enters exactly once, at the EDGE: :meth:`profile` takes an
+explicit ``step_time_s`` (the caller's measured ``cluster_step_ms``
+p50, or a pinned constant in tests) to convert per-step rates into the
+per-second units the cost model prices.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .cost_model import TrafficProfile
+
+__all__ = ["TrafficEstimator"]
+
+#: Length-histogram bucket upper edges (tokens): powers of two — fixed
+#: boundaries keep the percentile arithmetic deterministic and the
+#: state O(1) regardless of how long the cluster runs.
+_LEN_EDGES = tuple(2 ** i for i in range(1, 21))
+
+
+class _LenHistogram:
+    """Fixed-boundary histogram with nearest-rank percentiles (the
+    same discipline metrics.py's ``_pct`` uses over reservoirs, but
+    with bounded state)."""
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(_LEN_EDGES) + 1)
+        self.total = 0
+        self.sum = 0.0
+
+    def add(self, value: float) -> None:
+        self.total += 1
+        self.sum += float(value)
+        for i, edge in enumerate(_LEN_EDGES):
+            if value <= edge:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def pct(self, q: float) -> float:
+        """Nearest-rank percentile, reported at the bucket's upper
+        edge. 0 on an empty histogram — the pre-envelope window."""
+        if self.total == 0:
+            return 0.0
+        rank = max(1, int(round(q * self.total)))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return float(
+                    _LEN_EDGES[i] if i < len(_LEN_EDGES)
+                    else 2 * _LEN_EDGES[-1]
+                )
+        return float(2 * _LEN_EDGES[-1])
+
+    def mean(self) -> float:
+        return self.sum / self.total if self.total else 0.0
+
+
+class TrafficEstimator:
+    """Fits a TrafficProfile online, one :meth:`observe` per cluster
+    step. All inputs are plain numbers read off counters — cumulative
+    where the source is cumulative (``submitted``, prefix/spec
+    counters; the estimator takes deltas itself) — so feeding the same
+    sequence twice yields the same profile."""
+
+    def __init__(self, *, ema_alpha: float = 0.05,
+                 warmup_steps: int = 8) -> None:
+        if not 0.0 < ema_alpha <= 1.0:
+            raise ValueError(f"ema_alpha must be in (0, 1] (got {ema_alpha})")
+        self.ema_alpha = ema_alpha
+        #: observations before :meth:`ready` — pre-envelope windows
+        #: (remote stats mirrors fill from heartbeats) fit garbage
+        self.warmup_steps = warmup_steps
+        self.steps_observed = 0
+        # per-step EMAs
+        self._arrivals_per_step = 0.0
+        self._completions_per_step = 0.0
+        self._queue_delay_ema = 0.0
+        # cumulative high-water marks (deltas taken per observation)
+        self._seen_submitted = 0
+        self._seen_prefix = (0, 0)       # hits, misses
+        self._seen_spec = (0, 0)         # accepted, drafted
+        # ratio EMAs
+        self._prefix_share_ema = 0.0
+        self._accept_ema = 0.0
+        # length histograms over completed requests
+        self._prompt_hist = _LenHistogram()
+        self._output_hist = _LenHistogram()
+
+    # -- observation --------------------------------------------------
+
+    def observe(
+        self,
+        *,
+        submitted: int,
+        completions: Sequence[Tuple[int, int]] = (),
+        queue_delay_s: float = 0.0,
+        prefix_hits: int = 0,
+        prefix_misses: int = 0,
+        spec_accepted: int = 0,
+        spec_drafted: int = 0,
+    ) -> None:
+        """Fold one cluster step's telemetry in. ``submitted`` /
+        prefix / spec inputs are the CUMULATIVE counters (pass the
+        stats fields verbatim); ``completions`` is this step's newly
+        terminal requests as ``(prompt_len, output_len)`` pairs;
+        ``queue_delay_s`` is the max routable-replica estimate (0 on
+        pre-envelope windows — see Replica.rate_snapshot)."""
+        self.steps_observed += 1
+        a = self.ema_alpha
+        arrived = max(0, int(submitted) - self._seen_submitted)
+        self._seen_submitted = max(self._seen_submitted, int(submitted))
+        self._arrivals_per_step += a * (arrived - self._arrivals_per_step)
+        self._completions_per_step += a * (
+            len(completions) - self._completions_per_step
+        )
+        self._queue_delay_ema += a * (
+            max(0.0, float(queue_delay_s)) - self._queue_delay_ema
+        )
+        for prompt_len, output_len in completions:
+            self._prompt_hist.add(max(1, int(prompt_len)))
+            self._output_hist.add(max(1, int(output_len)))
+        hits_d = max(0, int(prefix_hits) - self._seen_prefix[0])
+        miss_d = max(0, int(prefix_misses) - self._seen_prefix[1])
+        self._seen_prefix = (
+            max(self._seen_prefix[0], int(prefix_hits)),
+            max(self._seen_prefix[1], int(prefix_misses)),
+        )
+        if hits_d + miss_d:
+            inst = hits_d / (hits_d + miss_d)
+            self._prefix_share_ema += a * (inst - self._prefix_share_ema)
+        acc_d = max(0, int(spec_accepted) - self._seen_spec[0])
+        drf_d = max(0, int(spec_drafted) - self._seen_spec[1])
+        self._seen_spec = (
+            max(self._seen_spec[0], int(spec_accepted)),
+            max(self._seen_spec[1], int(spec_drafted)),
+        )
+        if drf_d:
+            inst = min(1.0, acc_d / drf_d)
+            self._accept_ema += a * (inst - self._accept_ema)
+
+    def observe_cluster(self, cm) -> None:
+        """Convenience: gather one step's inputs from a live
+        ClusterManager — the autoscaler's per-step path. Reads only
+        host-side counters and the documented replica rate surface
+        (Replica.rate_snapshot); never touches a device."""
+        st = cm.stats
+        agg_hits = agg_miss = agg_acc = agg_drf = 0
+        delay = 0.0
+        for rep in cm.replicas:
+            try:
+                s = rep.stats
+                agg_hits += int(getattr(s, "prefix_hits", 0))
+                agg_miss += int(getattr(s, "prefix_misses", 0))
+                agg_acc += int(getattr(s, "spec_accepted", 0))
+                agg_drf += int(getattr(s, "spec_drafted", 0))
+                delay = max(delay, rep.rate_snapshot()["queue_delay_s"])
+            except Exception:
+                # a DOWN / mid-reconnect replica must not stall the
+                # estimator — its stats simply sit this window out
+                continue
+        completions = cm.drain_completion_window()
+        self.observe(
+            submitted=st.submitted,
+            completions=completions,
+            queue_delay_s=delay,
+            prefix_hits=agg_hits,
+            prefix_misses=agg_miss,
+            spec_accepted=agg_acc,
+            spec_drafted=agg_drf,
+        )
+
+    # -- the fitted profile -------------------------------------------
+
+    def ready(self) -> bool:
+        """True once the warmup window has passed AND at least one
+        request completed — before that, :meth:`profile` extrapolates
+        from defaults and the policy should hold."""
+        return (
+            self.steps_observed >= self.warmup_steps
+            and self._prompt_hist.total > 0
+        )
+
+    def arrival_rate_per_step(self) -> float:
+        return self._arrivals_per_step
+
+    def queue_delay_s(self) -> float:
+        return self._queue_delay_ema
+
+    def spec_accept_rate(self) -> float:
+        return self._accept_ema
+
+    def profile(self, *, step_time_s: float) -> TrafficProfile:
+        """The fitted TrafficProfile. ``step_time_s`` converts per-step
+        rates to per-second — the ONE wall-clock input, supplied by the
+        caller (measured cluster_step_ms p50, or pinned in tests)."""
+        if step_time_s <= 0.0:
+            raise ValueError(
+                f"step_time_s must be > 0 (got {step_time_s})"
+            )
+        return TrafficProfile(
+            arrival_rate_rps=self._arrivals_per_step / step_time_s,
+            prompt_len_p50=self._prompt_hist.pct(0.50) or 128.0,
+            prompt_len_p99=self._prompt_hist.pct(0.99) or 512.0,
+            output_len_p50=self._output_hist.pct(0.50) or 128.0,
+            output_len_p99=self._output_hist.pct(0.99) or 512.0,
+            prefix_share=self._prefix_share_ema,
+            spec_accept_rate=self._accept_ema,
+        )
+
+    def snapshot(self) -> Dict[str, float]:
+        """Debug/test surface: every fitted statistic as plain floats."""
+        return {
+            "steps_observed": self.steps_observed,
+            "arrivals_per_step": self._arrivals_per_step,
+            "completions_per_step": self._completions_per_step,
+            "queue_delay_s": self._queue_delay_ema,
+            "prefix_share": self._prefix_share_ema,
+            "spec_accept_rate": self._accept_ema,
+            "prompt_len_p50": self._prompt_hist.pct(0.50),
+            "prompt_len_p99": self._prompt_hist.pct(0.99),
+            "output_len_p50": self._output_hist.pct(0.50),
+            "output_len_p99": self._output_hist.pct(0.99),
+            "completed": self._prompt_hist.total,
+        }

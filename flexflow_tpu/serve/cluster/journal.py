@@ -257,6 +257,12 @@ def _apply(state: JournalState, rec: Dict[str, Any]) -> None:
     # own: a commit always writes the members snapshot alongside, and a
     # begin without a commit means the op never happened — replay
     # ignores both and keeps the last committed membership.
+    # "autoscale" records (serve/autotune/policy.py) are likewise
+    # replay-inert: each is the AUDIT record of one policy decision
+    # (kind/reason/applied), while the applied op's own reconfig
+    # begin→commit + members snapshot carry the recoverable state — so
+    # a SIGKILL between a decision and its commit recovers exactly like
+    # any torn reconfig: as if the decision never fired.
 
 
 def replay_journal(path: str) -> JournalState:

@@ -58,7 +58,9 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Union
+from typing import (
+    Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple, Union,
+)
 
 from ...logging_utils import get_logger
 from ...metrics import ClusterStats
@@ -324,6 +326,16 @@ class ClusterManager:
             (r.index for r in list(self.replicas) + self.standbys),
             default=-1,
         )
+        # Self-driving serving (serve/autotune): the optional policy
+        # loop hooked into step() — attached by build()/recover() when
+        # ServingConfig.autoscale is set, or injected by tests. The
+        # completion window feeds its TrafficEstimator: cluster ids
+        # still awaiting their terminal sweep, plus this-window
+        # (prompt_len, output_len) pairs for newly finished requests,
+        # drained by drain_completion_window() once per observation.
+        self.autoscaler = None
+        self._open_cids: Set[int] = set()
+        self._completion_window: List[Tuple[int, int]] = []
 
     # ------------------------------------------------------------------
     # construction
@@ -399,6 +411,8 @@ class ClusterManager:
         # stale journal replaying into a new cluster would resurrect a
         # previous run's requests
         cm._open_journal(resume=False)
+        if serving.autoscale:
+            cm._attach_autoscaler()
         return cm
 
     @classmethod
@@ -559,6 +573,15 @@ class ClusterManager:
         # idempotent and the file bounded)
         cm._open_journal(resume=True)
         cm._journal_checkpoint(include_finished=True)
+        # unfinished rehydrated requests re-enter the completion sweep;
+        # a fresh autoscaler (cooldown re-armed from the current step)
+        # resumes the policy loop over the recovered membership
+        cm._open_cids = {
+            cid for cid, cr in cm.requests.items()
+            if cr.status not in TERMINAL_STATUSES
+        }
+        if serving.autoscale:
+            cm._attach_autoscaler()
         cm._log.warning(
             "manager recovered from %s: %d replicas, %d requests "
             "rehydrated (%d re-admitted, %d already terminal)%s",
@@ -827,6 +850,7 @@ class ClusterManager:
             session_id=session_id, prompt_len=len(tokens), _manager=self,
         )
         self.requests[cid] = cr
+        self._open_cids.add(cid)
         self._place(cr, tokens)
         if self.journal is not None:
             # durable the moment submit returns: the journaled prompt
@@ -939,6 +963,12 @@ class ClusterManager:
                 return self._place_failed(cr, how)
             rep = self.replicas[self._routing_pos[pos]]
         delay = rep.queue_delay_s()
+        if first:
+            # per-replica arrival accounting + the admission-time
+            # queue-delay sample (what the router saw, not a later
+            # re-read) — the autotune TrafficEstimator's raw inputs
+            self.stats.note_arrival(rep.index)
+            self.stats.note_queue_delay_s(delay)
         cr.replica = self.replicas.index(rep)
         cr.phase = phase
         if phase == "prefill":
@@ -1692,6 +1722,12 @@ class ClusterManager:
             # backoff windows — a generate() must never break out and
             # strand a request between homes
             progressed = True
+        # completion sweep + autoscale BEFORE the journal sync: a
+        # policy decision's records (and the scale ops' begin records)
+        # batch into the same durable flush as the step that made them
+        self._sweep_completions()
+        if self.autoscaler is not None:
+            self.autoscaler.on_step(step_no)
         # journal sync point: flushed-token deltas + newly terminal
         # records batch into ONE buffered write + file flush per step
         self._journal_sync()
@@ -1729,7 +1765,54 @@ class ClusterManager:
             self._drain_migration_queue()
         self._run_failovers()
         _maybe_retire(self)
+        self._sweep_completions()
         self._journal_sync()
+
+    def _sweep_completions(self) -> None:
+        """Settle per-replica completion accounting for requests that
+        went terminal since the last sweep: counters on ClusterStats,
+        and ``(prompt_len, output_len)`` pairs into the completion
+        window the autotune TrafficEstimator drains. Errored requests
+        leave the open set but do NOT enter the window — a shed
+        request's zero-length output is not a service-time sample."""
+        if not self._open_cids:
+            return
+        closed = []
+        for cid in self._open_cids:
+            cr = self.requests.get(cid)
+            if cr is None:
+                closed.append(cid)
+                continue
+            st = cr.status
+            if st not in TERMINAL_STATUSES:
+                continue
+            closed.append(cid)
+            if st is RequestStatus.ERROR:
+                continue
+            produced = len(cr.output_tokens)
+            self._completion_window.append((cr.prompt_len, produced))
+            rep_idx = int(cr.profile.replica_id)
+            if rep_idx >= 0:
+                self.stats.note_completion(rep_idx)
+        for cid in closed:
+            self._open_cids.discard(cid)
+        # bound the window even if nobody drains it (no autoscaler)
+        if len(self._completion_window) > 4096:
+            del self._completion_window[:-4096]
+
+    def drain_completion_window(self) -> List[Tuple[int, int]]:
+        """Hand over (and clear) the ``(prompt_len, output_len)`` pairs
+        of requests that finished since the last call — the autotune
+        TrafficEstimator's per-observation completion feed."""
+        window, self._completion_window = self._completion_window, []
+        return window
+
+    def _attach_autoscaler(self) -> None:
+        # lazy import: serve.cluster must not depend on serve.autotune
+        # at import time (autotune imports the cost model stack)
+        from ..autotune.policy import Autoscaler
+
+        self.autoscaler = Autoscaler.from_manager(self)
 
     # ------------------------------------------------------------------
     # results

@@ -28,7 +28,7 @@ the router never reaches past them.
 from __future__ import annotations
 
 import time
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from ...logging_utils import get_logger
 from ..engine import InferenceEngine, ServingConfig
@@ -180,6 +180,29 @@ class Replica:
         if self._rate_samples < 2 or self._rate <= 0.0:
             return 0.0
         return self.backlog_tokens() / self._rate
+
+    def rate_snapshot(self) -> Dict[str, float]:
+        """The DOCUMENTED read path over the rate-EMA internals, for
+        telemetry consumers (the autotune TrafficEstimator, tests,
+        dashboards) — everything the router's shed decision sees, as
+        plain floats:
+
+        * ``token_rate`` — the ``_rate`` EMA (tokens/sec; 0.8·prev +
+          0.2·instantaneous per :meth:`step`, 0.0 while cold).
+        * ``rate_samples`` — EMA updates folded in so far; the
+          queue-delay gate opens at 2 (see :meth:`queue_delay_s`).
+        * ``backlog_tokens`` — accepted-but-undispatched work.
+        * ``queue_delay_s`` — backlog/rate, 0.0 while the gate is
+          closed (cold replica, post-``reset_rate``, pre-envelope
+          remote mirror) — consumers must treat 0.0 as "no estimate",
+          NOT "idle".
+        """
+        return {
+            "token_rate": float(self._rate),
+            "rate_samples": float(self._rate_samples),
+            "backlog_tokens": float(self.backlog_tokens()),
+            "queue_delay_s": float(self.queue_delay_s()),
+        }
 
     # ------------------------------------------------------------------
     # scheduling passthrough

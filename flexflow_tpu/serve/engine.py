@@ -341,6 +341,33 @@ class ServingConfig:
     # them on, and FF_SANITIZERS=retrace,donation enables them from the
     # environment without touching code.
     sanitizers: Tuple[str, ...] = ()
+    # Self-driving serving (serve/autotune/policy.py): None (default) =
+    # no policy loop; "drive" = a cost-model Autoscaler rides
+    # ClusterManager.step and APPLIES journaled reconfigurations
+    # (scale_out / scale_in / retune advisories); "advise" = the same
+    # loop evaluates and journals every decision but applies none
+    # (dry-run — the counters and the journal audit trail still fill).
+    autoscale: Optional[str] = None
+    # Latency SLOs the autoscaler's PREDICTIONS are held to, seconds.
+    # slo_ttft_s governs time-to-first-token p99 — admission wait on
+    # the ROUTED pool plus the prefill pass; slo_tpot_s governs
+    # time-per-output-token p99 — the decode-step interval on whichever
+    # pool decodes. At least one must be set when autoscale is on
+    # (a policy with no objective can never act). Both are PREDICTED
+    # quantities over the fitted traffic profile, distinct from
+    # slo_queue_delay_s, which is the router's MEASURED admission gate.
+    slo_ttft_s: Optional[float] = None
+    slo_tpot_s: Optional[float] = None
+    # Minimum cluster steps between APPLIED autoscale actions — the
+    # hysteresis floor that keeps a burst from triggering a scale_out /
+    # scale_in flap (counted in cluster steps, never wall clock, so
+    # replays reproduce decisions).
+    autoscale_cooldown_steps: int = 64
+    # The replica-count band the policy may move within. max_replicas
+    # must be set (>= min) when autoscale="drive" — an unbounded
+    # scale_out is a cost bug, not a default.
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 0
 
     def validate_cluster(self, *, specinfer: bool = False) -> None:
         """Fail-fast validation of the cluster fields — called from
@@ -399,6 +426,25 @@ class ServingConfig:
             raise ValueError(
                 f"slo_queue_delay_s must be >= 0 (got "
                 f"{self.slo_queue_delay_s})"
+            )
+        if self.slo_queue_delay_s is not None and self.prefill_replicas:
+            # Under disaggregated pools the ROUTED set is the PREFILL
+            # pool only (cluster/manager.py rebuild_routing), so this
+            # SLO would shed on prefill-pool admission delay while the
+            # decode pool's backlog — where TPOT pain actually lives —
+            # stays invisible to admission. That half-blind gate has
+            # bitten quietly; refuse it loudly instead.
+            raise ValueError(
+                "slo_queue_delay_s is not composed with disaggregated "
+                "prefill/decode pools: the router only sees the PREFILL "
+                "pool's queue-delay estimates (routing targets the "
+                "prefill pool; decode backlog is invisible to "
+                "admission), so the SLO would govern only prefill "
+                "admission wait and silently ignore decode saturation. "
+                "Use slo_ttft_s/slo_tpot_s with autoscale to manage a "
+                "disaggregated cluster's latency, or drop the pools "
+                f"(got slo_queue_delay_s={self.slo_queue_delay_s}, "
+                f"prefill_replicas={self.prefill_replicas})"
             )
         if self.failover_retries < 0:
             raise ValueError(
@@ -469,6 +515,54 @@ class ServingConfig:
             raise ValueError(
                 "journal_dir must be a non-empty directory path or None"
             )
+        if self.autoscale not in (None, "drive", "advise"):
+            raise ValueError(
+                f"unknown autoscale {self.autoscale!r} (expected None, "
+                "'drive' or 'advise')"
+            )
+        if self.slo_ttft_s is not None and self.slo_ttft_s <= 0:
+            raise ValueError(
+                f"slo_ttft_s must be > 0 (got {self.slo_ttft_s})"
+            )
+        if self.slo_tpot_s is not None and self.slo_tpot_s <= 0:
+            raise ValueError(
+                f"slo_tpot_s must be > 0 (got {self.slo_tpot_s})"
+            )
+        if self.autoscale_cooldown_steps < 1:
+            raise ValueError(
+                f"autoscale_cooldown_steps must be >= 1 (got "
+                f"{self.autoscale_cooldown_steps})"
+            )
+        if self.autoscale_min_replicas < 1:
+            raise ValueError(
+                f"autoscale_min_replicas must be >= 1 (got "
+                f"{self.autoscale_min_replicas})"
+            )
+        if self.autoscale is not None:
+            if self.slo_ttft_s is None and self.slo_tpot_s is None:
+                raise ValueError(
+                    f"autoscale={self.autoscale!r} needs an objective: "
+                    "set slo_ttft_s and/or slo_tpot_s (PREDICTED-latency "
+                    "SLOs — the policy scales to hold them)"
+                )
+            if self.autoscale_max_replicas < self.autoscale_min_replicas:
+                raise ValueError(
+                    f"autoscale_max_replicas "
+                    f"({self.autoscale_max_replicas}) must be >= "
+                    f"autoscale_min_replicas "
+                    f"({self.autoscale_min_replicas}) when autoscale is "
+                    "on — an unbounded scale_out is a cost bug, so the "
+                    "ceiling is explicit"
+                )
+            if not (
+                self.autoscale_min_replicas <= self.replicas
+                <= self.autoscale_max_replicas
+            ):
+                raise ValueError(
+                    f"replicas ({self.replicas}) must start inside the "
+                    f"autoscale band [{self.autoscale_min_replicas}, "
+                    f"{self.autoscale_max_replicas}]"
+                )
 
     def resolved_context_shards(self, mesh_seq_degree: int = 1) -> int:
         """The context-parallel degree this config resolves to on a mesh
