@@ -21,11 +21,24 @@ three parts:
    cache pytree is poisoned (:class:`DeletedBufferProxy`), so
    use-after-donate — the PR-2 page-corruption bug class — raises
    :class:`UseAfterDonateError` at the faulty read.
+4. **Lock sanitizer** (:mod:`.locks` — :class:`LockSanitizer`) —
+   every lock in the threaded transport/server stack is a
+   :class:`SanitizableLock`; enabled, each acquisition records the
+   per-thread held stack and the global acquisition-order graph, so a
+   lock-order inversion (the A→B / B→A deadlock recipe) raises
+   :class:`LockOrderInversion` at the second acquisition with BOTH
+   stacks, and ``assert_held`` turns "caller holds the lock" comments
+   into checked contracts (:class:`LockNotHeld`).
+5. **Protocol drift checker** (:mod:`.protocol`) — statically diffs
+   ``ReplicaServerCore``'s dispatch table against ``RemoteReplica``'s
+   ``_rpc`` call sites (method names, argument arity, required
+   envelope fields), so client/server skew fails ``scripts/ffcheck.py``
+   instead of a subprocess chaos test 20 minutes in.
 
 Runtime sanitizers are enabled per engine with
-``ServingConfig(sanitizers=("retrace", "donation"))`` (or
+``ServingConfig(sanitizers=("retrace", "donation", "locks"))`` (or
 ``"retrace-warn"`` for record-only), or globally with
-``FF_SANITIZERS=retrace,donation`` in the environment.
+``FF_SANITIZERS=retrace,donation,locks`` in the environment.
 
 Rule catalog
 ------------
@@ -70,6 +83,31 @@ FF108     tracer-sync           A device sync (``.item()``/``.tolist()``/
                                 un-flushed array stalls the very pipeline it
                                 measures — the observability layer must
                                 record host state (or defer to a flush).
+FF109     wall-clock-in-step-logic
+                                ``time.time``/``time.monotonic``/``time.sleep``
+                                /argless ``datetime.now`` in step-clock-
+                                contracted cluster/autotune files: health,
+                                autoscaling and journal decisions must count
+                                cluster steps, not seconds — wall clock
+                                enters once at ``TrafficEstimator.profile``.
+                                ``time.perf_counter`` (measurement-only) is
+                                allowed.
+FF110     unguarded-shared-state
+                                an attribute written from a ``threading.
+                                Thread``-targeted callable and touched from
+                                non-thread methods must appear in the class's
+                                ``# ffcheck: guarded-by=<lock>`` registry, and
+                                registered attrs must only be touched inside
+                                ``with <lock>:`` scopes (or ``*_locked`` /
+                                ``# ffcheck: requires-lock=<lock>`` methods).
+FF111     held-lock-blocking-call
+                                blocking op (socket I/O, ``Event.wait``,
+                                ``sleep``, queue take, RPC dispatch — directly
+                                or via a local callee) inside a ``with
+                                <lock>:`` body: one slow peer stalls every
+                                thread queuing on the lock. The same module
+                                also builds the cross-file lock-acquisition-
+                                order graph and fails on cycles.
 ========  ====================  ==============================================
 
 Suppressions: ``# ffcheck: disable=FF101 -- reason`` on (or alone
@@ -96,18 +134,48 @@ from .lint import (
     lint_paths,
     lint_source,
 )
+from .locks import (
+    LockNotHeld,
+    LockOrderInversion,
+    LockSanitizer,
+    SanitizableLock,
+    active_lock_sanitizer,
+    disable_lock_sanitizer,
+    enable_lock_sanitizer,
+    make_lock,
+)
+from .protocol import (
+    check_protocol_drift,
+    client_call_sites,
+    diff_protocol,
+    server_dispatch_table,
+)
 from .retrace import CompileEvent, RetraceError, RetraceGuard
+from .rules.held_lock_blocking import check_lock_order
 
 __all__ = [
     "CompileEvent",
     "DeletedBufferProxy",
     "DonationSanitizer",
     "Finding",
+    "LockNotHeld",
+    "LockOrderInversion",
+    "LockSanitizer",
     "RetraceError",
     "RetraceGuard",
     "Rule",
+    "SanitizableLock",
     "UseAfterDonateError",
+    "active_lock_sanitizer",
+    "check_lock_order",
+    "check_protocol_drift",
+    "client_call_sites",
+    "diff_protocol",
+    "disable_lock_sanitizer",
+    "enable_lock_sanitizer",
     "get_rules",
     "lint_paths",
     "lint_source",
+    "make_lock",
+    "server_dispatch_table",
 ]

@@ -1,0 +1,246 @@
+"""Lock sanitizer — runtime lock-discipline checking for the threaded
+cluster stack (the dynamic half of the ffcheck concurrency rules).
+
+The static rules (FF110 unguarded-shared-state, FF111
+held-lock-blocking-call) prove lock discipline about code they can SEE;
+this module proves it about executions. Every lock the transport/server
+stack takes is a :class:`SanitizableLock` built through :func:`make_lock`
+— a zero-overhead pass-through to ``threading.Lock`` until a
+:class:`LockSanitizer` is enabled, at which point every acquisition
+records:
+
+* the **per-thread held stack** (which locks this thread holds, in
+  acquisition order) — :meth:`SanitizableLock.held_by_current_thread`
+  and :meth:`SanitizableLock.assert_held` make "caller holds the lock"
+  contracts (``*_locked`` methods, guarded ClusterStats increments)
+  checkable at test time instead of by comment;
+* the **global acquisition-order graph**: acquiring B while holding A
+  records the edge A→B with the acquiring stack. The moment any thread
+  acquires A while holding B — the classic deadlock recipe, each order
+  observed on its own thread so no single run ever actually deadlocks —
+  the sanitizer flags a :class:`LockOrderInversion` carrying BOTH
+  stacks (strict mode raises at the second acquisition; record mode
+  appends to :attr:`LockSanitizer.findings`).
+
+Enable per engine with ``ServingConfig(sanitizers=("locks",))`` (or
+``FF_SANITIZERS=locks``), or directly with
+:func:`enable_lock_sanitizer` in a test. The sanitizer is process-
+global (module-level locks like the transport's ``_STATS_LOCK`` must
+participate), so tests disable it in a ``finally``. The instrumented
+path takes no extra locks of its own beyond one internal mutex on the
+order graph — enabling the sanitizer can reorder nothing, which is
+what the sanitizer-on == sanitizer-off bitwise suites assert.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "LockNotHeld",
+    "LockOrderInversion",
+    "LockSanitizer",
+    "SanitizableLock",
+    "active_lock_sanitizer",
+    "disable_lock_sanitizer",
+    "enable_lock_sanitizer",
+    "make_lock",
+]
+
+
+class LockOrderInversion(RuntimeError):
+    """Two locks were acquired in both orders (A→B on one code path,
+    B→A on another) — a latent deadlock. Carries both acquisition
+    stacks in the message."""
+
+
+class LockNotHeld(RuntimeError):
+    """An ``assert_held`` contract failed: the current thread touched
+    guarded shared state without holding the guarding lock."""
+
+
+def _stack_summary(skip: int = 3, limit: int = 6) -> str:
+    """A short culprit stack (this module's frames dropped)."""
+    frames = traceback.extract_stack()[:-skip]
+    frames = [f for f in frames if "analysis/locks" not in f.filename]
+    return " <- ".join(
+        f"{f.name}({f.filename.rsplit('/', 1)[-1]}:{f.lineno})"
+        for f in reversed(frames[-limit:])
+    )
+
+
+class LockSanitizer:
+    """Recorder + checker behind every :class:`SanitizableLock` while
+    enabled (see module docstring). ``strict=True`` raises on the
+    acquisition that completes an inversion; ``strict=False`` records
+    findings for a post-run assert (``sanitizer.findings == []``)."""
+
+    def __init__(self, strict: bool = True):
+        self.strict = strict
+        #: human-readable inversion/contract findings (record mode)
+        self.findings: List[str] = []
+        #: total instrumented acquisitions (test introspection)
+        self.acquisitions = 0
+        self._tls = threading.local()
+        # (held, acquired) -> stack summary of the first observation;
+        # a plain threading.Lock (not Sanitizable — the sanitizer must
+        # not instrument itself) guards the graph and counters.
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._mutex = threading.Lock()
+
+    # -- per-thread held stack ------------------------------------------
+
+    def held(self) -> Tuple[str, ...]:
+        return tuple(getattr(self._tls, "stack", ()))
+
+    def _push(self, name: str) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(name)
+
+    def _pop(self, name: str) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack and name in stack:
+            # remove the innermost occurrence — out-of-order releases
+            # (lock.release() without context managers) stay correct
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == name:
+                    del stack[i]
+                    break
+
+    # -- order graph -----------------------------------------------------
+
+    def note_acquire(self, name: str) -> None:
+        held = self.held()
+        site = _stack_summary()
+        with self._mutex:
+            self.acquisitions += 1
+            problem = None
+            for h in held:
+                if h == name:
+                    continue
+                edge = (h, name)
+                if edge not in self._edges:
+                    self._edges[edge] = site
+                rev = self._edges.get((name, h))
+                if rev is not None and (h, name) != (name, h):
+                    problem = (
+                        f"lock-order inversion: {h!r} -> {name!r} at "
+                        f"[{site}] but {name!r} -> {h!r} was taken at "
+                        f"[{rev}]"
+                    )
+            if problem is not None:
+                self.findings.append(problem)
+        self._push(name)
+        if problem is not None and self.strict:
+            raise LockOrderInversion(problem)
+
+    def note_release(self, name: str) -> None:
+        self._pop(name)
+
+    def check_held(self, name: str, what: str = "") -> None:
+        if name in self.held():
+            return
+        msg = (
+            f"unguarded access{f' to {what}' if what else ''}: thread "
+            f"{threading.current_thread().name!r} does not hold "
+            f"{name!r} (held: {list(self.held())}) at "
+            f"[{_stack_summary()}]"
+        )
+        with self._mutex:
+            self.findings.append(msg)
+        if self.strict:
+            raise LockNotHeld(msg)
+
+    def report(self) -> str:
+        with self._mutex:
+            edges = len(self._edges)
+            lines = list(self.findings)
+        head = (
+            f"lock sanitizer: {self.acquisitions} acquisitions, "
+            f"{edges} order edges, {len(lines)} finding(s)"
+        )
+        return "\n".join([head] + lines)
+
+
+#: process-global active sanitizer; None = every SanitizableLock is a
+#: plain pass-through (the zero-overhead default)
+_ACTIVE: Optional[LockSanitizer] = None
+
+
+def enable_lock_sanitizer(strict: bool = True) -> LockSanitizer:
+    """Install (or return the already-active) global sanitizer."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = LockSanitizer(strict=strict)
+    return _ACTIVE
+
+
+def disable_lock_sanitizer() -> Optional[LockSanitizer]:
+    """Uninstall and return the active sanitizer (None if none was)."""
+    global _ACTIVE
+    active, _ACTIVE = _ACTIVE, None
+    return active
+
+
+def active_lock_sanitizer() -> Optional[LockSanitizer]:
+    return _ACTIVE
+
+
+class SanitizableLock:
+    """``threading.Lock`` with a name and an instrumentation hook. The
+    un-instrumented path is a straight delegate (one ``is None`` check
+    per acquire); with a sanitizer active every acquire/release feeds
+    the held-stack + order-graph machinery above."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok and _ACTIVE is not None:
+            _ACTIVE.note_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        if _ACTIVE is not None:
+            _ACTIVE.note_release(self.name)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "SanitizableLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def held_by_current_thread(self) -> bool:
+        """Only answerable with a sanitizer active (False otherwise —
+        plain locks don't track owners)."""
+        return _ACTIVE is not None and self.name in _ACTIVE.held()
+
+    def assert_held(self, what: str = "") -> None:
+        """The runtime form of a ``*_locked`` naming contract: no-op
+        without a sanitizer; with one, flags (strict: raises
+        :class:`LockNotHeld`) when the current thread does not hold
+        this lock."""
+        if _ACTIVE is not None:
+            _ACTIVE.check_held(self.name, what)
+
+    def __repr__(self) -> str:
+        return f"SanitizableLock({self.name!r})"
+
+
+def make_lock(name: str) -> SanitizableLock:
+    """The one constructor the serving stack uses for every lock that
+    guards cross-thread state — always sanitizable, instrumented only
+    while a sanitizer is enabled."""
+    return SanitizableLock(name)

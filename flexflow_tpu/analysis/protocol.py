@@ -1,0 +1,335 @@
+"""Wire-protocol drift checker — statically diffs the replica server's
+dispatch table against the client's RPC call sites.
+
+The RPC protocol has two independent truths: ``ReplicaServerCore``'s
+``_m_<method>`` handlers (``server.py``) and ``RemoteReplica``'s
+``_rpc("method", {...})`` / ``_AsyncCall(self, "method", {...})`` call
+sites (``remote.py``). Nothing ties them together at import time — a
+renamed method, a dropped argument or a removed envelope field only
+surfaces when a subprocess test exercises that RPC, often 20 minutes
+into a chaos suite. This checker makes skew a ``scripts/ffcheck.py``
+failure instead:
+
+* **methods** — every client-called method must have a ``_m_<name>``
+  handler (server-only entry points — ``hello``, ``reset_rate``,
+  ``shutdown`` — are allowed to have no client call site);
+* **arity** — for call sites passing a dict literal, the handler's
+  REQUIRED args (``args["k"]`` subscripts) must all be supplied, and
+  every supplied key must be one the handler reads (``args["k"]`` or
+  ``args.get("k")``) — an ignored argument is drift in the making;
+* **envelope fields** — keys the client REQUIRES from the response
+  (``res["k"]`` subscripts on the variable bound to the call, or
+  directly on the call) must be keys the handler's return provides
+  (``self._envelope(k=...)`` keywords + the envelope's own
+  ``telemetry``/``updates``, or dict-literal keys). ``res.get(...)``
+  reads are optional by construction and not checked.
+
+Everything is AST-only (never imports the serving stack — safe on
+broken trees, no JAX needed), same as the lint rules.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+#: dispatch-table entries with no RemoteReplica call site by design:
+#: ``hello`` (the subprocess handshake the spawner speaks directly),
+#: ``reset_rate`` (client-side mirror reset only), ``shutdown`` (the
+#: spawner's teardown RPC).
+SERVER_ONLY_METHODS = frozenset({"hello", "reset_rate", "shutdown"})
+
+#: keys every ``_envelope()`` response carries regardless of extras
+ENVELOPE_BASE_KEYS = frozenset({"telemetry", "updates"})
+
+
+@dataclasses.dataclass
+class HandlerSpec:
+    """One ``_m_<name>`` handler's statically visible contract."""
+
+    method: str
+    line: int
+    required_args: Set[str]
+    optional_args: Set[str]
+    result_keys: Optional[Set[str]]  # None = not statically knowable
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One client RPC call site."""
+
+    method: str
+    line: int
+    path: str
+    arg_keys: Optional[Set[str]]      # None = non-literal args dict
+    required_reads: Set[str]          # res["k"] subscripts
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _dict_literal_keys(node: ast.AST) -> Optional[Set[str]]:
+    """String keys of a dict literal (None when not a literal or any
+    key is dynamic — ``**spread`` etc.)."""
+    if not isinstance(node, ast.Dict):
+        return None
+    keys: Set[str] = set()
+    for k in node.keys:
+        s = _str_const(k) if k is not None else None
+        if s is None:
+            return None
+        keys.add(s)
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# server side
+
+
+def _args_usage(fn: ast.AST, param: str) -> Tuple[Set[str], Set[str]]:
+    """(required, optional) keys read off the ``args`` parameter."""
+    required: Set[str] = set()
+    optional: Set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == param
+        ):
+            key = _str_const(node.slice)
+            if key is not None:
+                required.add(key)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == param
+            and node.args
+        ):
+            key = _str_const(node.args[0])
+            if key is not None:
+                optional.add(key)
+    return required, optional
+
+
+def _handler_result_keys(fn: ast.AST) -> Optional[Set[str]]:
+    """Union of keys over every ``return`` in the handler: dict
+    literals contribute their keys; ``self._envelope(**extra)``
+    contributes the base envelope keys + keyword names. ``None`` when
+    any return is opaque."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        v = node.value
+        lit = _dict_literal_keys(v)
+        if lit is not None:
+            keys |= lit
+            continue
+        if (
+            isinstance(v, ast.Call)
+            and isinstance(v.func, ast.Attribute)
+            and v.func.attr == "_envelope"
+        ):
+            kw_names = {k.arg for k in v.keywords}
+            if None in kw_names:  # **spread — opaque
+                return None
+            keys |= ENVELOPE_BASE_KEYS | {k for k in kw_names if k}
+            continue
+        return None
+    return keys
+
+
+def server_dispatch_table(source: str) -> Dict[str, HandlerSpec]:
+    """Every ``_m_<name>`` method of ``ReplicaServerCore``."""
+    tree = ast.parse(source)
+    table: Dict[str, HandlerSpec] = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef) \
+                or cls.name != "ReplicaServerCore":
+            continue
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not stmt.name.startswith("_m_"):
+                continue
+            params = [a.arg for a in stmt.args.args if a.arg != "self"]
+            args_param = params[0] if params else "args"
+            required, optional = _args_usage(stmt, args_param)
+            table[stmt.name[3:]] = HandlerSpec(
+                method=stmt.name[3:],
+                line=stmt.lineno,
+                required_args=required,
+                optional_args=optional,
+                result_keys=_handler_result_keys(stmt),
+            )
+    return table
+
+
+# ---------------------------------------------------------------------------
+# client side
+
+
+def _rpc_call_method(node: ast.Call) -> Optional[Tuple[str, ast.AST]]:
+    """``x._rpc("m", ARGS)`` or ``_AsyncCall(owner, "m", ARGS)`` ->
+    (method, ARGS node); None otherwise (dynamic method names — the
+    generic ``_rpc`` body itself — are skipped)."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "_rpc" and node.args:
+        m = _str_const(node.args[0])
+        if m is not None:
+            return m, (node.args[1] if len(node.args) > 1 else None)
+    if (
+        isinstance(f, ast.Name) and f.id == "_AsyncCall"
+        and len(node.args) >= 2
+    ):
+        m = _str_const(node.args[1])
+        if m is not None:
+            return m, (node.args[2] if len(node.args) > 2 else None)
+    return None
+
+
+def _required_reads(fn: ast.AST, call: ast.Call,
+                    parents: Dict[ast.AST, ast.AST]) -> Set[str]:
+    """Keys the client demands of this call's response: a direct
+    subscript on the call (``self._rpc(...)["score"]``), or
+    ``res["k"]`` subscripts where ``res`` is the name the call was
+    assigned to in the same function."""
+    reads: Set[str] = set()
+    parent = parents.get(call)
+    if isinstance(parent, ast.Subscript) and parent.value is call:
+        key = _str_const(parent.slice)
+        if key is not None:
+            reads.add(key)
+        return reads
+    var: Optional[str] = None
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+            and isinstance(parent.targets[0], ast.Name):
+        var = parent.targets[0].id
+    if var is None:
+        return reads
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == var
+        ):
+            key = _str_const(node.slice)
+            if key is not None:
+                reads.add(key)
+    return reads
+
+
+def client_call_sites(source: str, path: str = "remote.py"
+                      ) -> List[CallSite]:
+    """Every literal-method RPC call site in a client file."""
+    tree = ast.parse(source)
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    fns = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    sites: List[CallSite] = []
+    seen: Set[int] = set()
+    for fn in fns:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            hit = _rpc_call_method(node)
+            if hit is None:
+                continue
+            seen.add(id(node))
+            method, args_node = hit
+            sites.append(CallSite(
+                method=method,
+                line=node.lineno,
+                path=path,
+                arg_keys=(
+                    _dict_literal_keys(args_node)
+                    if args_node is not None else set()
+                ),
+                required_reads=_required_reads(fn, node, parents),
+            ))
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# the diff
+
+
+def diff_protocol(
+    server_source: str,
+    client_sources: Dict[str, str],
+) -> List[str]:
+    """Every drift between the dispatch table and the client call
+    sites, as human-readable problem lines (empty = no drift)."""
+    table = server_dispatch_table(server_source)
+    problems: List[str] = []
+    if not table:
+        return ["protocol: no ReplicaServerCore dispatch table found"]
+    called: Set[str] = set()
+    for path, src in client_sources.items():
+        for site in client_call_sites(src, path):
+            called.add(site.method)
+            where = f"{site.path}:{site.line}"
+            spec = table.get(site.method)
+            if spec is None:
+                problems.append(
+                    f"{where}: client calls {site.method!r} but the "
+                    "server dispatch table has no _m_"
+                    f"{site.method} handler"
+                )
+                continue
+            if site.arg_keys is not None:
+                missing = spec.required_args - site.arg_keys
+                if missing:
+                    problems.append(
+                        f"{where}: {site.method!r} call omits required "
+                        f"arg(s) {sorted(missing)} (server reads "
+                        f"args[...] at server.py:{spec.line})"
+                    )
+                unknown = site.arg_keys - spec.required_args \
+                    - spec.optional_args
+                if unknown:
+                    problems.append(
+                        f"{where}: {site.method!r} call passes arg(s) "
+                        f"{sorted(unknown)} the handler never reads — "
+                        "dead wire weight or a renamed field"
+                    )
+            if spec.result_keys is not None and site.required_reads:
+                absent = site.required_reads - spec.result_keys
+                if absent:
+                    problems.append(
+                        f"{where}: client requires response key(s) "
+                        f"{sorted(absent)} of {site.method!r} but the "
+                        "handler's returns only provide "
+                        f"{sorted(spec.result_keys)}"
+                    )
+    for method in sorted(set(table) - called - SERVER_ONLY_METHODS):
+        problems.append(
+            f"server.py:{table[method].line}: handler _m_{method} has "
+            "no client call site and is not in SERVER_ONLY_METHODS — "
+            "dead protocol surface or a renamed client call"
+        )
+    return problems
+
+
+def check_protocol_drift(server_path: str,
+                         client_paths: List[str]) -> List[str]:
+    """File-path front door for :func:`diff_protocol` (what
+    ``scripts/ffcheck.py`` calls)."""
+    with open(server_path, "r") as fh:
+        server_src = fh.read()
+    client_sources: Dict[str, str] = {}
+    for p in client_paths:
+        with open(p, "r") as fh:
+            client_sources[p] = fh.read()
+    return diff_protocol(server_src, client_sources)

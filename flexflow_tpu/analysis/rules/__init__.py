@@ -8,13 +8,16 @@ in ``_RULE_MODULES`` below. The catalog in ``analysis/__init__.py`` and
 from __future__ import annotations
 
 from . import (
+    held_lock_blocking,
     host_sync,
     missing_donation,
     static_hashability,
     sync_transfer,
     tracer_control_flow,
     tracer_sync,
+    unguarded_shared_state,
     unordered_iteration,
+    wall_clock_step_logic,
     weak_dtype,
 )
 
@@ -27,6 +30,9 @@ _RULE_MODULES = (
     static_hashability,
     sync_transfer,
     tracer_sync,
+    wall_clock_step_logic,
+    unguarded_shared_state,
+    held_lock_blocking,
 )
 
 ALL_RULES = tuple(m.RULE for m in _RULE_MODULES)

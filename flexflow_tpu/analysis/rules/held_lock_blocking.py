@@ -1,0 +1,337 @@
+"""FF111 held-lock-blocking-call: blocking operations inside a
+``with <lock>:`` body, plus the module-level lock-acquisition-order
+graph with cycle detection.
+
+Holding a lock across a blocking operation turns one slow peer into a
+stalled cluster: every thread that needs the lock queues behind a
+socket ``recv``, an ``Event.wait``, a ``sleep`` or an RPC dispatch.
+The rule flags calls that (directly, or transitively through intra-file
+callees) block, when they sit lexically inside a ``with`` scope whose
+context expression looks like a lock (name contains ``lock``). The
+stack's deliberate hold-across-blocking sites — the writer lock
+serializing ``sendall``/re-dials, the loopback dispatch lock
+serializing engine steps — carry reasoned suppressions; everything
+else is a hang waiting for a slow peer.
+
+The second half is deadlock prevention across files:
+:func:`analyze_lock_order` builds the acquisition-order graph over a
+corpus (``transport.py``/``server.py``/``remote.py`` — edge A→B when
+code acquires B while holding A, including acquisitions reached
+through cross-file calls matched by method name), and
+:func:`find_order_cycles` reports any cycle — the static mirror of the
+runtime :class:`~..locks.LockSanitizer` inversion check.
+``scripts/ffcheck.py`` runs it over ``serve/cluster/`` on every lint.
+
+Suppress findings with ``# ffcheck: disable=FF111 -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ..lint import FileContext, Finding, FuncDef, Rule
+
+#: dotted calls that block the calling thread
+BLOCKING_PATHS = {
+    "time.sleep",
+    "socket.create_connection",
+    "select.select",
+    "os.fsync",
+}
+#: function simple names (imported helpers) that block
+BLOCKING_NAMES = {"read_frame_from_socket"}
+#: attribute-method calls that block regardless of receiver: socket
+#: I/O, Event/future waits, thread joins, and RPC dispatch (the
+#: loopback's dispatch call runs a whole engine step)
+BLOCKING_METHODS = {
+    "sendall", "recv", "accept", "connect", "sendto", "recvfrom",
+    "wait", "join", "result", "dispatch",
+}
+#: argless ``.get()`` is a queue take (a dict ``.get`` always has args)
+BLOCKING_ARGLESS_METHODS = {"get"}
+
+
+def _is_lockish_name(name: Optional[str]) -> bool:
+    return name is not None and "lock" in name.lower()
+
+
+def _with_item_lock(expr: ast.AST) -> Optional[str]:
+    """``with self._lock:`` -> ``_lock``; ``with _STATS_LOCK:`` ->
+    ``_STATS_LOCK``; non-lock context managers -> None."""
+    if isinstance(expr, ast.Attribute) and _is_lockish_name(expr.attr):
+        return expr.attr
+    if isinstance(expr, ast.Name) and _is_lockish_name(expr.id):
+        return expr.id
+    return None
+
+
+def _blocks_directly(node: ast.Call, ctx: FileContext) -> Optional[str]:
+    """The reason string when this single call blocks, else None."""
+    resolved = ctx.resolve(node.func)
+    if resolved in BLOCKING_PATHS:
+        return resolved
+    if resolved is not None and resolved.split(".")[-1] in BLOCKING_NAMES:
+        return resolved.split(".")[-1]
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in BLOCKING_METHODS:
+            return f".{attr}()"
+        if attr in BLOCKING_ARGLESS_METHODS and not node.args \
+                and not node.keywords:
+            return f".{attr}()"
+    return None
+
+
+def _local_callee_names(node: ast.Call) -> List[str]:
+    """Simple names a call might resolve to intra-file: ``self._m(...)``
+    and bare ``fn(...)``."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return [f.attr]
+    if isinstance(f, ast.Name):
+        return [f.id]
+    return []
+
+
+def _blocking_functions(ctx: FileContext) -> Set[str]:
+    """Names of local functions/methods that (transitively) contain a
+    blocking call — fixpoint over simple-name calls."""
+    contains: Set[str] = set()
+    calls: Dict[str, Set[str]] = {}
+    for fn in ctx.functions:
+        callees: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _blocks_directly(node, ctx):
+                contains.add(fn.name)
+            callees.update(_local_callee_names(node))
+        calls[fn.name] = callees
+    names = {fn.name for fn in ctx.functions}
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls.items():
+            if name not in contains and callees & (contains & names):
+                contains.add(name)
+                changed = True
+    return contains
+
+
+class HeldLockBlockingRule(Rule):
+    code = "FF111"
+    slug = "held-lock-blocking-call"
+    doc = (
+        "blocking operation (socket I/O, Event.wait, sleep, queue "
+        "take, RPC dispatch — directly or through a local callee) "
+        "inside a `with <lock>:` body — one slow peer stalls every "
+        "thread queuing on the lock; move the blocking op outside the "
+        "critical section or suppress with the reason it must be held"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        blocking_fns = _blocking_functions(ctx)
+        for wnode in ast.walk(ctx.tree):
+            if not isinstance(wnode, ast.With):
+                continue
+            locks = [
+                lk for item in wnode.items
+                if (lk := _with_item_lock(item.context_expr)) is not None
+            ]
+            if not locks:
+                continue
+            lock = locks[0]
+            for stmt in wnode.body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    why = _blocks_directly(node, ctx)
+                    if why is None:
+                        for name in _local_callee_names(node):
+                            if name in blocking_fns:
+                                why = f"{name}() (blocks transitively)"
+                                break
+                    if why is None:
+                        continue
+                    yield self.finding(
+                        ctx, node,
+                        f"blocking call {why} while holding {lock!r} — "
+                        "threads queuing on the lock stall behind the "
+                        "slow peer; hoist it out of the critical "
+                        "section (or suppress with the reason the "
+                        "hold is the protocol)",
+                    )
+
+
+RULE = HeldLockBlockingRule()
+
+
+# ---------------------------------------------------------------------------
+# module-level lock-acquisition-order graph (corpus-wide)
+
+
+def _qualify(lock: str, cls: Optional[str], expr: ast.AST) -> str:
+    """Graph node id: instance locks are ``Class.attr`` (two classes'
+    ``_lock`` attributes are different locks); module-level lock names
+    stay global."""
+    if isinstance(expr, ast.Attribute) and cls is not None:
+        return f"{cls}.{lock}"
+    return lock
+
+
+def analyze_lock_order(
+    sources: Dict[str, str],
+) -> Dict[Tuple[str, str], str]:
+    """Build the acquisition-order graph over a corpus of files.
+
+    Returns ``{(held, acquired): "file:line"}`` — an edge per observed
+    "acquire B inside a ``with A:`` body", where the acquisition is a
+    lexically nested ``with`` OR a call (matched by simple name across
+    the whole corpus — the loopback's ``self.dispatch(...)`` reaching
+    the server core's ``_dispatch_lock``) into a function that
+    acquires locks, computed to a fixpoint."""
+    # pass 1: per file — every function with its class context, the
+    # locks each function acquires directly, and its callee names
+    ctxs = {path: FileContext(path, src) for path, src in sources.items()}
+    fn_infos: List[dict] = []
+    for path, ctx in ctxs.items():
+        class_of: Dict[ast.AST, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if isinstance(stmt, FuncDef):
+                        class_of[stmt] = node.name
+        for fn in ctx.functions:
+            cls = class_of.get(fn)
+            acquires: List[Tuple[str, int]] = []
+            callees: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lk = _with_item_lock(item.context_expr)
+                        if lk is not None:
+                            acquires.append((
+                                _qualify(lk, cls, item.context_expr),
+                                node.lineno,
+                            ))
+                elif isinstance(node, ast.Call):
+                    callees.update(_local_callee_names(node))
+            fn_infos.append({
+                "path": path, "ctx": ctx, "fn": fn, "cls": cls,
+                "name": fn.name, "acquires": acquires,
+                "callees": callees,
+            })
+    by_name: Dict[str, List[dict]] = {}
+    for info in fn_infos:
+        by_name.setdefault(info["name"], []).append(info)
+    # pass 2: transitive acquisition sets per function (corpus-wide
+    # name matching; over-approximate on purpose — a false edge is a
+    # review prompt, a missed edge is a deadlock)
+    trans: Dict[int, Set[str]] = {
+        id(info["fn"]): {lk for lk, _ in info["acquires"]}
+        for info in fn_infos
+    }
+    changed = True
+    while changed:
+        changed = False
+        for info in fn_infos:
+            mine = trans[id(info["fn"])]
+            for callee in info["callees"]:
+                for target in by_name.get(callee, ()):
+                    extra = trans[id(target["fn"])] - mine
+                    if extra:
+                        mine |= extra
+                        changed = True
+    # pass 3: edges — for every `with L:` body, locks acquired inside
+    # (nested withs + callees' transitive sets)
+    edges: Dict[Tuple[str, str], str] = {}
+    for info in fn_infos:
+        ctx, cls = info["ctx"], info["cls"]
+        for wnode in ast.walk(info["fn"]):
+            if not isinstance(wnode, ast.With):
+                continue
+            held = [
+                _qualify(lk, cls, item.context_expr)
+                for item in wnode.items
+                if (lk := _with_item_lock(item.context_expr)) is not None
+            ]
+            if not held:
+                continue
+            inner: Set[str] = set()
+            for stmt in wnode.body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            lk = _with_item_lock(item.context_expr)
+                            if lk is not None:
+                                inner.add(
+                                    _qualify(lk, cls, item.context_expr)
+                                )
+                    elif isinstance(node, ast.Call):
+                        for name in _local_callee_names(node):
+                            for target in by_name.get(name, ()):
+                                inner |= trans[id(target["fn"])]
+            site = f"{info['path']}:{wnode.lineno}"
+            for h in held:
+                for a in inner:
+                    if a != h:
+                        edges.setdefault((h, a), site)
+    return edges
+
+
+def find_order_cycles(
+    edges: Dict[Tuple[str, str], str],
+) -> List[List[str]]:
+    """Cycles in the acquisition-order graph (each is a potential
+    deadlock). Returns lists of node names, cycle closed implicitly."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+
+    def dfs(node: str, path: List[str]) -> None:
+        color[node] = GRAY
+        path.append(node)
+        for nxt in sorted(graph[node]):
+            if color[nxt] == GRAY:
+                cyc = path[path.index(nxt):]
+                key = tuple(sorted(cyc))
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(list(cyc))
+            elif color[nxt] == WHITE:
+                dfs(nxt, path)
+        path.pop()
+        color[node] = BLACK
+
+    for node in sorted(graph):
+        if color[node] == WHITE:
+            dfs(node, [])
+    return cycles
+
+
+def check_lock_order(paths: Sequence[str]) -> List[str]:
+    """The ffcheck entry point: read the corpus, report each cycle as
+    one problem line (empty list = acyclic = clean)."""
+    sources: Dict[str, str] = {}
+    for p in paths:
+        with open(p, "r") as fh:
+            sources[p] = fh.read()
+    edges = analyze_lock_order(sources)
+    problems = []
+    for cyc in find_order_cycles(edges):
+        hops = " -> ".join(cyc + [cyc[0]])
+        sites = "; ".join(
+            f"{a}->{b} at {edges[(a, b)]}"
+            for a, b in zip(cyc, cyc[1:] + [cyc[0]])
+            if (a, b) in edges
+        )
+        problems.append(
+            f"lock-order cycle: {hops} ({sites}) — two threads taking "
+            "these locks in opposite orders deadlock"
+        )
+    return problems

@@ -1,0 +1,364 @@
+"""FF110 unguarded-shared-state: cross-thread instance attributes must
+be in a declared ``guarded-by`` registry, and every access must hold
+the declared lock.
+
+The transport layer runs real threads (the socket reader, the loopback
+worker); any attribute those threads WRITE and caller-thread code also
+touches is shared mutable state. This rule makes the guarding
+discipline declarative and machine-checked:
+
+1. **Discovery** — per class (in-file base classes merged), the rule
+   finds every ``threading.Thread(target=self._x)`` entry point,
+   closes over intra-class ``self.m()`` calls to get the
+   thread-reachable method set, and intersects the attributes those
+   methods write with the attributes the caller-facing methods touch
+   (``__init__`` excluded — construction precedes the thread).
+2. **Registry** — each shared attribute must be declared, either
+   inline on its initializer line::
+
+       self._pending = {}  # ffcheck: guarded-by=_lock
+
+   or in bulk anywhere in the class body::
+
+       # ffcheck: guarded-by[_lock]=_pending,_sock
+
+   The lock name is an instance attribute (``self._lock``) or a
+   module-level lock (``_STATS_LOCK``). An undeclared shared
+   attribute is a finding.
+3. **Scope check** — every access (load or store) to a REGISTERED
+   attribute outside ``__init__`` must sit lexically inside a
+   ``with self.<lock>:`` / ``with <LOCK>:`` scope for its declared
+   lock. Two escape hatches encode "caller holds the lock" contracts:
+   a method whose name ends in ``_locked`` (the transport's existing
+   convention), or an explicit ``# ffcheck: requires-lock=<lock>``
+   comment on/above the ``def`` line. Both are runtime-checkable via
+   :meth:`analysis.locks.SanitizableLock.assert_held`.
+
+Suppress with ``# ffcheck: disable=FF110 -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..lint import FileContext, Finding, FuncDef, Rule
+
+#: attribute-method calls treated as writes to the receiver
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "discard", "remove", "pop",
+    "popitem", "clear", "update", "setdefault", "appendleft",
+}
+
+_GUARDED_BULK_RE = re.compile(
+    r"#\s*ffcheck:\s*guarded-by\[(?P<lock>[A-Za-z_][A-Za-z0-9_.]*)\]\s*=\s*"
+    r"(?P<attrs>[A-Za-z0-9_, ]+)"
+)
+_GUARDED_INLINE_RE = re.compile(
+    r"#\s*ffcheck:\s*guarded-by\s*=\s*(?P<lock>[A-Za-z_][A-Za-z0-9_.]*)"
+    r"(?!\])"
+)
+_REQUIRES_LOCK_RE = re.compile(
+    r"#\s*ffcheck:\s*requires-lock\s*=\s*(?P<lock>[A-Za-z_][A-Za-z0-9_.]*)"
+)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> ``x`` (else None)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _attr_accesses(fn: ast.AST) -> Iterator[Tuple[str, bool, ast.AST]]:
+    """Yield ``(attr, is_write, node)`` for every ``self.attr`` touch in
+    ``fn``'s body: assignments (plain/augmented/subscript/del), mutator
+    method calls, and plain loads."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, True, t
+                    continue
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        yield attr, True, t
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                attr = _self_attr(base)
+                if attr is not None:
+                    yield attr, True, t
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in MUTATOR_METHODS
+            ):
+                attr = _self_attr(f.value)
+                if attr is not None:
+                    yield attr, True, node
+        elif isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load):
+                yield attr, False, node
+
+
+class _ClassView:
+    """One class with its in-file base-class methods merged (the
+    transport hierarchy keeps counters on the base and threads on the
+    subclass — the analysis needs the flat view)."""
+
+    def __init__(self, cls: ast.ClassDef,
+                 by_name: Dict[str, ast.ClassDef]):
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {}
+        #: every FuncDef in the chain, INCLUDING base methods shadowed
+        #: by a subclass override — registry comments on a base
+        #: initializer line must bind even when the subclass has its
+        #: own ``__init__``
+        self.all_methods: List[ast.AST] = []
+        self.spans: List[Tuple[int, int]] = []
+        seen: Set[str] = set()
+        stack, chain = [cls], []
+        while stack:
+            c = stack.pop(0)
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            chain.append(c)
+            for b in c.bases:
+                if isinstance(b, ast.Name) and b.id in by_name:
+                    stack.append(by_name[b.id])
+        for c in chain:
+            self.spans.append(
+                (c.lineno, getattr(c, "end_lineno", c.lineno))
+            )
+            for stmt in c.body:
+                if isinstance(stmt, FuncDef):
+                    self.all_methods.append(stmt)
+                    if stmt.name not in self.methods:
+                        self.methods[stmt.name] = stmt
+
+    def contains_line(self, lineno: int) -> bool:
+        return any(a <= lineno <= b for a, b in self.spans)
+
+
+def _thread_targets(view: _ClassView, ctx: FileContext) -> Set[str]:
+    """Method names handed to ``threading.Thread(target=self._x)``
+    anywhere in the class."""
+    roots: Set[str] = set()
+    for fn in view.methods.values():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved not in ("threading.Thread", "Thread"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = _self_attr(kw.value)
+                    if attr is not None and attr in view.methods:
+                        roots.add(attr)
+    return roots
+
+
+def _close_over_calls(view: _ClassView, seeds: Set[str],
+                      stop: Set[str] = frozenset()) -> Set[str]:
+    """Transitive closure of intra-class ``self.m()`` calls from the
+    seed methods, never descending into ``stop`` methods."""
+    reach = set(seeds)
+    frontier = list(seeds)
+    while frontier:
+        fn = view.methods.get(frontier.pop())
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = _self_attr(node.func)
+            if (
+                attr is not None and attr in view.methods
+                and attr not in reach and attr not in stop
+            ):
+                reach.add(attr)
+                frontier.append(attr)
+    return reach
+
+
+def _parse_registry(
+    source: str, view: _ClassView, ctx: FileContext
+) -> Tuple[Dict[str, str], Dict[int, str], Set[int]]:
+    """Returns (attr -> lock, def-line -> required lock,
+    lines carrying an inline guarded-by comment). Inline form binds to
+    the ``self.attr`` assignment on its line; bulk form lists attrs
+    explicitly; requires-lock binds to the def on/below its line."""
+    registry: Dict[str, str] = {}
+    requires: Dict[int, str] = {}
+    inline_lines: Dict[int, str] = {}
+    lines = source.splitlines()
+    for i, line in enumerate(lines, start=1):
+        if not view.contains_line(i):
+            continue
+        m = _GUARDED_BULK_RE.search(line)
+        if m:
+            for attr in m.group("attrs").split(","):
+                attr = attr.strip()
+                if attr:
+                    registry[attr] = m.group("lock")
+            continue
+        m = _GUARDED_INLINE_RE.search(line)
+        if m:
+            inline_lines[i] = m.group("lock")
+        m = _REQUIRES_LOCK_RE.search(line)
+        if m:
+            # bind to this line's def, or the next line's (comment
+            # above the def)
+            requires[i] = m.group("lock")
+            requires[i + 1] = m.group("lock")
+    if inline_lines:
+        for fn in view.all_methods:
+            for attr, is_write, node in _attr_accesses(fn):
+                if not is_write:
+                    continue
+                lock = inline_lines.get(getattr(node, "lineno", -1))
+                if lock is not None:
+                    registry.setdefault(attr, lock)
+    req_by_def: Dict[int, str] = {}
+    for fn in view.methods.values():
+        lock = requires.get(fn.lineno)
+        if lock is None and fn.decorator_list:
+            lock = requires.get(fn.decorator_list[0].lineno)
+        if lock is not None:
+            req_by_def[fn.lineno] = lock
+    return registry, req_by_def, set(inline_lines)
+
+
+def _with_locks_around(ctx: FileContext, node: ast.AST) -> Set[str]:
+    """Lock names of every ``with`` scope lexically enclosing ``node``
+    (``self._lock`` -> ``_lock``; module-level ``_STATS_LOCK`` as-is;
+    ``lock.acquire()``-style is out of scope — the stack uses context
+    managers only)."""
+    held: Set[str] = set()
+    anc = ctx._parent.get(node)
+    while anc is not None:
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                expr = item.context_expr
+                attr = _self_attr(expr)
+                if attr is not None:
+                    held.add(attr)
+                elif isinstance(expr, ast.Name):
+                    held.add(expr.id)
+        anc = ctx._parent.get(anc)
+    return held
+
+
+class UnguardedSharedStateRule(Rule):
+    code = "FF110"
+    slug = "unguarded-shared-state"
+    doc = (
+        "instance attribute written from a threading.Thread-targeted "
+        "method and touched from caller threads without a "
+        "`# ffcheck: guarded-by=<lock>` registry entry, or a "
+        "registered attribute accessed outside its `with <lock>:` "
+        "scope (escape hatches: *_locked method names, "
+        "`# ffcheck: requires-lock=<lock>`)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        classes = [
+            n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)
+        ]
+        by_name = {c.name: c for c in classes}
+        # base-class methods are re-visited once per subclass (the flat
+        # view) — dedupe by position so each site reports once
+        seen: Set[Tuple[int, int, str]] = set()
+        for cls in classes:
+            view = _ClassView(cls, by_name)
+            roots = _thread_targets(view, ctx)
+            registry, requires, _ = _parse_registry(
+                ctx.source, view, ctx
+            )
+            if not roots and not registry:
+                continue
+            for f in self._check_class(ctx, view, roots, registry,
+                                       requires):
+                key = (f.line, f.col, f.message.split(" in ")[0])
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+
+    def _check_class(
+        self,
+        ctx: FileContext,
+        view: _ClassView,
+        roots: Set[str],
+        registry: Dict[str, str],
+        requires: Dict[int, str],
+    ) -> Iterator[Finding]:
+        thread_reach = _close_over_calls(view, roots)
+        caller_entries = {
+            name for name in view.methods if name not in roots
+        }
+        caller_reach = _close_over_calls(view, caller_entries, stop=roots)
+        # discovery: thread-written ∩ caller-touched (outside __init__)
+        thread_writes: Dict[str, ast.AST] = {}
+        for name in thread_reach:
+            fn = view.methods[name]
+            for attr, is_write, node in _attr_accesses(fn):
+                if is_write:
+                    thread_writes.setdefault(attr, node)
+        caller_touches: Set[str] = set()
+        for name in caller_reach:
+            if name == "__init__":
+                continue
+            for attr, _, _node in _attr_accesses(view.methods[name]):
+                caller_touches.add(attr)
+        shared = set(thread_writes) & caller_touches
+        for attr in sorted(shared - set(registry) - set(view.methods)):
+            yield self.finding(
+                ctx, thread_writes[attr],
+                f"attribute '{attr}' of class {view.cls.name} is "
+                "written on a thread-target path and touched from "
+                "caller threads, but is not in the guarded-by "
+                "registry — declare `# ffcheck: guarded-by=<lock>` "
+                "on its initializer (or fix the sharing)",
+            )
+        # scope check over registered attrs
+        for name, fn in view.methods.items():
+            if name == "__init__":
+                continue
+            exempt_lock: Optional[str] = requires.get(fn.lineno)
+            if exempt_lock is None and name.endswith("_locked"):
+                exempt_lock = "*"
+            for attr, is_write, node in _attr_accesses(fn):
+                lock = registry.get(attr)
+                if lock is None:
+                    continue
+                if exempt_lock == "*" or exempt_lock == lock:
+                    continue
+                if lock in _with_locks_around(ctx, node):
+                    continue
+                verb = "write to" if is_write else "read of"
+                yield self.finding(
+                    ctx, node,
+                    f"{verb} '{attr}' (guarded-by={lock}) outside a "
+                    f"`with {lock}:` scope in {view.cls.name}."
+                    f"{name} — hold the declared lock, or mark the "
+                    "method `# ffcheck: requires-lock="
+                    f"{lock}` / name it *_locked if the caller holds it",
+                )
+
+
+RULE = UnguardedSharedStateRule()

@@ -1,0 +1,103 @@
+"""FF109 wall-clock-in-step-logic: wall-clock reads/sleeps inside the
+step-clock-contracted cluster control plane.
+
+The determinism contract (PR 9, re-affirmed by PR 18): health
+transitions, heartbeat gaps, autoscaler cooldowns/streaks/windows and
+journal replay are all counted in CLUSTER STEPS, never wall clock —
+that is what makes failover, chaos and autoscale runs bitwise
+reproducible under a seed. Wall time enters the control plane exactly
+once, at the measurement edge (``TrafficEstimator.profile(
+step_time_s=...)`` is handed a duration; it never reads a clock).
+
+This rule machine-checks the contract over the contracted file set
+(``serve/cluster/{health,journal,manager,remote,transport}.py`` and
+``serve/autotune/{policy,workload}.py``): any call to ``time.time``,
+``time.monotonic`` (plus their ``_ns`` variants), ``time.sleep`` or an
+argless ``datetime.now()`` is a finding. ``time.perf_counter`` is
+explicitly ALLOWED — it only ever feeds measurement outputs (latency
+EMAs, RTT percentiles, profile stamps), never a control decision, and
+banning it would just push timing telemetry out of the files the rule
+can see.
+
+The two legitimate wall-clock sites carry reasoned suppressions: the
+socket retry backoff (``remote.py`` — real links recover with time;
+outputs are unaffected because the loopback transport never backs
+off) and the loopback worker's injected link delay (``transport.py`` —
+the delay IS the simulated wire latency the chaos tests script).
+
+Suppress with ``# ffcheck: disable=FF109 -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..lint import FileContext, Finding, Rule
+
+#: the step-clock-contracted file set (path suffixes, "/"-normalized)
+CONTRACT_SUFFIXES = (
+    "serve/cluster/health.py",
+    "serve/cluster/journal.py",
+    "serve/cluster/manager.py",
+    "serve/cluster/remote.py",
+    "serve/cluster/transport.py",
+    "serve/autotune/policy.py",
+    "serve/autotune/workload.py",
+)
+
+#: wall-clock calls banned anywhere in a contracted file
+WALL_CLOCK_PATHS = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.sleep",
+}
+#: argless ``datetime.now()`` / ``datetime.datetime.now()`` — the
+#: naive-local-time read; a tz-carrying call is assumed to be
+#: formatting an externally supplied stamp and left to review
+DATETIME_NOW_PATHS = {"datetime.now", "datetime.datetime.now"}
+
+
+def in_contract_set(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return any(norm.endswith(sfx) for sfx in CONTRACT_SUFFIXES)
+
+
+class WallClockStepLogicRule(Rule):
+    code = "FF109"
+    slug = "wall-clock-in-step-logic"
+    doc = (
+        "time.time/time.monotonic/time.sleep/datetime.now inside the "
+        "step-clock-contracted cluster control plane (health, "
+        "autoscaler, journal, manager/remote/transport step logic) — "
+        "transitions and cooldowns count cluster steps, never wall "
+        "clock; time.perf_counter (measurement-only) is allowed"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not in_contract_set(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved in WALL_CLOCK_PATHS:
+                yield self.finding(
+                    ctx, node,
+                    f"{resolved}() in step-clock-contracted code — "
+                    "health/autoscale/journal logic counts cluster "
+                    "steps, never wall clock (use the step counter, or "
+                    "time.perf_counter for measurement-only stamps)",
+                )
+            elif resolved in DATETIME_NOW_PATHS and not node.args:
+                yield self.finding(
+                    ctx, node,
+                    "datetime.now() in step-clock-contracted code — "
+                    "wall-clock timestamps break the deterministic "
+                    "replay contract; derive times from the step clock "
+                    "or stamp at the measurement edge",
+                )
+
+
+RULE = WallClockStepLogicRule()

@@ -62,7 +62,13 @@ from ...obs.tracer import NULL_TRACER
 from ..batch_config import GenerationConfig, ProfileInfo
 from ..request_manager import TERMINAL_STATUSES, RequestStatus
 from .server import gen_to_wire
-from .transport import RemoteError, RpcFuture, Transport, TransportError
+from .transport import (
+    _STATS_LOCK,
+    RemoteError,
+    RpcFuture,
+    Transport,
+    TransportError,
+)
 
 
 class _AsyncCall:
@@ -156,7 +162,10 @@ class _AsyncCall:
             owner._last_call_retries += 1
             st = owner.stats
             if st is not None:
-                st.rpc_retries += 1
+                # same lock as the transports' wire counters: a reader
+                # thread mid-_count() must not interleave with this RMW
+                with _STATS_LOCK:
+                    st.rpc_retries += 1
             if tr.enabled:
                 # retries/backoff are part of the request's wire
                 # story — each is its own event on the wire lane
@@ -166,6 +175,7 @@ class _AsyncCall:
                     error=type(last_exc).__name__,
                 )
             if owner.transport.needs_backoff:
+                # ffcheck: disable=FF109 -- retry backoff against a real socket peer is inherently wall-clock (the link recovers with time, not with steps); gated off for loopback via needs_backoff
                 time.sleep(
                     owner.serving.rpc_backoff_s * (2 ** (attempt - 1))
                 )
@@ -183,7 +193,8 @@ class _AsyncCall:
                 continue
         st = owner.stats
         if st is not None:
-            st.rpc_errors += 1
+            with _STATS_LOCK:
+                st.rpc_errors += 1
         assert last_exc is not None
         self.completed_at = time.perf_counter()
         if tr.enabled:

@@ -44,6 +44,7 @@ import sys
 import threading
 from typing import Any, Dict
 
+from ...analysis.locks import make_lock
 from ...logging_utils import get_logger
 from ..batch_config import GenerationConfig
 from ..request_manager import RequestStatus
@@ -95,7 +96,7 @@ class ReplicaServerCore:
         # double-execute (donated engine buffers make that a
         # deleted-array crash, not just a logic bug), so dispatch
         # serializes behind this lock and the loser replays the cache.
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = make_lock("ReplicaServerCore._dispatch_lock")
         self.shutdown_requested = False
 
     # ------------------------------------------------------------------

@@ -77,6 +77,7 @@ from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import numpy as np
 
+from ...analysis.locks import make_lock
 from ...obs.tracer import NULL_TRACER
 
 MAGIC = b"FT"
@@ -360,8 +361,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 #: ClusterStats is a plain dataclass shared by EVERY transport in the
 #: cluster; once responses can complete on reader/worker threads, the
 #: ``+=`` on its wire counters must be serialized or concurrent
-#: completions lose increments.
-_STATS_LOCK = threading.Lock()
+#: completions lose increments. A :class:`~...analysis.locks
+#: .SanitizableLock` so ``ServingConfig(sanitizers=("locks",))`` can
+#: watch its acquisition order against the transport/server locks.
+_STATS_LOCK = make_lock("_STATS_LOCK")
 
 
 class RpcFuture:
@@ -446,9 +449,9 @@ class Transport:
 
     def __init__(self, stats=None):
         self._stats_src = stats
-        self.bytes_sent = 0
-        self.bytes_received = 0
-        self.reconnects = 0
+        self.bytes_sent = 0  # ffcheck: guarded-by=_STATS_LOCK
+        self.bytes_received = 0  # ffcheck: guarded-by=_STATS_LOCK
+        self.reconnects = 0  # ffcheck: guarded-by=_STATS_LOCK
         # Observability (flexflow_tpu/obs): with a live tracer attached
         # (obs.attach_observability sets the owning RemoteReplica's
         # wire tracer here too) every frame exchange becomes a "wire"
@@ -514,7 +517,7 @@ class Transport:
 #: not thread-safe. Worker threads overlap their injected link DELAYS
 #: freely (that is the concurrency the bench measures); the computes
 #: behind them serialize here, same as N processes sharing one chip.
-_LOOPBACK_DISPATCH_LOCK = threading.Lock()
+_LOOPBACK_DISPATCH_LOCK = make_lock("_LOOPBACK_DISPATCH_LOCK")
 
 
 class LoopbackTransport(Transport):
@@ -567,6 +570,7 @@ class LoopbackTransport(Transport):
         # dedupes re-execution only when dispatches serialize.
         with _LOOPBACK_DISPATCH_LOCK:
             response_frame = encode_frame(
+                # ffcheck: disable=FF111 -- the hold IS the protocol: dispatch runs the real engine step and JAX host state is single-threaded; serializing computes is what this lock exists for
                 self.dispatch(decode_frame(request))
             )
         self._count(received=len(response_frame))
@@ -616,10 +620,12 @@ class LoopbackTransport(Transport):
                 else self.delay_s
             )
             if delay > 0:
+                # ffcheck: disable=FF109 -- injected LINK latency on the worker thread is the quantity under test in the threaded bench; step logic never sees this clock
                 time.sleep(delay)
             try:
                 with _LOOPBACK_DISPATCH_LOCK:
                     response_frame = encode_frame(
+                        # ffcheck: disable=FF111 -- same as the sync path: the hold serializes real engine steps (JAX host state is single-threaded); link delays already overlapped above, outside the lock
                         self.dispatch(decode_frame(request))
                     )
                 self._count(received=len(response_frame))
@@ -679,20 +685,21 @@ class SocketTransport(Transport):
         self.host = host
         self.port = int(port)
         self.connect_timeout_s = connect_timeout_s
-        self._sock: Optional[socket.socket] = None
-        self._ever_connected = False
+        self._sock: Optional[socket.socket] = None  # ffcheck: guarded-by=_lock
+        self._ever_connected = False  # ffcheck: guarded-by=_lock
         #: serializes dial / send / pending-table mutation; reconnect
         #: accounting happens inside, so a racing pair of callers
         #: observing a dead link produce exactly ONE re-dial
-        self._lock = threading.Lock()
-        self._pending: Dict[int, RpcFuture] = {}
+        self._lock = make_lock("SocketTransport._lock")
+        self._pending: Dict[int, RpcFuture] = {}  # ffcheck: guarded-by=_lock
         #: connection generation — a reader thread only tears down the
         #: pending table of the connection it was spawned for
-        self._gen = 0
+        self._gen = 0  # ffcheck: guarded-by=_lock
 
     def _dial_locked(self) -> socket.socket:
         """Dial and start this connection's reader. Caller holds
         ``_lock``."""
+        self._lock.assert_held("SocketTransport re-dial")
         try:
             sock = socket.create_connection(
                 (self.host, self.port), timeout=self.connect_timeout_s
@@ -791,6 +798,7 @@ class SocketTransport(Transport):
             fut._fail(exc)
 
     def _close_sock_locked(self) -> None:
+        self._lock.assert_held("SocketTransport teardown")
         if self._sock is not None:
             try:
                 self._sock.shutdown(socket.SHUT_RDWR)
@@ -811,9 +819,10 @@ class SocketTransport(Transport):
         with self._lock:
             try:
                 sock = self._sock if self._sock is not None \
-                    else self._dial_locked()
+                    else self._dial_locked()  # ffcheck: disable=FF111 -- re-dial must be atomic with the liveness check: two callers racing a dead link would otherwise double-dial and orphan a reader generation
                 self._pending[seq] = fut
                 sock.settimeout(deadline_s)
+                # ffcheck: disable=FF111 -- frame writes must serialize per connection (interleaved sendall corrupts the stream); per-call deadline bounds the stall via settimeout above
                 sock.sendall(frame)
             except TransportError as exc:
                 self._pending.pop(seq, None)

@@ -336,10 +336,14 @@ class ServingConfig:
     # perf-bug class caught at test time instead of as a 100x TPU
     # slowdown); "retrace-warn" — record + FF_LOG=serve=debug log only;
     # "donation" — poison donated cache pytrees after every dispatch so
-    # use-after-donate (the PR-2 page-corruption class) raises loudly.
+    # use-after-donate (the PR-2 page-corruption class) raises loudly;
+    # "locks" — the process-global LockSanitizer watches every
+    # SanitizableLock in the transport/server stack (acquisition-order
+    # graph, per-thread held stacks) and raises LockOrderInversion on
+    # the A->B / B->A deadlock recipe at the second acquisition.
     # Off by default (zero steady-state overhead); tests and bench flip
-    # them on, and FF_SANITIZERS=retrace,donation enables them from the
-    # environment without touching code.
+    # them on, and FF_SANITIZERS=retrace,donation,locks enables them
+    # from the environment without touching code.
     sanitizers: Tuple[str, ...] = ()
     # Self-driving serving (serve/autotune/policy.py): None (default) =
     # no policy loop; "drive" = a cost-model Autoscaler rides
@@ -731,6 +735,7 @@ class InferenceEngine:
         # dispatch hands the old cache to self._poison_donated.
         self.retrace_guard = None
         self.donation_sanitizer = None
+        self.lock_sanitizer = None
         sanitizers = self.serving.sanitizers
         if isinstance(sanitizers, str):
             sanitizers = tuple(
@@ -748,10 +753,17 @@ class InferenceEngine:
                 from ..analysis.donation import DonationSanitizer
 
                 self.donation_sanitizer = DonationSanitizer()
+            elif name == "locks":
+                from ..analysis.locks import enable_lock_sanitizer
+
+                # process-global (locks are shared across engines in a
+                # loopback cluster); idempotent — a second engine joins
+                # the already-active sanitizer
+                self.lock_sanitizer = enable_lock_sanitizer(strict=True)
             else:
                 raise ValueError(
                     f"unknown sanitizer {name!r} (expected 'retrace', "
-                    "'retrace-warn' or 'donation')"
+                    "'retrace-warn', 'donation' or 'locks')"
                 )
         # Cluster fields (serve/cluster/) fail here, at the first
         # replica's engine construction, like kv_quant/fused_decode do.

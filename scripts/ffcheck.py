@@ -16,6 +16,12 @@ Usage::
     python scripts/ffcheck.py --diff main        # only files changed vs ref
     python scripts/ffcheck.py --list-rules
     python scripts/ffcheck.py --show-suppressed  # include suppressed hits
+
+Full-package runs (no explicit paths, no ``--diff``) also run the two
+whole-program concurrency checks that don't fit the one-file lint
+model: the wire-protocol drift diff (``ReplicaServerCore`` dispatch
+table vs ``RemoteReplica`` call sites) and the cross-file
+lock-acquisition-order cycle check. Both exit non-zero on problems.
 """
 from __future__ import annotations
 
@@ -78,6 +84,7 @@ def main(argv: List[str] = None) -> int:
             print(f"{rule.code}  {rule.slug:22s} {rule.doc}")
         return 0
 
+    whole_program = not args.diff and not args.paths
     if args.diff:
         paths = changed_files(args.diff)
         if not paths:
@@ -89,12 +96,39 @@ def main(argv: List[str] = None) -> int:
     findings = lint_paths(paths, with_suppressed=args.show_suppressed)
     for f in findings:
         print(f.format())
+
+    problems: List[str] = []
+    if whole_program:
+        from flexflow_tpu.analysis import check_protocol_drift
+        from flexflow_tpu.analysis.rules.held_lock_blocking import (
+            check_lock_order,
+        )
+
+        cluster = os.path.join(DEFAULT_TARGET, "serve", "cluster")
+        problems += check_protocol_drift(
+            os.path.join(cluster, "server.py"),
+            [os.path.join(cluster, "remote.py")],
+        )
+        problems += check_lock_order([
+            os.path.join(cluster, "transport.py"),
+            os.path.join(cluster, "server.py"),
+            os.path.join(cluster, "remote.py"),
+        ])
+        for p in problems:
+            print(f"ffcheck: {p}")
+
     nfiles = len(list(__import__(
         "flexflow_tpu.analysis.lint", fromlist=["iter_py_files"]
     ).iter_py_files(paths)))
-    if findings:
-        print(f"ffcheck: {len(findings)} finding(s) in {nfiles} file(s)")
+    if findings or problems:
+        print(
+            f"ffcheck: {len(findings)} finding(s), "
+            f"{len(problems)} whole-program problem(s) in "
+            f"{nfiles} file(s)"
+        )
         return 1
+    if whole_program:
+        print("ffcheck: protocol drift + lock order: clean")
     print(f"ffcheck: clean ({nfiles} file(s))")
     return 0
 

@@ -1,0 +1,49 @@
+"""ffcheck fixture: a correctly disciplined threaded worker.
+
+Premerge gate 16 lints this file with the full rule set — it exercises
+every escape hatch the FF110/FF111 concurrency rules ship (inline +
+bulk ``guarded-by`` registry entries, a ``*_locked`` method, a
+``requires-lock`` comment, lock-scoped accesses) and must stay at ZERO
+findings. If a rule change starts flagging this file, the rule broke,
+not the fixture.
+"""
+import threading
+
+
+class GuardedWorker:
+    """Thread-target writes + caller reads, all under the declared
+    lock — the shape transport.py's reader/writer split follows."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = []  # ffcheck: guarded-by=_lock
+        # ffcheck: guarded-by[_lock]=_done
+        self._done = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        with self._lock:
+            self._drain_locked()
+
+    def _drain_locked(self):
+        # *_locked naming: the caller holds _lock (FF110 escape hatch;
+        # checkable at runtime via SanitizableLock.assert_held)
+        while self._inbox:
+            self._inbox.pop()
+            self._done += 1
+
+    def put(self, item):
+        with self._lock:
+            self._inbox.append(item)
+
+    # ffcheck: requires-lock=_lock
+    def pending(self):
+        return len(self._inbox)
+
+    def snapshot(self):
+        with self._lock:
+            return self.pending()

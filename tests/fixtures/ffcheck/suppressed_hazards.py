@@ -1,0 +1,27 @@
+"""ffcheck fixture: every new-rule hazard shape, each carrying the
+reasoned suppression that makes it lint clean.
+
+Premerge gate 16 lints this file — it proves the FF109/FF110/FF111
+suppression syntax keeps working (a suppression-parser regression
+would surface here as findings, before it silently un-suppresses the
+production sites in transport.py/remote.py).
+
+NOTE: the module path is outside the FF109 contract set, so the
+wall-clock call below exercises only the suppression comment parsing,
+not the path gate (tests/test_ffcheck.py covers the gate itself).
+"""
+import threading
+import time
+
+_SEND_LOCK = threading.Lock()
+
+
+def backoff(attempt):
+    # ffcheck: disable=FF109 -- fixture: the reasoned-suppression form the remote.py retry backoff uses
+    time.sleep(0.0 * attempt)
+
+
+def send_exactly(sock, frame):
+    with _SEND_LOCK:
+        # ffcheck: disable=FF111 -- fixture: hold-across-send is the per-connection serialization protocol, same reason as SocketTransport.call_async
+        sock.sendall(frame)

@@ -728,3 +728,57 @@ def test_autoscale_advise_mode_applies_nothing_e2e(tiny, tmp_path):
     assert len(cm.replicas) == 1
     assert all(not d.applied for d in cm.autoscaler.decisions)
     assert all(cm.result(c).error is None for c in cids)
+
+
+# ---------------------------------------------------------------------------
+# PR-19 satellite: the autoscaler drive loop under the lock sanitizer —
+# decisions and outputs BITWISE identical sanitizer-on vs -off, zero
+# findings. Gate 16 selects this by the `locks_sanitizer` fragment.
+
+
+@pytest.mark.slow
+def test_locks_sanitizer_autoscale_drive_bitwise(tiny, tmp_path):
+    from flexflow_tpu.analysis.locks import (
+        active_lock_sanitizer,
+        disable_lock_sanitizer,
+    )
+
+    cfg, params = tiny
+    burst = PROMPTS * 3
+
+    def drive(jdir, sanitizers):
+        serving = _autoscale_serving(jdir, replica_transport="loopback",
+                                     sanitizers=sanitizers)
+        cm = ClusterManager.build(llama, cfg, params, serving)
+        assert cm.autoscaler is not None
+        _tune_policy(cm)
+        cids = [cm.submit(p, max_new_tokens=8) for p in burst]
+        steps = 0
+        while any(not cm._terminal(c) for c in cids):
+            steps += 1
+            assert steps < 4000, "burst hung"
+            if not cm.step():
+                cm.drain()
+        cm.drain()
+        for _ in range(60):
+            cm.step()
+            if cm.stats.scale_ins >= 1:
+                break
+        outs = [list(cm.result(c).output_tokens) for c in cids]
+        kinds = [d.kind for d in cm.autoscaler.decisions]
+        return outs, kinds, cm.stats.autoscale_decisions
+
+    try:
+        assert active_lock_sanitizer() is None
+        base = drive(str(tmp_path / "off"), ())
+        assert active_lock_sanitizer() is None
+        sanitized = drive(str(tmp_path / "on"), ("locks",))
+        san = active_lock_sanitizer()
+        assert san is not None, "ServingConfig wiring did not enable"
+        assert san.findings == [], "\n".join(san.findings)
+        assert san.acquisitions > 0
+        assert sanitized == base, (
+            "lock sanitizer changed autoscaler drive-loop behavior"
+        )
+    finally:
+        disable_lock_sanitizer()

@@ -844,3 +844,58 @@ def test_chaos_sigkill_server_and_manager_crash(tiny, tmp_path):
             if proc.poll() is None:
                 proc.terminate()
                 proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# PR-19 satellite: the SIGKILL-recovery path under the lock sanitizer —
+# recovery must be BITWISE identical sanitizer-on vs -off, with zero
+# findings over the whole kill/replay/finish sequence. Gate 16 selects
+# this by the `locks_sanitizer` name fragment.
+
+
+@pytest.mark.slow
+def test_locks_sanitizer_kill_restart_bitwise(tiny, tmp_path):
+    from flexflow_tpu.analysis.locks import (
+        active_lock_sanitizer,
+        disable_lock_sanitizer,
+    )
+
+    cfg, params = tiny
+
+    def kill_and_recover(jdir, sanitizers):
+        kw = sc_kwargs(replicas=2, router_policy="round_robin",
+                       replica_transport="loopback",
+                       sanitizers=sanitizers)
+        sc = ServingConfig(journal_dir=jdir, **kw)
+        cm = ClusterManager.build(llama, cfg, params, sc)
+        cids = [cm.submit(p, max_new_tokens=8) for p in PROMPTS]
+        for _ in range(40):
+            cm.step()
+            if any(cm.requests[c].output_tokens for c in cids):
+                cm.step()
+                break
+        assert not all(cm._terminal(c) for c in cids), "killed too late"
+        del cm  # simulated SIGKILL: no drain, no close, no goodbyes
+        cm2 = ClusterManager.recover(
+            llama, cfg, params, ServingConfig(journal_dir=jdir, **kw)
+        )
+        assert cm2.stats.manager_recoveries == 1
+        got = _finish(cm2, cids)
+        errs = [cm2.result(c).error for c in cids]
+        cm2.check_no_leaks()
+        return got, errs
+
+    try:
+        assert active_lock_sanitizer() is None
+        base = kill_and_recover(str(tmp_path / "off"), ())
+        assert active_lock_sanitizer() is None
+        sanitized = kill_and_recover(str(tmp_path / "on"), ("locks",))
+        san = active_lock_sanitizer()
+        assert san is not None, "ServingConfig wiring did not enable"
+        assert san.findings == [], "\n".join(san.findings)
+        assert san.acquisitions > 0
+        assert sanitized == base, (
+            "lock sanitizer changed SIGKILL-recovery behavior"
+        )
+    finally:
+        disable_lock_sanitizer()

@@ -1,0 +1,263 @@
+"""Lock sanitizer (flexflow_tpu/analysis/locks.py) unit tests.
+
+The sanitizer is the DYNAMIC half of the PR's concurrency tooling: the
+static FF110/FF111 rules prove lock discipline about code they can see,
+these tests prove the runtime checker catches what slips past —
+an injected lock-order inversion must fail LOUDLY
+(:class:`LockOrderInversion` with both acquisition stacks), and an
+``assert_held`` contract violation must raise :class:`LockNotHeld`
+naming the un-held lock. The sanitizer is process-global, so every test
+disables it in a ``finally`` — leaking an active sanitizer into the
+rest of the suite would instrument unrelated transport tests.
+"""
+import threading
+
+import pytest
+
+from flexflow_tpu.analysis.locks import (
+    LockNotHeld,
+    LockOrderInversion,
+    LockSanitizer,
+    SanitizableLock,
+    active_lock_sanitizer,
+    disable_lock_sanitizer,
+    enable_lock_sanitizer,
+    make_lock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_sanitizer():
+    """Belt and suspenders: no test may leak the global sanitizer."""
+    assert active_lock_sanitizer() is None
+    yield
+    disable_lock_sanitizer()
+
+
+# ---------------------------------------------------------------------------
+# pass-through (sanitizer off)
+
+
+def test_sanitizable_lock_is_plain_lock_when_disabled():
+    lock = make_lock("t_lock")
+    assert isinstance(lock, SanitizableLock)
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()
+        # no owner tracking without a sanitizer
+        assert not lock.held_by_current_thread()
+    assert not lock.locked()
+    lock.assert_held("never raises while disabled")
+
+
+def test_acquire_release_protocol():
+    lock = make_lock("t_lock")
+    assert lock.acquire()
+    assert not lock.acquire(blocking=False)  # held, non-blocking fails
+    lock.release()
+    assert lock.acquire(blocking=False)
+    lock.release()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+
+
+def test_enable_is_idempotent_and_disable_returns_it():
+    san = enable_lock_sanitizer()
+    assert enable_lock_sanitizer() is san
+    assert active_lock_sanitizer() is san
+    assert disable_lock_sanitizer() is san
+    assert active_lock_sanitizer() is None
+    assert disable_lock_sanitizer() is None
+
+
+def test_held_stack_tracks_nesting():
+    san = enable_lock_sanitizer()
+    try:
+        a, b = make_lock("A"), make_lock("B")
+        with a:
+            assert san.held() == ("A",)
+            assert a.held_by_current_thread()
+            with b:
+                assert san.held() == ("A", "B")
+            assert san.held() == ("A",)
+        assert san.held() == ()
+        assert san.acquisitions == 2
+    finally:
+        disable_lock_sanitizer()
+
+
+def test_held_stack_is_per_thread():
+    san = enable_lock_sanitizer()
+    try:
+        a = make_lock("A")
+        seen = {}
+        with a:
+            t = threading.Thread(
+                target=lambda: seen.setdefault("held", san.held())
+            )
+            t.start()
+            t.join()
+        assert seen["held"] == ()  # the other thread holds nothing
+    finally:
+        disable_lock_sanitizer()
+
+
+# ---------------------------------------------------------------------------
+# order-graph inversion — must fail LOUDLY
+
+
+def test_injected_inversion_raises_with_both_stacks():
+    enable_lock_sanitizer(strict=True)
+    try:
+        a, b = make_lock("A"), make_lock("B")
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderInversion) as exc_info:
+            with b:
+                with a:  # the reverse order — latent deadlock
+                    pass
+        msg = str(exc_info.value)
+        assert "'B' -> 'A'" in msg and "'A' -> 'B'" in msg
+        # both acquisition sites are named (function(file:line) summaries)
+        assert "test_locks.py" in msg
+    finally:
+        disable_lock_sanitizer()
+
+
+def test_inversion_across_threads_detected():
+    """Each order observed on its OWN thread — no run ever deadlocks,
+    the sanitizer still flags the latent cycle."""
+    san = enable_lock_sanitizer(strict=False)
+    try:
+        a, b = make_lock("A"), make_lock("B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start()
+        t2.join()
+        assert len(san.findings) == 1
+        assert "lock-order inversion" in san.findings[0]
+    finally:
+        disable_lock_sanitizer()
+
+
+def test_record_mode_collects_instead_of_raising():
+    san = enable_lock_sanitizer(strict=False)
+    try:
+        a, b = make_lock("A"), make_lock("B")
+        with a, b:
+            pass
+        with b, a:
+            pass
+        assert len(san.findings) == 1
+        assert "acquisitions" in san.report()
+        assert "lock-order inversion" in san.report()
+    finally:
+        disable_lock_sanitizer()
+
+
+def test_reacquiring_same_order_is_not_an_inversion():
+    san = enable_lock_sanitizer(strict=True)
+    try:
+        a, b = make_lock("A"), make_lock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert san.findings == []
+    finally:
+        disable_lock_sanitizer()
+
+
+# ---------------------------------------------------------------------------
+# assert_held contracts
+
+
+def test_assert_held_raises_when_not_held():
+    enable_lock_sanitizer(strict=True)
+    try:
+        lock = make_lock("guard")
+        with pytest.raises(LockNotHeld) as exc_info:
+            lock.assert_held("the pending table")
+        msg = str(exc_info.value)
+        assert "the pending table" in msg and "'guard'" in msg
+    finally:
+        disable_lock_sanitizer()
+
+
+def test_assert_held_passes_under_lock():
+    enable_lock_sanitizer(strict=True)
+    try:
+        lock = make_lock("guard")
+        with lock:
+            lock.assert_held("fine")
+    finally:
+        disable_lock_sanitizer()
+
+
+def test_transport_locked_methods_carry_runtime_contract():
+    """The transport's ``*_locked`` methods are assert_held-guarded:
+    calling one WITHOUT the writer lock must raise under the sanitizer
+    (the runtime form of the FF110 ``*_locked`` escape hatch)."""
+    from flexflow_tpu.serve.cluster.transport import SocketTransport
+
+    enable_lock_sanitizer(strict=True)
+    try:
+        t = SocketTransport("127.0.0.1", 1, connect_timeout_s=0.1)
+        with pytest.raises(LockNotHeld):
+            t._close_sock_locked()
+        with t._lock:
+            t._close_sock_locked()  # caller holds the lock: fine
+    finally:
+        disable_lock_sanitizer()
+
+
+# ---------------------------------------------------------------------------
+# engine wiring
+
+
+def _tiny_engine(sanitizers):
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import InferenceEngine, ServingConfig
+
+    cfg = llama.LLaMAConfig.tiny(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    sc = ServingConfig(
+        max_requests_per_batch=2,
+        max_sequence_length=16,
+        cache_dtype=jnp.float32,
+        sanitizers=sanitizers,
+    )
+    return InferenceEngine(llama, cfg, params, sc)
+
+
+def test_serving_config_unknown_sanitizer_mentions_locks():
+    with pytest.raises(ValueError, match="locks"):
+        _tiny_engine(("bogus",))
+
+
+def test_serving_config_locks_enables_global_sanitizer():
+    try:
+        eng = _tiny_engine(("locks",))
+        assert eng.lock_sanitizer is not None
+        assert active_lock_sanitizer() is eng.lock_sanitizer
+    finally:
+        disable_lock_sanitizer()

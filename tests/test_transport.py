@@ -834,3 +834,156 @@ def test_subprocess_server_survives_malformed_frames(tiny):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# lock sanitizer over the threaded transport (PR-19): the deadlock
+# regression — reader delivering out-of-order completions while the
+# writer re-dials under the writer lock — and the sanitizer-on ==
+# sanitizer-off bitwise chaos run. Gate 16 selects these by the
+# `locks_sanitizer` name fragment.
+
+from flexflow_tpu.analysis.locks import (  # noqa: E402
+    active_lock_sanitizer,
+    disable_lock_sanitizer,
+    enable_lock_sanitizer,
+)
+
+
+def _out_of_order_frame_server():
+    """Frame-speaking echo server that answers PAIRS of requests
+    newest-first (out-of-order completion on the wire) and singles
+    after a short idle — the reader-thread ordering the deadlock
+    regression needs."""
+    listener = socket.create_server(("127.0.0.1", 0))
+    listener.settimeout(0.2)
+    port = listener.getsockname()[1]
+    stop = threading.Event()
+
+    def serve_conn(conn):
+        conn.settimeout(0.2)
+        batch = []
+
+        def flush():
+            for r in reversed(batch):
+                conn.sendall(encode_frame(
+                    {"seq": r["seq"], "ok": True,
+                     "result": r["args"]["x"]}
+                ))
+            batch.clear()
+
+        try:
+            while not stop.is_set():
+                try:
+                    req = read_frame_from_socket(conn)
+                except DeadlineExceeded:
+                    flush()
+                    continue
+                batch.append(req)
+                if len(batch) == 2:
+                    flush()
+        except (TransportError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def accept_loop():
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    return listener, port, stop
+
+
+def test_locks_sanitizer_reader_redial_deadlock_regression():
+    """PR-19 satellite: the reader thread popping out-of-order
+    completions under the writer lock races the caller re-dialing
+    under the SAME lock after a drop. A lock-order inversion anywhere
+    in that dance deadlocks two threads in production; under the
+    strict sanitizer it raises LockOrderInversion here instead. Also
+    proves the *_locked assert_held contracts hold on the real path."""
+    import itertools
+    import random
+
+    san = enable_lock_sanitizer(strict=True)
+    listener, port, stop = _out_of_order_frame_server()
+    tp = SocketTransport("127.0.0.1", port, connect_timeout_s=5.0)
+    rng = random.Random(7)  # seeded: same drop schedule every run
+    seq = itertools.count(1)
+    try:
+        for _ in range(6):
+            f1 = tp.call_async(next(seq), "echo", {"x": 1},
+                               deadline_s=5.0)
+            f2 = tp.call_async(next(seq), "echo", {"x": 2},
+                               deadline_s=5.0)
+            # the wire delivers f2's response FIRST (server replies
+            # newest-first): the reader resolves out of issue order
+            assert f2.result() == 2
+            assert f1.result() == 1
+            if rng.random() < 0.5:
+                # writer re-dials under _lock on the next call while
+                # the superseded reader generation tears down
+                tp.drop_connection()
+        assert san.findings == [], "\n".join(san.findings)
+        assert san.acquisitions > 0
+    finally:
+        tp.close()
+        stop.set()
+        listener.close()
+        disable_lock_sanitizer()
+
+
+@pytest.mark.slow
+def test_locks_sanitizer_chaos_bitwise(tiny):
+    """The acceptance chaos plan, sanitizer-off vs
+    ServingConfig(sanitizers=("locks",)): outputs, errors and fired
+    faults must be BITWISE identical (the instrumented path takes no
+    lock of its own around user-visible work) and the sanitizer must
+    finish with zero findings over the whole fault schedule."""
+    kw = dict(replicas=3, router_policy="round_robin",
+              failover_retries=3)
+    plan_json = FaultPlan([
+        Fault("partition", replica=1, step=2, count=1000),
+        Fault("delay", replica=0, step=3, count=3, seconds=0.25),
+        Fault("disconnect", replica=2, step=4, count=2),
+        Fault("drop", replica=0, step=5, count=3),
+    ]).to_json()
+
+    def run(sanitizers):
+        cm = _cluster(tiny, "loopback", sanitizers=sanitizers, **kw)
+        injector = cm.attach_faults(plan_json)
+        cids = [cm.submit(p, max_new_tokens=8) for p in PROMPTS]
+        for _ in range(500):
+            if all(cm._terminal(c) for c in cids):
+                break
+            cm.step()
+        cm.drain()
+        outs = [cm.result(c).output_tokens for c in cids]
+        errs = [cm.result(c).error for c in cids]
+        fired = [(f["kind"], f["replica"], f["step"])
+                 for f in injector.fired]
+        return outs, errs, fired
+
+    try:
+        assert active_lock_sanitizer() is None
+        base = run(())
+        assert active_lock_sanitizer() is None
+        sanitized = run(("locks",))
+        san = active_lock_sanitizer()
+        assert san is not None, "ServingConfig wiring did not enable"
+        assert san.findings == [], "\n".join(san.findings)
+        assert san.acquisitions > 0
+        assert sanitized == base, "sanitizer changed observable behavior"
+    finally:
+        disable_lock_sanitizer()

@@ -651,3 +651,63 @@ def test_subprocess_concurrent_cluster_bitwise_serial(tiny):
             proc.terminate()
         for proc in procs:
             proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# PR-19 satellite: ClusterStats wire/RPC counters are read-modify-write
+# from the worker/reader thread (received bytes) and caller threads
+# (sent bytes, retries/errors) CONCURRENTLY — every increment must land
+# under _STATS_LOCK, so the totals are exact, not approximate.
+
+
+def test_wire_counter_atomicity_under_thread_hammer():
+    """8 threads x 2000 bare increments: any unlocked += on the shared
+    ClusterStats would lose updates and land below the exact total."""
+    from flexflow_tpu.metrics import ClusterStats
+
+    st = ClusterStats()
+    tp = Transport(stats=st)
+    threads = [
+        threading.Thread(
+            target=lambda: [tp._count(sent=1, received=2)
+                            for _ in range(2000)]
+        )
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tp.bytes_sent == 8 * 2000
+    assert tp.bytes_received == 2 * 8 * 2000
+    assert st.wire_bytes_sent == 8 * 2000
+    assert st.wire_bytes_received == 2 * 8 * 2000
+
+
+def test_wire_counter_accuracy_concurrent_async_steps():
+    """Threaded loopback under concurrent issue/harvest: the transport
+    and ClusterStats wire totals must equal the EXACT sum of per-frame
+    byte counts the futures observed — worker-thread received-side
+    increments interleaving with caller-thread sent-side increments."""
+    from flexflow_tpu.metrics import ClusterStats
+
+    st = ClusterStats()
+
+    def dispatch(req):
+        return {"seq": req["seq"], "ok": True, "result": req["args"]}
+
+    tp = LoopbackTransport(dispatch, stats=st)
+    tp.threaded = True
+    futs = [
+        tp.call_async(seq, "echo", {"x": list(range(seq % 7))},
+                      deadline_s=10.0)
+        for seq in range(1, 101)
+    ]
+    for fut in futs:
+        fut.result()
+    sent = sum(f.sent_bytes for f in futs)
+    received = sum(f.received_bytes for f in futs)
+    assert sent > 0 and received > 0
+    assert (tp.bytes_sent, tp.bytes_received) == (sent, received)
+    assert (st.wire_bytes_sent, st.wire_bytes_received) == (sent, received)
+    tp.close()
