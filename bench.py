@@ -252,6 +252,15 @@ _PHASES = [
     # (>=1x asserted); bitwise greedy parity + zero steady-state
     # recompiles asserted in both arms
     ("serve_spec_adaptive", 700, 500, True, True),
+    # distilled drafts + verify-skip + the megakernel fold: KL-distill
+    # a student draft from harvested teacher logits and rank it against
+    # layer-skip by measured accept-rate-per-draft-GFLOP (distilled
+    # must win per-FLOP); verify-skip A/B on a cold-draft adversarial
+    # workload (tokens/sec >= the non-speculative scheduler, bitwise
+    # parity, zero steady-state recompiles, skips actually taken);
+    # early-exit spec rounds folded into the whole-step walk bitwise
+    # the unfused spec arm
+    ("serve_spec_distill", 700, 500, True, True),
     # megakernel decode step: per-fusion ablation (rope_kv_write /
     # sampling / both) on small-batch sync decode — decode_step_ms
     # p50/p99 + dispatched programs per step, bitwise parity asserted
@@ -464,6 +473,43 @@ def orchestrate(which):
                 tokens_per_verify_step=d.get("tokens_per_verify_step"),
                 platform=d.get("platform"),
             )
+
+    # Derived: draft utility — measured drafted accept rate per draft
+    # GFLOP for the distilled student, next to layer-skip's on the same
+    # verify ladder, so BENCH_r*.json tracks whether distillation keeps
+    # paying per-FLOP as the recipe and harvest corpus evolve.
+    rec = _RESULTS.get("spec_distill_accept_per_gflop")
+    if rec:
+        d = rec.get("detail") or {}
+        emit(
+            "accept_rate_per_draft_gflop",
+            rec["value"],
+            "accept/GFLOP",
+            source=rec["metric"],
+            layer_skip=d.get("layer_skip_accept_per_gflop"),
+            distilled_over_layer_skip=rec.get("vs_baseline"),
+            distilled_accept_rate=d.get("distilled_accept_rate"),
+            student_geometry=d.get("student_geometry"),
+            platform=d.get("platform"),
+        )
+
+    # Derived: the verify-skip win — speculative tokens/sec over the
+    # non-speculative scheduler on the cold-draft adversarial workload.
+    # The strictly-never-worse claim IS this number staying >= 1.
+    rec = _RESULTS.get("spec_verify_skip_tokens_per_sec_per_chip")
+    if rec:
+        d = rec.get("detail") or {}
+        emit(
+            "verify_skip_win",
+            rec.get("vs_baseline"),
+            "ratio",
+            source=rec["metric"],
+            verify_skipped_rounds=d.get("verify_skipped_rounds"),
+            spec_reprobes=d.get("spec_reprobes"),
+            output_parity=d.get("output_parity"),
+            steady_state_recompiles=d.get("steady_state_recompiles"),
+            platform=d.get("platform"),
+        )
 
     # Derived: fault-recovery behavior — how long a replica death
     # stalls the requests it stranded (recompute re-admission drain)
@@ -1270,6 +1316,291 @@ def serve_spec_adaptive_bench(on_tpu, kernels):
         f"non-speculative continuous-batching scheduler ({incr_tps:.1f})"
     )
     return spec_tps
+
+
+def serve_spec_distill_bench(on_tpu, kernels):
+    """Distilled drafts + verify-skip + the megakernel fold (ROADMAP
+    item 4, the PR-20 half): speculation priced by measured
+    accept-rate-per-draft-FLOP instead of chosen by prior.
+
+    Three sub-workloads, each asserting its half of the claim:
+
+    * **draft ladder** (distilled vs layer-skip): harvest
+      (context, teacher-logits) pairs by offline trace replay of the
+      teacher's own greedy outputs, KL-distill a narrow/shallow
+      student (`serve/spec_distill.py`), then run BOTH drafts through
+      the same adaptive verify ladder and price each with
+      `measure_draft_utility`. Asserts the distilled draft beats the
+      1-layer layer-skip draft on accept-rate-per-draft-GFLOP — the
+      student is both smaller (denominator) and target-shaped
+      (numerator), which is the whole distillation thesis.
+    * **verify-skip A/B** (cold-draft adversarial workload — the
+      regime where speculation loses to its own overhead): a 1-layer
+      layer-skip draft over RAW random weights never gets a token
+      accepted, so without verify-skip every round pays draft+verify
+      for nothing. `SpecConfig(verify_skip=True)` parks those requests
+      on the incremental decode path with periodic re-probes. Asserts
+      tokens/sec >= the non-speculative continuous-batching scheduler
+      (`verify_skip_win` >= 1), bitwise greedy parity, skips actually
+      taken (verify_skipped_rounds > 0, re-probes on cadence), zero
+      retraces and zero steady-state recompiles.
+    * **megakernel fold** (early-exit draft on the damped-deep
+      target): the SAME spec workload with `fused_decode=
+      ("whole_step",)` — draft (layer-sliced grid) and verify
+      (tree-masked all-positions head) dispatch as two programs of the
+      ONE persistent whole-step walk. Asserts the folded outputs are
+      bitwise the unfused spec arm's (both bitwise incremental), and
+      that the fold actually engaged (whole-step tree/speculate step
+      keys present).
+
+    CPU caveat: the skip arm's tokens/sec ratio is timing, so off-chip
+    it is a parity-grade smoke (skip rounds run the literal incremental
+    step, so the arms execute near-identical work); the draft ladder's
+    accept-per-GFLOP ranking and both bitwise assertions are
+    platform-independent.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.models import llama
+    from flexflow_tpu.serve import (
+        InferenceEngine,
+        RequestManager,
+        ServingConfig,
+        SpecConfig,
+        SpecInferManager,
+    )
+    from flexflow_tpu.serve import spec_distill as sd
+
+    cfg = llama.LLaMAConfig.tiny(
+        dtype=jnp.float32, num_hidden_layers=4, hidden_size=128,
+        intermediate_size=256, num_attention_heads=4,
+        num_key_value_heads=2, vocab_size=512,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_new = 48
+    n_req, slots, prompt_len = 8, 4, 12
+    prompts = [
+        [(i * 37 + j * 11 + 3) % cfg.vocab_size for j in range(prompt_len)]
+        for i in range(n_req)
+    ]
+
+    def make_sc(**kw):
+        d = dict(
+            max_requests_per_batch=slots,
+            max_sequence_length=prompt_len + n_new + 8,
+            prefill_chunk=8,
+            max_spec_tree_tokens=32,
+            cache_dtype=jnp.float32,
+            kernels=kernels,
+            kv_layout="paged",
+            page_size=16,
+        )
+        d.update(kw)
+        return ServingConfig(**d)
+
+    def guards(mgr):
+        return [
+            g for g in (
+                e.retrace_guard for e in [mgr.engine, *mgr.ssms]
+            ) if g is not None
+        ]
+
+    # ---- draft ladder: KL-distilled student vs 1-layer layer-skip,
+    # both priced by measured accept-rate-per-draft-GFLOP ----
+    rm = RequestManager(InferenceEngine(llama, cfg, params, make_sc()))
+    traces = rm.generate(prompts, max_new_tokens=n_new)
+    ref = [o.output_tokens for o in traces]
+
+    buf = sd.harvest_offline(llama, cfg, params, traces, max_len=48)
+    # Low temperature sharpens the teacher targets toward its argmax —
+    # the greedy ladder accepts on argmax agreement, and this raw-init
+    # teacher's logits are near-uniform (a trained teacher needs less).
+    dcfg = sd.DistillConfig(
+        hidden_size=64, num_layers=2, num_heads=4,
+        seq_len=48, batch_size=8, steps=1500, lr=3e-3,
+        temperature=0.02, seed=0,
+    )
+    scfg, sparams, history = sd.train_distilled_draft(
+        buf, cfg, dcfg, family=llama
+    )
+
+    def make_mgr(draft_cfg, draft_params, spec):
+        return SpecInferManager(
+            InferenceEngine(llama, cfg, params, make_sc()),
+            InferenceEngine(llama, draft_cfg, draft_params, make_sc()),
+            spec,
+        )
+
+    ladder = SpecConfig(beam_width=3, beam_depth=8, adaptive=True)
+    ev_distilled = sd.measure_draft_utility(
+        make_mgr(scfg, sparams, ladder), prompts,
+        max_new_tokens=n_new, name="distilled",
+    )
+    lcfg, lparams = _layer_skip_draft(cfg, params, 1)
+    ev_skip = sd.measure_draft_utility(
+        make_mgr(lcfg, lparams, ladder), prompts,
+        max_new_tokens=n_new, name="layer_skip",
+    )
+    per_gflop_ratio = ev_distilled.accept_rate_per_gflop / max(
+        ev_skip.accept_rate_per_gflop, 1e-9
+    )
+    emit(
+        "spec_distill_accept_per_gflop",
+        round(ev_distilled.accept_rate_per_gflop, 2),
+        "accept/GFLOP",
+        vs_baseline=per_gflop_ratio,  # vs layer-skip; the bar is > 1
+        layer_skip_accept_per_gflop=round(ev_skip.accept_rate_per_gflop, 2),
+        distilled_accept_rate=round(ev_distilled.accept_rate, 4),
+        layer_skip_accept_rate=round(ev_skip.accept_rate, 4),
+        distilled_gflops_per_token=round(
+            ev_distilled.draft_gflops_per_token, 6),
+        layer_skip_gflops_per_token=round(ev_skip.draft_gflops_per_token, 6),
+        harvested_examples=len(buf),
+        distill_steps=dcfg.steps,
+        distill_loss_first=round(history[0], 4),
+        distill_loss_last=round(history[-1], 4),
+        student_geometry=(
+            f"{dcfg.num_layers}L/{dcfg.hidden_size}h/{dcfg.num_heads}H"
+        ),
+        kernels=kernels,
+        platform=_platform(),
+    )
+    assert per_gflop_ratio > 1.0, (
+        f"distilled draft ({ev_distilled.accept_rate_per_gflop:.2f} "
+        f"accept/GFLOP) did not beat layer-skip "
+        f"({ev_skip.accept_rate_per_gflop:.2f}) on "
+        f"accept-rate-per-draft-GFLOP"
+    )
+
+    # ---- verify-skip A/B: cold draft, spec must never lose ----
+    # the adversarial draft: an UNRELATED random init (not even the
+    # teacher's first layer) — nothing it drafts is ever accepted, so
+    # without verify-skip every round pays draft+verify for zero tokens
+    import dataclasses as _dc
+    ccfg = _dc.replace(cfg, num_hidden_layers=1)
+    cparams = llama.init_params(jax.random.PRNGKey(7), ccfg)
+    rm_cold = RequestManager(InferenceEngine(llama, cfg, params, make_sc()))
+    rm_cold.generate(prompts, max_new_tokens=n_new)  # warm compiles
+    incr_dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ref_cold = rm_cold.generate(prompts, max_new_tokens=n_new)
+        incr_dt = min(incr_dt, time.perf_counter() - t0)
+    assert [o.output_tokens for o in ref_cold] == ref
+    incr_tokens = sum(len(o.output_tokens) for o in ref_cold)
+    incr_tps = incr_tokens / incr_dt
+
+    spec_vs = SpecConfig(
+        beam_width=2, beam_depth=3, adaptive=True,
+        verify_skip=True, skip_threshold=0.1, reprobe_every=8,
+    )
+    mgr_vs = SpecInferManager(
+        InferenceEngine(llama, cfg, params, make_sc(sanitizers=("retrace",))),
+        InferenceEngine(llama, ccfg, cparams,
+                        make_sc(sanitizers=("retrace",))),
+        spec_vs,
+    )
+    # warm with the IDENTICAL workload: fresh requests repeat the same
+    # skip/re-probe trajectory, so the timed runs must compile NOTHING
+    mgr_vs.generate(prompts, max_new_tokens=n_new)
+    compiles_warm = sum(g.total_compiles for g in guards(mgr_vs))
+    skip_dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        outs_vs = mgr_vs.generate(prompts, max_new_tokens=n_new)
+        skip_dt = min(skip_dt, time.perf_counter() - t0)
+    assert [o.output_tokens for o in outs_vs] == ref, (
+        "verify-skip broke greedy parity vs incremental decoding"
+    )
+    steady_vs = sum(g.total_compiles for g in guards(mgr_vs)) - compiles_warm
+    assert steady_vs == 0, steady_vs
+    assert all(g.retraces == 0 for g in guards(mgr_vs))
+    st = mgr_vs.stats
+    assert st.verify_skipped_rounds > 0, (
+        "cold draft never tripped verify-skip — the A/B measured nothing"
+    )
+    skip_tokens = sum(len(o.output_tokens) for o in outs_vs)
+    skip_tps = skip_tokens / skip_dt
+    emit(
+        "spec_verify_skip_tokens_per_sec_per_chip",
+        round(skip_tps, 2),
+        "tokens/sec/chip",
+        vs_baseline=skip_tps / incr_tps,  # verify_skip_win; bar is >= 1
+        incr_tokens_per_sec=round(incr_tps, 2),
+        verify_skipped_rounds=st.verify_skipped_rounds,
+        spec_reprobes=st.spec_reprobes,
+        spec_rounds=st.spec_rounds,
+        drafted_accept_rate=round(st.spec_accept_rate, 4),
+        skip_threshold=spec_vs.skip_threshold,
+        reprobe_every=spec_vs.reprobe_every,
+        output_parity=1,
+        steady_state_recompiles=steady_vs,
+        caveat=(
+            "CPU smoke: skip rounds execute the literal incremental "
+            "step so both arms do near-identical work off-chip; the "
+            "chip is where skipped draft+verify dispatches were the "
+            "measurable loss"
+        ) if not on_tpu else None,
+        kernels=kernels,
+        platform=_platform(),
+    )
+    assert skip_tps >= incr_tps, (
+        f"verify-skip ({skip_tps:.1f} tok/s) lost to the "
+        f"non-speculative continuous-batching scheduler ({incr_tps:.1f})"
+    )
+
+    # ---- megakernel fold: spec round as two dispatches of the ONE
+    # persistent whole-step walk, bitwise the unfused spec arm ----
+    bparams = _damped_deep_layers(cfg, params, k=1)
+    rm_b = RequestManager(InferenceEngine(llama, cfg, bparams, make_sc()))
+    ref_b = [
+        o.output_tokens for o in rm_b.generate(prompts, max_new_tokens=n_new)
+    ]
+    spec_ee = SpecConfig(beam_width=2, beam_depth=3,
+                         draft="early_exit", draft_layers=1)
+    mgr_unf = SpecInferManager(
+        InferenceEngine(llama, cfg, bparams, make_sc()), None, spec_ee,
+    )
+    unf = [
+        o.output_tokens
+        for o in mgr_unf.generate(prompts, max_new_tokens=n_new)
+    ]
+    assert unf == ref_b, "unfused spec arm broke greedy parity"
+    eng_fold = InferenceEngine(
+        llama, cfg, bparams, make_sc(fused_decode=("whole_step",)),
+    )
+    assert eng_fold.whole_step_spec_on, (
+        "whole-step spec fold did not engage on the untiled "
+        "single-shard walk"
+    )
+    mgr_fold = SpecInferManager(eng_fold, None, spec_ee)
+    fold = [
+        o.output_tokens
+        for o in mgr_fold.generate(prompts, max_new_tokens=n_new)
+    ]
+    assert fold == unf, (
+        "megakernel-folded spec rounds are not bitwise the unfused arm"
+    )
+    fold_keys = [k for k in eng_fold._steps if "whole_step" in str(k)]
+    assert any("whole_step_tree" in str(k) for k in fold_keys), fold_keys
+    assert any(
+        "speculate" in str(k) and "whole_step" in str(k) for k in fold_keys
+    ), fold_keys
+    emit(
+        "spec_megakernel_fold_parity",
+        1.0,
+        "bool",
+        vs_baseline=1.0,
+        whole_step_keys=len(fold_keys),
+        spec_rounds=mgr_fold.stats.spec_rounds,
+        drafted_accept_rate=round(mgr_fold.stats.spec_accept_rate, 4),
+        draft="early_exit",
+        draft_layers=1,
+        kernels=kernels,
+        platform=_platform(),
+    )
+    return skip_tps
 
 
 def serve_paged_bench(on_tpu, kernels):
@@ -4771,6 +5102,8 @@ def child_main(phase, platform, kernels):
         serve_long_context_bench(on_tpu, kernels)
     elif phase == "serve_spec_adaptive":
         serve_spec_adaptive_bench(on_tpu, kernels)
+    elif phase == "serve_spec_distill":
+        serve_spec_distill_bench(on_tpu, kernels)
     elif phase == "serve_fused":
         serve_fused_bench(on_tpu, kernels)
     elif phase == "serve_megakernel":
@@ -4807,7 +5140,8 @@ def main():
                  "serve_paged_q", "serve_kv_hierarchy",
                  "serve_long_context", "serve_cluster",
                  "serve_faults", "serve_elastic", "serve_transport",
-                 "serve_cluster_async", "serve_autotune", "serve_fused",
+                 "serve_cluster_async", "serve_autotune",
+                 "serve_spec_adaptive", "serve_spec_distill", "serve_fused",
                  "serve_megakernel", "serve_int8", "serve_int4", "serve_7b"],
         help="run a single phase (default: all, insurance-first order)",
     )

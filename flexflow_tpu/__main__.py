@@ -11,6 +11,8 @@ model.cc:4049-4200). The TPU framework's equivalents:
                directory (or a tiny random model when omitted)
   search       Unity auto-parallel compile + strategy/dot export
   serve-search offline ServingConfig search over the serving cost model
+  spec-distill distill a draft from target logits + rank the draft
+               ladder by measured accept-rate-per-draft-GFLOP
   bench        the headline benchmark (bench.py)
 
 Reference-style degree flags are accepted with either one or two
@@ -318,6 +320,127 @@ def cmd_serve_search(args):
     print("serve with: python -m flexflow_tpu serve " + " ".join(flags))
 
 
+def cmd_spec_distill(args):
+    import dataclasses
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .serve import (
+        InferenceEngine,
+        ServingConfig,
+        SpecConfig,
+        SpecInferManager,
+    )
+    from .serve import spec_distill as sd
+
+    if args.model_dir:
+        from .serve.llm import LLM
+
+        llm = LLM.from_pretrained(args.model_dir)
+        family, cfg, params = llm.family, llm.cfg, llm.params
+    else:
+        from .models import llama as family
+
+        cfg = family.LLaMAConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=344,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=4, max_position_embeddings=512,
+            dtype=jnp.float32,
+        )
+        params = family.init_params(jax.random.PRNGKey(0), cfg)
+
+    def make_sc():
+        return ServingConfig(
+            max_requests_per_batch=4,
+            max_sequence_length=args.max_sequence_length,
+            max_spec_tree_tokens=16,
+            cache_dtype=cfg.dtype,
+        )
+
+    k = max(1, cfg.num_hidden_layers // 4)
+    dcfg = dataclasses.replace(cfg, num_hidden_layers=k)
+    dparams = dict(params)
+    dparams["layers"] = {n: v[:k] for n, v in params["layers"].items()}
+
+    def make_mgr(draft_cfg=None, draft_params=None, spec=None):
+        eng = InferenceEngine(family, cfg, params, make_sc())
+        ssms = []
+        if draft_cfg is not None:
+            ssms = [InferenceEngine(family, draft_cfg, draft_params,
+                                    make_sc())]
+        return SpecInferManager(
+            eng, ssms, spec or SpecConfig(2, 4, adaptive=True)
+        )
+
+    rng = np.random.RandomState(args.seed)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(4, 12)).tolist()
+        for _ in range(args.num_prompts)
+    ]
+
+    # 1. harvest teacher logits: offline trace replay, or live from the
+    #    layer-skip manager's verify rounds
+    if args.trace_file:
+        with open(args.trace_file) as f:
+            traces = json.load(f)
+        buf = sd.harvest_offline(family, cfg, params, traces)
+        print(f"harvested {len(buf)} examples from "
+              f"{len(traces)} offline trace(s)")
+    else:
+        buf = sd.harvest_online(
+            make_mgr(dcfg, dparams), prompts,
+            max_new_tokens=args.max_new_tokens,
+        )
+        print(f"harvested {len(buf)} examples from live verify rounds")
+
+    # 2. distill the student
+    distill = sd.DistillConfig(
+        hidden_size=args.hidden, num_layers=args.layers,
+        num_heads=args.heads, seq_len=args.seq_len,
+        batch_size=args.batch_size, steps=args.steps, lr=args.lr,
+        temperature=args.temperature, seed=args.seed,
+    )
+    scfg, sparams, hist = sd.train_distilled_draft(
+        buf, cfg, distill, family=family
+    )
+    print(f"distilled {distill.num_layers}L/{distill.hidden_size}h draft: "
+          f"loss {hist[0]:.4f} -> {hist[-1]:.4f} over {len(hist)} steps")
+
+    # 3. rank the draft ladder by measured accept-rate-per-draft-GFLOP
+    evals = [
+        sd.measure_draft_utility(
+            make_mgr(scfg, sparams), prompts,
+            max_new_tokens=args.max_new_tokens, name="distilled",
+        ),
+        sd.measure_draft_utility(
+            make_mgr(dcfg, dparams), prompts,
+            max_new_tokens=args.max_new_tokens, name="layer_skip",
+        ),
+        sd.measure_draft_utility(
+            make_mgr(spec=SpecConfig(2, 4, adaptive=True,
+                                     draft="early_exit", draft_layers=k)),
+            prompts, max_new_tokens=args.max_new_tokens, name="early_exit",
+        ),
+    ]
+    print(f"{'draft':<12} {'accept':>8} {'GF/tok':>10} {'accept/GF':>12}")
+    for e in sd.rank_drafts(evals):
+        print(f"{e.name:<12} {e.accept_rate:>8.3f} "
+              f"{e.draft_gflops_per_token:>10.6f} "
+              f"{e.accept_rate_per_gflop:>12.2f}")
+    best = sd.rank_drafts(evals)[0]
+    print(f"best draft: {best.name} "
+          f"(feed measured_accept_rate={best.accept_rate:.3f} to the "
+          f"serving cost model)")
+
+    if args.out:
+        sd.save_distilled_draft(args.out, scfg, sparams)
+        print(f"distilled draft checkpoint -> {args.out} "
+              f"(load as an SSM spec)")
+
+
 def cmd_bench(args):
     _load_repo_module("bench.py", "bench").main()
 
@@ -598,6 +721,38 @@ def main(argv=None):
     ss.add_argument("--top-k", type=int, default=8,
                     help="leaderboard rows to print")
     ss.set_defaults(fn=cmd_serve_search)
+
+    sdp = sub.add_parser(
+        "spec-distill",
+        help="distill a draft from target logits; rank distilled vs "
+             "layer-skip vs early-exit by accept-rate-per-draft-GFLOP",
+    )
+    sdp.add_argument("--model-dir", default=None,
+                     help="teacher HF checkpoint dir (default: tiny "
+                          "random model)")
+    sdp.add_argument("--trace-file", default=None,
+                     help="JSON list of token-id lists to replay offline "
+                          "(default: harvest live verify rounds)")
+    sdp.add_argument("--out", default=None,
+                     help="save the distilled draft checkpoint here")
+    sdp.add_argument("--hidden", type=int, default=64)
+    sdp.add_argument("--layers", type=int, default=2)
+    sdp.add_argument("--heads", type=int, default=4)
+    sdp.add_argument("--steps", type=int, default=200)
+    sdp.add_argument("--lr", type=float, default=1e-3)
+    sdp.add_argument(
+        "--temperature", type=float, default=0.25,
+        help="distillation temperature: softmax(teacher_logits / T) "
+        "targets; < 1 sharpens toward the teacher argmax (what a "
+        "greedy verify ladder accepts on)",
+    )
+    sdp.add_argument("--seq-len", type=int, default=64)
+    sdp.add_argument("--batch-size", type=int, default=8)
+    sdp.add_argument("--num-prompts", type=int, default=16)
+    sdp.add_argument("--max-new-tokens", type=int, default=24)
+    sdp.add_argument("--max-sequence-length", type=int, default=256)
+    sdp.add_argument("--seed", type=int, default=0)
+    sdp.set_defaults(fn=cmd_spec_distill)
 
     b = sub.add_parser("bench", help="headline benchmark (one JSON line)")
     b.set_defaults(fn=cmd_bench)

@@ -204,6 +204,17 @@ HOT_ROOTS = {
     "_sweep_completions",
     "drain_completion_window",
     "rate_snapshot",
+    # draft distillation (serve/spec_distill.py): the harvest path
+    # fetches teacher logits by design — an offline/side-channel tool,
+    # but it lives in serve/ and attaches a sink the verify round
+    # calls, so every blocking fetch it can reach must be a reviewed
+    # suppression, not a silent sync the sink smuggles onto the hot
+    # path. measure_draft_utility drives the live verify ladder; the
+    # skip arm's incremental decode rides the existing ``step`` root.
+    "harvest_online",
+    "harvest_offline",
+    "train_distilled_draft",
+    "measure_draft_utility",
 }
 
 # Calls that force a synchronous transfer / device round-trip.

@@ -118,6 +118,12 @@ class SchedulerStats:
     spec_drafted: int = 0
     spec_accepted: int = 0
     spec_resizes: int = 0
+    # Acceptance-weighted verify-skip (SpecConfig.verify_skip):
+    # request-rounds that skipped the speculate+verify dispatches and
+    # rode the incremental decode path (cold draft), and the periodic
+    # smallest-rung re-probe rounds that re-measured the draft.
+    verify_skipped_rounds: int = 0
+    spec_reprobes: int = 0
     # Context-parallel long-context serving (ServingConfig.kv_shard=
     # "context", serve/paging.py + serve/kernels.py): the shard degree
     # one request's KV pages stripe over (0 = CP off), ring hops a
@@ -273,6 +279,8 @@ class SchedulerStats:
             "spec_drafted": self.spec_drafted,
             "spec_accepted": self.spec_accepted,
             "spec_resizes": self.spec_resizes,
+            "verify_skipped_rounds": self.verify_skipped_rounds,
+            "spec_reprobes": self.spec_reprobes,
             "spec_accept_rate": round(self.spec_accept_rate, 4),
             "cp_shards": self.cp_shards,
             "ring_steps": self.ring_steps,
@@ -302,6 +310,8 @@ class SchedulerStats:
             f"host_toks={s['host_hit_tokens']} host_B={s['host_bytes']} "
             f"spec={s['spec_accepted']}/{s['spec_drafted']}"
             f"@{s['spec_rounds']}r resize={s['spec_resizes']} "
+            f"vskip={s['verify_skipped_rounds']} "
+            f"reprobe={s['spec_reprobes']} "
             f"cp={s['cp_shards']} ring={s['ring_steps']} "
             f"bal={s['shard_balance']:.2f} "
             f"dstep_ms={s['decode_step_ms_p50']:.2f}/"

@@ -1244,6 +1244,19 @@ def _whole_head_fn(cfg: LLaMAConfig, head, x, logits_idx):
     return jnp.matmul(x, hm, preferred_element_type=jnp.float32)[:, 0]
 
 
+def _whole_head_all_fn(cfg: LLaMAConfig, head, x, logits_idx):
+    """ALL-positions epilogue twin — op-for-op
+    :func:`serve_step_paged`'s ``all_logits=True`` tail (final norm →
+    LM head over every chunk column, no row select). The spec
+    draft/verify fold dispatches the whole-step walk with this head:
+    the verifier needs logits at every tree node, the draft pass at
+    every frontier column."""
+    del logits_idx
+    x = _rms(x, head["final_norm"], cfg.rms_norm_eps)
+    hm = head["embed"].T if cfg.tie_word_embeddings else head["lm_head"]
+    return jnp.matmul(x, hm, preferred_element_type=jnp.float32)
+
+
 def whole_step_tile_roles(
     cfg: LLaMAConfig,
 ) -> Dict[str, Tuple[str, Optional[str]]]:
@@ -1325,6 +1338,10 @@ def serve_step_whole(
     tp_mesh=None,
     collective: str = "exact",
     tiles: int = 1,
+    mask: Optional[jnp.ndarray] = None,       # (R, C, cache_len+1) bool
+    cache_positions: Optional[jnp.ndarray] = None,  # (R, C) cache lines
+    all_logits: bool = False,
+    num_layers: Optional[int] = None,
 ):
     """The WHOLE serving step as one program (ROADMAP 5a/5b,
     MPK-style): embedding, all L layers (QKV → RoPE + KV page commit →
@@ -1349,13 +1366,34 @@ def serve_step_whole(
     non-scratch pool bytes are identical to
     :func:`serve_step_paged`(kernels="xla") on the same backend (exact
     collective mode; "int8" is a documented-tolerance trade) — at any
-    tile count, because tiles split only matmul OUTPUT columns."""
+    tile count, because tiles split only matmul OUTPUT columns.
+
+    The SPECULATION FOLD rides the same four optional kwargs
+    :func:`serve_step_paged` grew for it: an explicit tree ``mask``,
+    ``cache_positions`` for slack-line K/V placement, ``all_logits``
+    (logits at every chunk column — the all-positions head twin
+    :func:`_whole_head_all_fn`) and ``num_layers`` (the early-exit
+    draft walks only the first k grid steps; deeper pool rows pass
+    through untouched for the verify pass to own). With them the
+    draft pass and the verify pass of one SpecInfer round become two
+    dispatches of this ONE persistent program — same streamed weight
+    blocks, bitwise the unfused spec round. Not composed with
+    sub-block streaming (``tiles > 1``) or the TP walk."""
     R, C = tokens.shape
     ps = cache["k"].shape[2]
+    spec_fold = all_logits or num_layers is not None
+    if spec_fold and tiles > 1:
+        raise ValueError(
+            "the whole-step speculation fold (all_logits/num_layers) is "
+            "not composed with sub-block streaming (tiles > 1) — the "
+            "tiled walk's epilogue emits the single decode logits row"
+        )
+    if cache_positions is None:
+        cache_positions = positions
     x = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
     cos, sin = rope_freqs(cfg, positions)
-    mask = _paged_mask(None, positions, page_table, ps, cache_len)
-    phys, off = _page_lookup(page_table, positions, ps)
+    mask = _paged_mask(mask, positions, page_table, ps, cache_len)
+    phys, off = _page_lookup(page_table, cache_positions, ps)
     qmax = None
     if kv_quant is not None:
         from ..serve.kv_quant import resolve_spec
@@ -1370,6 +1408,12 @@ def serve_step_whole(
                 "composed with the TP walk — the collective-explicit "
                 "path is per-layer XLA, not one kernel"
             )
+        if spec_fold:
+            raise ValueError(
+                "the whole-step speculation fold (all_logits/num_layers) "
+                "is not composed with the TP walk — the engine routes "
+                "TP spec rounds through the unfused paged step"
+            )
         return _serve_step_whole_tp(
             params, cache, x, cos, sin, mask, phys, off, page_table,
             logits_idx, cfg=cfg, qmax=qmax, mesh=tp_mesh,
@@ -1378,20 +1422,43 @@ def serve_step_whole(
     layer_arrays, head_arrays = whole_step_weight_layout(params, cfg)
     from ..serve import kernels as _pk
 
+    n = cfg.num_hidden_layers
+    if num_layers is not None:
+        n = min(num_layers, n)
+    sliced = n < cfg.num_hidden_layers
+    walk_cache = cache
+    if sliced:
+        # early-exit draft fold: the grid walks only the first n layers
+        # — slice the weight streams AND the pool rows (the walk derives
+        # L from the pool), then hand the deeper rows back untouched
+        # below, exactly serve_step_paged's num_layers contract
+        layer_arrays = {k: a[:n] for k, a in layer_arrays.items()}
+        walk_cache = {k: a[:n] for k, a in cache.items()}
+
     def block_fn(p_l, xv, cs, sn, mk, kb, vb, ks, vs, ph, of, pt):
         return _block_paged_xla(
             cfg, p_l, xv, cs, sn, mk, kb, vb, ph, of, pt, ks, vs, qmax
         )
 
-    def head_fn(head, xv, li):
-        return _whole_head_fn(cfg, head, xv, li)
+    if all_logits:
+        def head_fn(head, xv, li):
+            return _whole_head_all_fn(cfg, head, xv, li)
+    else:
+        def head_fn(head, xv, li):
+            return _whole_head_fn(cfg, head, xv, li)
 
     plan = _whole_tile_plan(cfg, qmax) if tiles > 1 else None
-    return _pk.whole_step_decode(
-        layer_arrays, head_arrays, x, cos, sin, cache, page_table,
+    logits, toks, new_cache = _pk.whole_step_decode(
+        layer_arrays, head_arrays, x, cos, sin, walk_cache, page_table,
         phys, off, mask, logits_idx.astype(jnp.int32),
         block_fn=block_fn, head_fn=head_fn, tiles=tiles, tile_plan=plan,
     )
+    if sliced:
+        new_cache = {
+            k: jnp.concatenate([new_cache[k], cache[k][n:]], axis=0)
+            for k in new_cache
+        }
+    return logits, toks, new_cache
 
 
 def _serve_step_whole_tp(params, cache, x, cos, sin, mask, phys, off,

@@ -1421,6 +1421,21 @@ def _whole_head_fn(cfg: DecoderConfig, head, x, logits_idx):
     return logits[:, 0]
 
 
+def _whole_head_all_fn(cfg: DecoderConfig, head, x, logits_idx):
+    """ALL-positions epilogue twin — op-for-op
+    :func:`serve_step_paged`'s ``all_logits=True`` tail (final norm →
+    LM head over every chunk column). The spec draft/verify fold's
+    head (see the llama twin)."""
+    del logits_idx
+    x = _norm(cfg, x, head["final_norm_scale"],
+              head.get("final_norm_bias"))
+    hm = head["embed"].T if cfg.tie_word_embeddings else head["lm_head"]
+    logits = jnp.matmul(x, hm, preferred_element_type=jnp.float32)
+    if "lm_head_bias" in head:
+        logits = logits + head["lm_head_bias"].astype(jnp.float32)
+    return logits
+
+
 def whole_step_tile_roles(
     cfg: DecoderConfig,
 ) -> Dict[str, Tuple[str, Optional[str]]]:
@@ -1517,6 +1532,10 @@ def serve_step_whole(
     tp_mesh=None,
     collective: str = "exact",
     tiles: int = 1,
+    mask: Optional[jnp.ndarray] = None,       # (R, C, cache_len+1) bool
+    cache_positions: Optional[jnp.ndarray] = None,  # (R, C) cache lines
+    all_logits: bool = False,
+    num_layers: Optional[int] = None,
 ):
     """The WHOLE serving step as one program — the generic-decoder twin
     of models/llama.serve_step_whole (same contract: returns
@@ -1525,17 +1544,31 @@ def serve_step_whole(
     collective). ``C == 1`` is the decode step, ``C > 1`` the
     whole-step mixed step; ``tiles > 1`` streams each projection
     weight in output-column sub-tiles (the engine's VMEM gate picks
-    the count — see the llama twin)."""
+    the count — see the llama twin). The SPECULATION FOLD kwargs
+    (explicit tree ``mask``, slack-line ``cache_positions``,
+    ``all_logits``, early-exit ``num_layers``) turn one SpecInfer
+    round's draft and verify passes into two dispatches of this one
+    persistent program — see the llama twin; not composed with
+    ``tiles > 1`` or the TP walk."""
     from ..serve.kernels import paged_serve_mask
 
     R, C = tokens.shape
     ps = cache["k"].shape[2]
+    spec_fold = all_logits or num_layers is not None
+    if spec_fold and tiles > 1:
+        raise ValueError(
+            "the whole-step speculation fold (all_logits/num_layers) is "
+            "not composed with sub-block streaming (tiles > 1) — the "
+            "tiled walk's epilogue emits the single decode logits row"
+        )
+    if cache_positions is None:
+        cache_positions = positions
     x = _embed_in(cfg, params, tokens, positions)
     rope = rope_freqs(cfg, positions) if cfg.positions == "rope" else None
     mask = paged_serve_mask(
-        None, positions, page_table.shape[1], ps, cache_len
+        mask, positions, page_table.shape[1], ps, cache_len
     )
-    phys, off = _page_lookup(page_table, positions, ps)
+    phys, off = _page_lookup(page_table, cache_positions, ps)
     qmax = None
     if kv_quant is not None:
         from ..serve.kv_quant import resolve_spec
@@ -1550,6 +1583,12 @@ def serve_step_whole(
                 "composed with the TP walk — the collective-explicit "
                 "path is per-layer XLA, not one kernel"
             )
+        if spec_fold:
+            raise ValueError(
+                "the whole-step speculation fold (all_logits/num_layers) "
+                "is not composed with the TP walk — the engine routes "
+                "TP spec rounds through the unfused paged step"
+            )
         return _serve_step_whole_tp(
             params, cache, x, rope, mask, phys, off, page_table,
             logits_idx, cfg=cfg, qmax=qmax, mesh=tp_mesh,
@@ -1560,21 +1599,43 @@ def serve_step_whole(
 
     cos, sin = rope if rope is not None else (None, None)
 
+    n = cfg.num_hidden_layers
+    if num_layers is not None:
+        n = min(num_layers, n)
+    sliced = n < cfg.num_hidden_layers
+    walk_cache = cache
+    if sliced:
+        # early-exit draft fold: walk only the first n layers; deeper
+        # pool rows are handed back untouched below (serve_step_paged's
+        # num_layers contract)
+        layer_arrays = {k: a[:n] for k, a in layer_arrays.items()}
+        walk_cache = {k: a[:n] for k, a in cache.items()}
+
     def block_fn(p_l, xv, cs, sn, mk, kb, vb, ks, vs, ph, of, pt):
         rp = (cs, sn) if cs is not None else None
         return _block_paged_xla(
             cfg, p_l, xv, rp, None, mk, kb, vb, ph, of, pt, ks, vs, qmax
         )
 
-    def head_fn(head, xv, li):
-        return _whole_head_fn(cfg, head, xv, li)
+    if all_logits:
+        def head_fn(head, xv, li):
+            return _whole_head_all_fn(cfg, head, xv, li)
+    else:
+        def head_fn(head, xv, li):
+            return _whole_head_fn(cfg, head, xv, li)
 
     plan = _whole_tile_plan(cfg, qmax) if tiles > 1 else None
-    return _pk.whole_step_decode(
-        layer_arrays, head_arrays, x, cos, sin, cache, page_table,
+    logits, toks, new_cache = _pk.whole_step_decode(
+        layer_arrays, head_arrays, x, cos, sin, walk_cache, page_table,
         phys, off, mask, logits_idx.astype(jnp.int32),
         block_fn=block_fn, head_fn=head_fn, tiles=tiles, tile_plan=plan,
     )
+    if sliced:
+        new_cache = {
+            k: jnp.concatenate([new_cache[k], cache[k][n:]], axis=0)
+            for k in new_cache
+        }
+    return logits, toks, new_cache
 
 
 def _serve_step_whole_tp(params, cache, x, rope, mask, phys, off,

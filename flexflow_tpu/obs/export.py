@@ -109,6 +109,7 @@ SCHED_COUNTERS = frozenset({
     "prefix_evictions", "prefix_cows",
     "spills", "readmits", "host_hit_tokens",
     "spec_rounds", "spec_drafted", "spec_accepted", "spec_resizes",
+    "verify_skipped_rounds", "spec_reprobes",
     "ring_steps", "compiles", "retraces", "whole_step_fallbacks",
 })
 #: SchedulerStats fields exported verbatim as gauges.
@@ -186,6 +187,7 @@ PROFILE_EXCLUDED = {
     "first_token_time": "flexflow_request_ttft_seconds_sum",
     "tree_width": "per-request shape, no meaningful sum",
     "tree_depth": "per-request shape, no meaningful sum",
+    "draft_flops_per_token": "per-request draft pricing, no meaningful sum",
     "context_shards": "per-request layout fact, no meaningful sum",
     "replica_id": "per-request placement fact, no meaningful sum",
     "failover_replica_id": "per-request placement fact, no meaningful sum",

@@ -99,6 +99,11 @@ class TrafficProfile:
     #: accepted drafted tokens per drafted token (0 = no speculation
     #: signal; the spec pricing treats it as the per-level acceptance)
     spec_accept_rate: float = 0.0
+    #: MEASURED drafted-accept rate from a live verify ladder
+    #: (SchedulerStats.spec_accept_rate, or the spec_distill eval
+    #: harness) — when set, the speculation term prices with this
+    #: instead of the ``spec_accept_rate`` prior. None = no measurement.
+    measured_accept_rate: Optional[float] = None
 
     @property
     def prompt_len_mean(self) -> float:
@@ -334,7 +339,12 @@ class ServingCostModel:
         the verifier's own bonus token."""
         if not cand.speculation:
             return 1.0, 1.0
-        a = min(max(traffic.spec_accept_rate, 0.0), 0.99)
+        rate = traffic.spec_accept_rate
+        if traffic.measured_accept_rate is not None:
+            # measured verify-ladder acceptance beats the workload prior
+            # (serve/spec_distill.py eval harness feeds this)
+            rate = traffic.measured_accept_rate
+        a = min(max(rate, 0.0), 0.99)
         d = max(1, cand.spec_depth)
         accepted = a * (1.0 - a ** d) / (1.0 - a) if a > 0 else 0.0
         tree = 1.0 + cand.spec_width * cand.spec_depth

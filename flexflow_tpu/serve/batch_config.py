@@ -147,6 +147,11 @@ class ProfileInfo:
     tree_resizes: int = 0
     tree_width: int = 0
     tree_depth: int = 0
+    # Draft pricing (serve/spec_distill.py accept-rate-per-draft-FLOP):
+    # dense FLOPs one drafted token cost in the draft stack that served
+    # this request — the cost model's 2×params forward pricing, summed
+    # over the SSMs (0.0 outside speculation).
+    draft_flops_per_token: float = 0.0
     # Context-parallel long-context serving (ServingConfig.kv_shard=
     # "context"): how many sequence shards this request's KV pages
     # striped over (1 = the single-pool layout).

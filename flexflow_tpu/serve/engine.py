@@ -381,17 +381,22 @@ class ServingConfig:
         how ``kv_quant``/``fused_decode`` fail at construction rather
         than mid-serve. ``specinfer=True`` (LLM.compile with ssms)
         additionally rejects SpecInfer × DISAGGREGATED pools — the
-        page-migration hand-off does not carry draft caches; plain
-        replicated clusters compose (per-replica SSM mirror engines,
+        prefill→decode migration itself (including its RPC wire
+        transport) is built; what it does not carry yet is the SSM
+        mirror engines' draft caches. Plain replicated clusters
+        compose (per-replica SSM mirror engines,
         serve/cluster/replica.py)."""
         if specinfer and self.prefill_replicas:
             raise ValueError(
                 "disaggregated prefill/decode pools are not composed "
-                "with SpecInfer ssms — the draft and verifier caches "
-                "advance together, which the prefill→decode page "
-                "migration hand-off does not carry; use replicas > 1 "
-                "WITHOUT prefill_replicas/decode_replicas (each replica "
-                "then runs its own SSM mirrors, serve/cluster/replica.py)"
+                "with SpecInfer ssms — the prefill→decode migration "
+                "hand-off (built, including the multiplexed RPC wire "
+                "transport, serve/cluster/remote.py) ships only the "
+                "TARGET engine's pages; the remaining gap is shipping "
+                "the draft mirrors' caches in the same hand-off. Use "
+                "replicas > 1 WITHOUT prefill_replicas/decode_replicas "
+                "(each replica then runs its own SSM mirrors, "
+                "serve/cluster/replica.py)"
             )
         if self.replicas < 1:
             raise ValueError(
@@ -1378,6 +1383,53 @@ class InferenceEngine:
             tiles=tiles,
         )
 
+    @property
+    def whole_step_spec_on(self) -> bool:
+        """Whether SpecInfer rounds fold into the whole-step walk: the
+        draft pass (early-exit ``num_layers`` slice) and the verify
+        pass (tree mask + slack-line ``cache_positions`` +
+        ``all_logits``) dispatch as two programs of the ONE persistent
+        layer walk instead of the per-layer unfused step. Requires the
+        untiled single-shard walk — sub-block streaming, context-ring
+        and TP meshes keep the unfused spec programs (the fold's
+        all-positions epilogue and layer slicing are not composed with
+        those walks)."""
+        from ..core.mesh import MODEL_AXIS
+
+        return (
+            self.whole_step_on
+            and self.whole_step_tiles == 1
+            and not self.cp_ring
+            and self.mesh.shape.get(MODEL_AXIS, 1) == 1
+        )
+
+    def _get_tree_whole_step(self, chunk: int):
+        """The VERIFY half of the speculation fold
+        (:attr:`whole_step_spec_on`): the whole-step walk dispatched
+        with the verify round's tree mask, slack-line cache positions
+        and the all-positions head twin — same signature as the paged
+        :meth:`_get_step`, so :meth:`run` routes verify dispatches here
+        transparently. One program per chunk (the spec manager's
+        padded tree width), bitwise the unfused verify step because
+        the walk runs the same ``_block_paged_xla`` body."""
+        key_id = ("whole_step_tree", chunk)
+        if key_id not in self._steps:
+            wfn = self._serve_whole_fn(1)
+
+            def step(params, cache, tokens, positions, logits_idx,
+                     mask, cpos, page_table):
+                logits, _gtoks, cache = wfn(
+                    params, cache, tokens, positions, logits_idx,
+                    page_table, mask=mask, cache_positions=cpos,
+                    all_logits=True,
+                )
+                return logits, cache
+
+            self._steps[key_id] = self._jit(
+                step, key=key_id, donate_argnums=(1,)
+            )
+        return self._steps[key_id]
+
     def _get_whole_step(self, with_logits: bool, sample_mode: str,
                         topk_cap: int, chunk: int = 1):
         """The whole-step program (fused_decode=("whole_step",)):
@@ -1686,13 +1738,35 @@ class InferenceEngine:
         SpecConfig.bucket_ladder), so the key set stays bounded by the
         ladder, never free-form. ``num_layers`` is the self-speculation
         early-exit draft: the frontier expands through a layer-sliced
-        step over THIS engine's own params + cache."""
+        step over THIS engine's own params + cache.
+
+        With :attr:`whole_step_spec_on` the per-depth expansion runs
+        the whole-step walk (early-exit slice + all-positions head +
+        tree mask + slack lines) — the DRAFT half of the speculation
+        fold: the draft becomes the first ``num_layers`` grid steps of
+        the same persistent program the verify pass dispatches, bitwise
+        the unfused spec round (shared ``_block_paged_xla`` body)."""
         key_id = ("speculate", W, D)
         if num_layers is not None:
             key_id = key_id + (int(num_layers),)
+        whole = self.whole_step_spec_on
+        if whole:
+            key_id = key_id + ("whole_step",)
         if key_id not in self._steps:
-            fn = self._serve_step_fn(all_logits=True,
-                                     num_layers=num_layers)
+            if whole:
+                wfn = self._serve_whole_fn(1)
+
+                def fn(params, cache, tokens, positions, logits_idx,
+                       mask, cpos, page_table):
+                    logits, _gtoks, cache = wfn(
+                        params, cache, tokens, positions, logits_idx,
+                        page_table, mask=mask, cache_positions=cpos,
+                        all_logits=True, num_layers=num_layers,
+                    )
+                    return logits, cache
+            else:
+                fn = self._serve_step_fn(all_logits=True,
+                                         num_layers=num_layers)
             from .sampling import log_softmax
 
             R = self.num_slots
@@ -1874,11 +1948,24 @@ class InferenceEngine:
             args = args + (self.page_table_device(),)
         donated = self.cache
         self.count_dispatch("step")
+        # the speculation fold's verify half: tree-masked all-logits
+        # dispatches ride the whole-step walk when the engine runs it
+        # (whole_step_spec_on) — same signature, one persistent program
+        fold_verify = (
+            all_logits and bc.mask is not None
+            and bc.cache_positions is not None and self.whole_step_spec_on
+        )
         with _set_mesh(self.mesh):
-            step = self._get_step(bc.chunk, all_logits, bc.mask is not None)
+            step = (
+                self._get_tree_whole_step(bc.chunk) if fold_verify
+                else self._get_step(bc.chunk, all_logits,
+                                    bc.mask is not None)
+            )
             logits, self.cache = step(self.params, self.cache, *args)
         self._poison_donated(
-            donated, (bc.chunk, all_logits, bc.mask is not None)
+            donated,
+            ("whole_step_tree", bc.chunk) if fold_verify
+            else (bc.chunk, all_logits, bc.mask is not None),
         )
         return logits
 

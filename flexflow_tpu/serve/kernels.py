@@ -1498,19 +1498,23 @@ def whole_step_decode(
     in_specs += const_specs
     operands += const_ops
 
-    # epilogue output shapes: probe the head on abstract values
+    # epilogue output shapes: probe the head on abstract values. The
+    # full shape (not just V) so head twins with different ranks
+    # compose — the decode head returns (R, V), the all-positions head
+    # the spec verify fold dispatches returns (R, C, V); the argmax
+    # epilogue below is rank-agnostic either way.
     head_abs = {n: head_arrays[n] for n in head_names}
-    V = jax.eval_shape(
+    head_shape = jax.eval_shape(
         lambda h, x, li: head_fn(h, x, li),
         head_abs, jnp.zeros((R, C, D), x0.dtype),
         logits_idx.astype(jnp.int32),
-    ).shape[-1]
+    ).shape
 
     out_shapes = [
-        jax.ShapeDtypeStruct((R, V), jnp.float32),       # logits
-        jax.ShapeDtypeStruct((R,), jnp.int32),           # greedy tokens
+        jax.ShapeDtypeStruct(head_shape, jnp.float32),      # logits
+        jax.ShapeDtypeStruct(head_shape[:-1], jnp.int32),   # greedy tokens
     ]
-    out_specs = [_const((R, V)), _const((R,))]
+    out_specs = [_const(head_shape), _const(head_shape[:-1])]
     aliases = {}
     for j, name in enumerate(pool_names):
         a = cache[name]

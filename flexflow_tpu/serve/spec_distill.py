@@ -1,0 +1,387 @@
+"""Draft distillation + accept-rate-per-draft-FLOP pricing.
+
+The SpecInfer concept (PAPER.md) assumes the SSM draft is *distilled*
+from the target — layer-skip and early-exit drafts are cheap stand-ins.
+This module closes that loop on served traffic:
+
+1. **Harvest** (prompt, target-logits) pairs from the engine's verify
+   rounds — `SpecInferManager.logit_sink` hands every verify dispatch's
+   full teacher logits along the accepted path to an attached
+   :class:`HarvestBuffer` — or replay a token trace offline through the
+   teacher's training ``forward``.
+2. **Train** a narrow/shallow decoder on the harvested pairs with a
+   KL-to-target loss, reusing the existing training stack
+   (``models/*.forward`` + ``losses.categorical_crossentropy`` +
+   ``optimizers.AdamOptimizer``) in ONE jitted fixed-shape step.
+3. **Emit** a checkpoint (``checkpoint.save_params`` + a geometry json)
+   loadable as an SSM spec for ``LLM.compile(ssms=[...])``.
+4. **Price** drafts by measured utility: drafted accept rate from a
+   live verify ladder divided by the draft's per-token GFLOPs from the
+   cost model's 2·params pricing — so distilled vs layer-skip vs
+   early-exit is a *measurement*, not a vibe. The measured acceptance
+   also feeds ``autotune.cost_model.TrafficProfile.measured_accept_rate``
+   so the serving cost model prices speculation with it instead of its
+   prior.
+
+Everything here is the OFFLINE/side-channel path: the harvest sink is
+``None`` in production serving (``specinfer.py`` fetches verify logits
+only while a sink is attached), and training never touches the serving
+step-key space.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import checkpoint
+from ..losses import categorical_crossentropy
+from ..optimizers import AdamOptimizer
+from .autotune.cost_model import ModelGeometry
+from .batch_config import GenerationConfig
+
+
+def _default_family():
+    from ..models import llama
+
+    return llama
+
+
+# ----------------------------------------------------------------------
+# harvest
+
+
+class HarvestBuffer:
+    """Accumulates (context, teacher-logits) training pairs.
+
+    ``add(tokens, logits, start)`` stores one pair per logits row:
+    row ``k`` is the teacher's next-token distribution after seeing
+    ``tokens[:start + k + 1]``. The default ``start`` lines the rows up
+    against the END of ``tokens`` — exactly the shape of the verify
+    round's accepted-path logits, so ``manager.logit_sink = buf.add``
+    harvests live traffic with no adapter.
+    """
+
+    def __init__(self, max_examples: int = 65536):
+        self.max_examples = max_examples
+        # list of (context token list, (V,) float32 teacher logits)
+        self.examples: List[Tuple[List[int], np.ndarray]] = []
+
+    def __len__(self) -> int:
+        return len(self.examples)
+
+    @property
+    def full(self) -> bool:
+        return len(self.examples) >= self.max_examples
+
+    def add(
+        self,
+        tokens: Sequence[int],
+        logits: Any,
+        start: Optional[int] = None,
+    ) -> None:
+        rows = np.asarray(logits, np.float32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if start is None:
+            start = len(tokens) - rows.shape[0]
+        for k in range(rows.shape[0]):
+            ctx = [int(t) for t in tokens[: start + k + 1]]
+            if not ctx or self.full:
+                return
+            self.examples.append((ctx, rows[k]))
+
+    def batches(
+        self, seq_len: int, batch_size: int
+    ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Fixed-shape training batches: right-truncate each context to
+        its last ``seq_len`` tokens, right-pad, and carry the index of
+        the last real token so the trainer selects the one position the
+        teacher distribution targets. The ragged tail (fewer than
+        ``batch_size`` leftovers) is dropped — every batch compiles to
+        the same shapes, so the jitted step traces exactly once."""
+        out = []
+        n = (len(self.examples) // batch_size) * batch_size
+        for i in range(0, n, batch_size):
+            chunk = self.examples[i : i + batch_size]
+            toks = np.zeros((batch_size, seq_len), np.int32)
+            idx = np.zeros((batch_size,), np.int32)
+            tgt = np.stack([row for _, row in chunk]).astype(np.float32)
+            for b, (ctx, _) in enumerate(chunk):
+                window = ctx[-seq_len:]
+                toks[b, : len(window)] = window
+                idx[b] = len(window) - 1
+            out.append((toks, idx, tgt))
+        return out
+
+
+def harvest_online(
+    manager: Any,
+    prompts: Sequence[Any],
+    *,
+    buf: Optional[HarvestBuffer] = None,
+    gen: Optional[GenerationConfig] = None,
+    max_new_tokens: Optional[int] = 32,
+) -> HarvestBuffer:
+    """Serve ``prompts`` through a :class:`SpecInferManager` with the
+    harvest sink attached: every verify round's full teacher logits
+    along the accepted path land in the buffer. The sink is detached
+    on exit, so the manager goes back to never fetching verify logits."""
+    buf = buf if buf is not None else HarvestBuffer()
+    prev = manager.logit_sink
+    manager.logit_sink = buf.add
+    try:
+        manager.generate(list(prompts), gen, max_new_tokens)
+    finally:
+        manager.logit_sink = prev
+    return buf
+
+
+def harvest_offline(
+    family: Any,
+    cfg: Any,
+    params: Dict[str, Any],
+    traces: Sequence[Any],
+    *,
+    buf: Optional[HarvestBuffer] = None,
+    max_len: Optional[int] = None,
+) -> HarvestBuffer:
+    """Replay token traces through the teacher's training ``forward``
+    and harvest every position's next-token logits. A trace is a token
+    sequence or a ``GenerationResult`` (input + output tokens). Each
+    distinct trace length traces the jitted forward once — an offline
+    tool's compile cost, never the serving step-key space."""
+    buf = buf if buf is not None else HarvestBuffer()
+    fwd = jax.jit(lambda p, t: family.forward(p, t, cfg))
+    for trace in traces:
+        if hasattr(trace, "output_tokens"):
+            toks = list(trace.input_tokens) + list(trace.output_tokens)
+        else:
+            toks = [int(t) for t in trace]
+        if max_len is not None:
+            toks = toks[:max_len]
+        if len(toks) < 2:
+            continue
+        lg = fwd(
+            params,
+            jnp.asarray(np.asarray(toks, np.int32)[None, :], dtype=jnp.int32),
+        )
+        # ffcheck: disable=FF107 -- offline trace replay (distillation harvest): blocking teacher-logit fetch is the tool's whole job; never runs on a serving path
+        rows = np.asarray(jax.device_get(lg))[0]
+        buf.add(toks, rows, start=0)
+        if buf.full:
+            break
+    return buf
+
+
+# ----------------------------------------------------------------------
+# training
+
+
+@dataclasses.dataclass
+class DistillConfig:
+    """Student geometry + training knobs. The student inherits every
+    teacher config field not named here (vocab, rope, norm eps, dtype),
+    so its checkpoint drops straight into ``LLM.compile(ssms=[...])``."""
+
+    hidden_size: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: Optional[int] = None       # None = num_heads
+    intermediate_size: Optional[int] = None  # None = 4 * hidden_size
+    seq_len: int = 64
+    batch_size: int = 8
+    steps: int = 200
+    lr: float = 1e-3
+    #: Distillation temperature for the teacher targets: the loss
+    #: matches ``softmax(teacher_logits / temperature)``. 1.0 keeps the
+    #: teacher's own distribution; below 1.0 sharpens it toward the
+    #: argmax — the right regime when the verify ladder is GREEDY
+    #: (acceptance is argmax agreement, so the student should spend its
+    #: capacity on the teacher's top choice, not the full tail).
+    temperature: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature <= 0.0:
+            raise ValueError(
+                f"temperature must be > 0 (got {self.temperature})"
+            )
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"hidden_size ({self.hidden_size}) must be divisible by "
+                f"num_heads ({self.num_heads})"
+            )
+        kv = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv:
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must be divisible by "
+                f"num_kv_heads ({kv})"
+            )
+
+
+def student_config(teacher_cfg: Any, dcfg: DistillConfig) -> Any:
+    """Narrow/shallow student config cut from the teacher's."""
+    return dataclasses.replace(
+        teacher_cfg,
+        hidden_size=dcfg.hidden_size,
+        num_hidden_layers=dcfg.num_layers,
+        num_attention_heads=dcfg.num_heads,
+        num_key_value_heads=dcfg.num_kv_heads or dcfg.num_heads,
+        intermediate_size=dcfg.intermediate_size or 4 * dcfg.hidden_size,
+    )
+
+
+def train_distilled_draft(
+    buf: HarvestBuffer,
+    teacher_cfg: Any,
+    dcfg: DistillConfig,
+    *,
+    family: Any = None,
+) -> Tuple[Any, Dict[str, Any], List[float]]:
+    """KL-distill a student draft from harvested teacher logits.
+
+    The loss is cross-entropy of the student's logits at each example's
+    last real position against ``softmax(teacher_logits / temperature)``
+    — KL to the (tempered) teacher up to the teacher-entropy constant,
+    so its argmin is the same. One jitted step over fixed shapes; with the pinned threefry
+    PRNG the whole run is bitwise deterministic per backend.
+
+    Returns ``(student_cfg, params, loss_history)``.
+    """
+    family = family or _default_family()
+    scfg = student_config(teacher_cfg, dcfg)
+    params = family.init_params(jax.random.PRNGKey(dcfg.seed), scfg)
+    opt = AdamOptimizer(lr=dcfg.lr)
+    opt_state = opt.init(params)
+
+    def _step(params, opt_state, toks, idx, tgt):
+        def loss_fn(p):
+            logits = family.forward(p, toks, scfg)       # (B, S, V)
+            sel = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1
+            )[:, 0]                                      # (B, V)
+            probs = jax.nn.softmax(
+                tgt.astype(jnp.float32) / dcfg.temperature, axis=-1
+            )
+            return categorical_crossentropy(sel, probs, from_logits=True)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = opt.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    batches = buf.batches(dcfg.seq_len, dcfg.batch_size)
+    if not batches:
+        raise ValueError(
+            f"HarvestBuffer holds {len(buf)} examples — fewer than one "
+            f"batch of {dcfg.batch_size}; harvest more traffic first"
+        )
+    history: List[float] = []
+    i = 0
+    while i < dcfg.steps:
+        for toks, idx, tgt in batches:
+            if i >= dcfg.steps:
+                break
+            params, opt_state, loss = step(params, opt_state, toks, idx, tgt)
+            # ffcheck: disable=FF107 -- training loop, not a serving path: per-step loss fetch feeds the history the eval harness reports
+            history.append(float(jax.device_get(loss)))
+            i += 1
+    return scfg, params, history
+
+
+# ----------------------------------------------------------------------
+# checkpoint emit / load
+
+_GEOMETRY_FIELDS = (
+    "hidden_size",
+    "num_hidden_layers",
+    "num_attention_heads",
+    "num_key_value_heads",
+    "intermediate_size",
+)
+
+
+def save_distilled_draft(
+    directory: str, cfg: Any, params: Dict[str, Any]
+) -> None:
+    """Emit the student as an SSM spec: orbax params + a geometry json
+    (`draft_config.json`) naming the fields that differ from whatever
+    teacher it is loaded next to."""
+    checkpoint.save_params(directory, params)
+    geom = {k: int(getattr(cfg, k)) for k in _GEOMETRY_FIELDS}
+    with open(os.path.join(directory, "draft_config.json"), "w") as f:
+        json.dump(geom, f, indent=2, sort_keys=True)
+
+
+def load_distilled_draft(
+    directory: str, teacher_cfg: Any, *, family: Any = None
+) -> Tuple[Any, Dict[str, Any]]:
+    """Rebuild (student_cfg, params) from :func:`save_distilled_draft`
+    output against a teacher config (vocab/rope/dtype inherit)."""
+    family = family or _default_family()
+    with open(os.path.join(directory, "draft_config.json")) as f:
+        geom = json.load(f)
+    cfg = dataclasses.replace(
+        teacher_cfg, **{k: int(geom[k]) for k in _GEOMETRY_FIELDS}
+    )
+    template = family.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, checkpoint.load_params(directory, template)
+
+
+# ----------------------------------------------------------------------
+# pricing: accept-rate-per-draft-FLOP
+
+
+@dataclasses.dataclass
+class DraftEval:
+    """One draft's measured utility on a verify ladder."""
+
+    name: str
+    accept_rate: float              # drafted accept rate, measured
+    draft_gflops_per_token: float   # cost-model 2·params pricing
+    accept_rate_per_gflop: float    # the figure drafts are ranked by
+    output_tokens: int = 0
+
+
+def draft_gflops_per_token(cfg: Any) -> float:
+    """Dense per-token draft GFLOPs from the cost model's 2·params
+    forward pricing — the denominator of accept-rate-per-draft-FLOP."""
+    return 2.0 * ModelGeometry.from_model_config(cfg).param_count() / 1e9
+
+
+def measure_draft_utility(
+    manager: Any,
+    prompts: Sequence[Any],
+    *,
+    gen: Optional[GenerationConfig] = None,
+    max_new_tokens: Optional[int] = 32,
+    name: str = "draft",
+) -> DraftEval:
+    """Run a verify ladder over ``prompts`` on a compiled
+    :class:`SpecInferManager` and price the draft it speculates with:
+    measured drafted-accept rate ÷ the draft stack's per-token GFLOPs
+    (``manager.draft_flops_per_token``). The returned ``accept_rate``
+    is what ``TrafficProfile.measured_accept_rate`` wants."""
+    results = manager.generate(list(prompts), gen, max_new_tokens)
+    accept = float(manager.stats.spec_accept_rate)
+    gfl = float(getattr(manager, "draft_flops_per_token", 0.0)) / 1e9
+    return DraftEval(
+        name=name,
+        accept_rate=accept,
+        draft_gflops_per_token=gfl,
+        accept_rate_per_gflop=accept / gfl if gfl > 0 else 0.0,
+        output_tokens=sum(len(r.output_tokens) for r in results),
+    )
+
+
+def rank_drafts(evals: Sequence[DraftEval]) -> List[DraftEval]:
+    """Best draft first, by measured accept-rate-per-draft-GFLOP."""
+    return sorted(
+        evals, key=lambda e: e.accept_rate_per_gflop, reverse=True
+    )

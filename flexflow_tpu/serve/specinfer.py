@@ -242,6 +242,17 @@ class SpecConfig:
     Zero extra model, zero extra cache — the verify pass re-writes
     every tree line anyway, so the shallow draft's K/V never leaks
     into committed state.
+
+    ``verify_skip`` (requires ``adaptive``) is the acceptance-weighted
+    escape hatch below the ladder's floor: a request whose controller
+    sits at the SMALLEST rung with a near-zero acceptance EMA (≤
+    ``skip_threshold`` × depth) skips the speculate+verify dispatches
+    entirely and rides the incremental decode path — a cold draft then
+    costs ~zero, so speculation is strictly never worse than
+    non-speculative continuous batching. Every ``reprobe_every``
+    skipped rounds ONE cheap smallest-rung round runs to re-measure
+    the draft; an accepting re-probe warms the EMA back over the
+    threshold and the request resumes speculating.
     """
 
     beam_width: int = 2
@@ -260,6 +271,11 @@ class SpecConfig:
     # draft source: "ssm" | "early_exit"
     draft: str = "ssm"
     draft_layers: int = 0
+    # acceptance-weighted verify-skip (cold drafts ride the
+    # incremental decode path; periodic re-probe at the smallest rung)
+    verify_skip: bool = False
+    skip_threshold: float = 0.1
+    reprobe_every: int = 8
 
     def __post_init__(self):
         if self.beam_width < 1 or self.beam_depth < 1:
@@ -291,6 +307,27 @@ class SpecConfig:
             raise ValueError(
                 f"width_threshold must be in [0, 1] (got "
                 f"{self.width_threshold})"
+            )
+        if self.verify_skip and not self.adaptive:
+            raise ValueError(
+                "verify_skip requires adaptive=True — the skip decision "
+                "reads the TreeController's rung and acceptance EMA"
+            )
+        if not 0.0 <= self.skip_threshold < 1.0:
+            raise ValueError(
+                f"skip_threshold must be in [0, 1) (got "
+                f"{self.skip_threshold})"
+            )
+        if self.skip_threshold > self.shrink_threshold:
+            raise ValueError(
+                "skip_threshold must not exceed shrink_threshold — the "
+                "skip regime sits BELOW the ladder's floor (got "
+                f"skip={self.skip_threshold} > "
+                f"shrink={self.shrink_threshold})"
+            )
+        if self.reprobe_every < 1:
+            raise ValueError(
+                f"reprobe_every must be >= 1 (got {self.reprobe_every})"
             )
         if self.buckets is not None:
             ladder = tuple(
@@ -380,10 +417,46 @@ class TreeController:
         ) * depth
         self.width_ema = 1.0                        # width presumed useful
         self.resizes = 0
+        # acceptance-weighted verify-skip (SpecConfig.verify_skip):
+        # rounds skipped since the last spec/re-probe round, plus
+        # lifetime counters the manager mirrors into SchedulerStats
+        self._skip_streak = 0
+        self.skipped_rounds = 0
+        self.reprobes = 0
 
     @property
     def bucket(self) -> Tuple[int, int]:
         return self.ladder[self.idx]
+
+    def next_action(self) -> str:
+        """One verify-skip state-machine transition — call exactly once
+        per scheduling round for a DECODING request. Returns ``"spec"``
+        (run a normal speculate+verify round), ``"skip"`` (ride the
+        incremental decode path: the draft is cold and a tree would be
+        pure overhead) or ``"reprobe"`` (the skip cadence came due —
+        run the cheap smallest-rung round so a draft that warmed back
+        up can exit the skip regime through :meth:`observe`).
+
+        The skip regime engages only BELOW the ladder's floor: the
+        controller must sit on rung 0 — (1, 1) on the default ladder —
+        with its acceptance EMA at or under ``skip_threshold`` × depth.
+        Any other state resets the streak, so a request that resizes
+        upward or warms its EMA flows straight back to "spec"."""
+        spec = self.spec
+        if not spec.verify_skip or self.idx != 0:
+            self._skip_streak = 0
+            return "spec"
+        _, depth = self.bucket
+        if self.ema > spec.skip_threshold * depth:
+            self._skip_streak = 0
+            return "spec"
+        if self._skip_streak >= spec.reprobe_every:
+            self._skip_streak = 0
+            self.reprobes += 1
+            return "reprobe"
+        self._skip_streak += 1
+        self.skipped_rounds += 1
+        return "skip"
 
     def observe(self, accepted_len: int, used_width: bool = False) -> bool:
         """Record one round's accepted path length (and whether tree
@@ -503,6 +576,23 @@ class SpecInferManager(RequestManager):
         ), "LLM and SSM engines must agree on kv_layout"
         # per-request adaptive tree controllers (SpecConfig.adaptive)
         self._controllers: Dict[int, TreeController] = {}
+        # verify-skip SSM cache debt: cache lines ending at n_cached
+        # that the skipped rounds advanced on the LLM ONLY (a skipped
+        # round must cost one engine step, not one per engine). Repaid
+        # through _sync_ssm_caches before anything next touches the
+        # mirrors (re-probe/spec round, mixed-phase mirror dispatch,
+        # completion-time prefix publish).
+        self._ssm_lag: Dict[int, int] = {}
+        # Draft pricing (autotune cost model, 2 × params per token):
+        # the denominator of spec_distill's accept-rate-per-draft-FLOP
+        # utility; stamped into ProfileInfo.draft_flops_per_token.
+        self.draft_flops_per_token = self._price_draft_flops()
+        # Distillation harvest hook (serve/spec_distill.py): when set,
+        # every verify round hands the sink (context tokens, teacher
+        # logits over the accepted path) pairs. The full-logit fetch is
+        # a reviewed blocking site, taken only with a sink attached —
+        # production serving keeps this None.
+        self.logit_sink: Optional[Any] = None
         # Prefix caching: one radix tree per SSM pool, kept in lockstep
         # with the LLM's through the _cache_attach/_cache_insert hooks
         # (insert publishes the same blocks everywhere; attach aligns
@@ -522,6 +612,26 @@ class SpecInferManager(RequestManager):
                 )
                 ssm_engine.pager.reclaim_cb = pc.reclaim
                 self.ssm_prefix_caches.append(pc)
+
+    def _price_draft_flops(self) -> float:
+        """Dense FLOPs one drafted token costs in the draft stack —
+        the serving cost model's forward-pass pricing (2 × params),
+        summed over every SSM (a multi-draft round drafts once per
+        SSM). The early-exit self-draft prices the target's first
+        ``draft_layers`` blocks. This is the denominator of the
+        accept-rate-per-draft-FLOP utility (serve/spec_distill.py)."""
+        from .autotune.cost_model import ModelGeometry
+
+        if self.spec.draft == "early_exit":
+            cfg = dataclasses.replace(
+                self.engine.cfg,
+                num_hidden_layers=self.spec.draft_layers,
+            )
+            return 2.0 * ModelGeometry.from_model_config(cfg).param_count()
+        return sum(
+            2.0 * ModelGeometry.from_model_config(s.cfg).param_count()
+            for s in self.ssms
+        )
 
     @property
     def n_drafts(self) -> int:
@@ -743,6 +853,10 @@ class SpecInferManager(RequestManager):
         logits = self.engine.run(bc, all_logits=True)  # (R, C, V)
         # ffcheck: disable=FF107 -- tree verify: the host acceptance walk needs the greedy tokens; one transfer per round by design
         greedy = np.asarray(jax.device_get(_greedy(logits)))  # (R, C)
+        full_logits = None
+        if self.logit_sink is not None:
+            # ffcheck: disable=FF107 -- distillation harvest (serve/spec_distill.py): the attached sink needs the verify round's full teacher logits; one reviewed extra transfer per round, never taken in production serving (logit_sink stays None)
+            full_logits = np.asarray(jax.device_get(logits))
         accepted: Dict[int, Tuple[int, List[int]]] = {}  # rid -> (slot, path tokens)
 
         R = self.engine.num_slots
@@ -790,6 +904,16 @@ class SpecInferManager(RequestManager):
                 req.profile.tree_width, req.profile.tree_depth = ctrl.bucket
             else:
                 req.profile.tree_width, req.profile.tree_depth = W, D
+            req.profile.draft_flops_per_token = self.draft_flops_per_token
+            if full_logits is not None:
+                # teacher rows for the accepted path: row k is the
+                # verifier's next-token distribution after consuming
+                # context tokens[:prefix+1+k] — exactly the on-policy
+                # (prompt, target-logits) pairs distillation trains on
+                self.logit_sink(
+                    list(req.tokens) + [tree.tokens[n] for n in path[1:]],
+                    full_logits[req.slot, path],
+                )
             # Tokens: path nodes beyond the root are newly committed
             # outputs; the bonus token is the LLM's own next sample.
             new_tokens = [tree.tokens[n] for n in path[1:]] + [bonus]
@@ -843,6 +967,12 @@ class SpecInferManager(RequestManager):
     # ------------------------------------------------------------------
     # scheduling
 
+    def _preempt(self, req: Request):
+        # recompute preemption re-prefills prompt + generated tokens
+        # through EVERY engine on re-admission — the skip debt is void
+        self._ssm_lag.pop(req.request_id, None)
+        super()._preempt(req)
+
     def register_request(self, prompt, gen: Optional[GenerationConfig] = None):
         gen = gen or GenerationConfig()
         if gen.do_sample:
@@ -860,6 +990,94 @@ class SpecInferManager(RequestManager):
         for ssm in self.ssms:
             ssm.run(bc)  # same tokens into every SSM cache
         return logits
+
+    def _decode_skipped(self, reqs: List[Request]) -> None:
+        """The verify-skip arm (SpecConfig.verify_skip): ONE C=1
+        incremental decode step for every request whose draft is cold —
+        the same decode-row batch, step program ((1, False, False) step
+        key) and greedy argmax the non-speculative sync scheduler runs,
+        so the skip arm is bitwise the incremental decode path by
+        construction. Only the TARGET engine steps — that is the whole
+        point of the skip (a cold draft costs ~zero, so speculation
+        never loses to non-speculative decoding). The SSM mirrors fall
+        behind instead; the per-request debt is recorded in
+        ``_ssm_lag`` and repaid by :meth:`_sync_ssm_caches` right
+        before anything next feeds the mirrors."""
+        R = self.engine.num_slots
+        bc = BatchConfig.empty(R, 1, self.engine.scratch_pos)
+        bc.qlens = np.zeros((R,), np.int32)
+        for req in reqs:
+            bc.tokens[req.slot, 0] = req.tokens[-1]
+            bc.positions[req.slot, 0] = len(req.tokens) - 1
+            bc.active[req.slot] = True
+            bc.logits_idx[req.slot] = 0
+            bc.qlens[req.slot] = 1
+        self._attach_paging_metadata(bc)
+        logits = self.engine.run(bc)  # (R, V); the LLM alone
+        # ffcheck: disable=FF107 -- verify-skip incremental arm: blocking greedy decode step by design — the skip exists to cost exactly one non-speculative step, same transfer the sync path pays
+        sampled = np.asarray(jax.device_get(_greedy(logits)))  # (R,)
+        for req in reqs:
+            req.n_cached += 1
+            req.n_sched = req.n_cached
+            req.profile.llm_decoding_steps += 1
+            req.profile.draft_flops_per_token = self.draft_flops_per_token
+            self.stats.verify_skipped_rounds += 1
+            if self.ssms:
+                self._ssm_lag[req.request_id] = (
+                    self._ssm_lag.get(req.request_id, 0) + 1
+                )
+            self._append_token(req, int(sampled[req.slot]))
+            if req.status is not RequestStatus.DECODING:
+                self._controllers.pop(req.request_id, None)
+                if self.prefix_cache is not None:
+                    # completion publishes this slot's prefix blocks on
+                    # every pool — the SSM pools' lines must hold real
+                    # K/V, not skip-round holes
+                    self._sync_ssm_caches([req])
+                self._ssm_lag.pop(req.request_id, None)
+
+    def _sync_ssm_caches(self, reqs: List[Request]) -> None:
+        """Repay the verify-skip SSM cache debt: replay the cache lines
+        [n_cached - lag, n_cached) — tokens the skipped rounds ran
+        through the LLM only — as ordinary causal inputs through every
+        SSM mirror (the :meth:`_refeed_accepted` pattern), chunked at
+        ``prefill_chunk``. ONE bounded step key per SSM regardless of
+        how long a request skipped, and the lag is normally capped at
+        ``reprobe_every`` anyway. Pages were reserved in lockstep all
+        along (_ensure_pages covers every engine), so the lines are
+        already granted."""
+        if not self.ssms:
+            return
+        reqs = [r for r in reqs if self._ssm_lag.get(r.request_id)]
+        if not reqs:
+            return
+        C = self.engine.serving.prefill_chunk
+        R = self.engine.num_slots
+        while reqs:
+            bc = BatchConfig.empty(R, C, self.engine.scratch_pos)
+            bc.qlens = np.zeros((R,), np.int32)
+            bc.prefill_offsets = np.zeros((R,), np.int32)
+            rest: List[Request] = []
+            for req in reqs:
+                lag = self._ssm_lag[req.request_id]
+                off = req.n_cached - lag
+                toks = req.tokens[off : off + min(lag, C)]
+                n = len(toks)
+                bc.tokens[req.slot, :n] = toks
+                bc.positions[req.slot, :n] = np.arange(off, off + n)
+                bc.active[req.slot] = True
+                bc.logits_idx[req.slot] = n - 1
+                bc.qlens[req.slot] = n
+                bc.prefill_offsets[req.slot] = off
+                if lag > n:
+                    self._ssm_lag[req.request_id] = lag - n
+                    rest.append(req)
+                else:
+                    self._ssm_lag.pop(req.request_id, None)
+            self._attach_paging_metadata(bc)
+            for ssm in self.ssms:
+                ssm.run(bc)
+            reqs = rest
 
     def _mirror_dispatch(self, last, host_tokens, use_last, positions,
                          logits_idx, key, greedy, temperature, topp,
@@ -890,6 +1108,10 @@ class SpecInferManager(RequestManager):
         self._admit_pending()
         sc = self.engine.serving
         if self._active(RequestStatus.PREFILLING):
+            # the prefill phase mirrors decode rows into every SSM —
+            # skip-lagged requests must replay their missed lines FIRST
+            # or the mirror would write K/V computed over cache holes
+            self._sync_ssm_caches(self._active(RequestStatus.DECODING))
             if sc.continuous_batching and not sc.inference_debugging:
                 self._reclaim_slots_for_admission()
                 self._reserve_active_pages(
@@ -900,15 +1122,52 @@ class SpecInferManager(RequestManager):
         # speculation rounds read host-side roots (req.tokens[-1]) —
         # drain whatever the pipelined prefill phase left in flight
         self._flush_all()
+        decoding = self._active(RequestStatus.DECODING)
+        # acceptance-weighted verify-skip: decide each request's round
+        # BEFORE reserving pages — a skipped row prices one incremental
+        # decode line, not a speculation tree's slack region
+        actions: Dict[int, str] = {}
+        if self.spec.verify_skip:
+            for req in decoding:
+                action = self._ctrl(req).next_action()
+                actions[req.request_id] = action
+                if action == "skip":
+                    self._log.debug(
+                        "verify-skip: request %d rides incremental "
+                        "decode (ema %.3f <= %.3f)",
+                        req.request_id, self._ctrl(req).ema,
+                        self.spec.skip_threshold * self._bucket(req)[1],
+                    )
+                elif action == "reprobe":
+                    self.stats.spec_reprobes += 1
+                    self._log.debug(
+                        "verify-skip: request %d re-probes the draft "
+                        "at %dx%d after %d skipped rounds",
+                        req.request_id, *self._bucket(req),
+                        self.spec.reprobe_every,
+                    )
         # paged KV: a spec round writes the whole tree's slack lines —
         # reserve prefix + tree pages (per-request shapes) on the LLM
-        # and every SSM
-        self._reserve_active_pages(self._spec_lines)
-        decoding = self._active(RequestStatus.DECODING)
+        # and every SSM; verify-skip rows need only their next line
+        self._reserve_active_pages(
+            lambda r: (
+                self._lines_needed(r)
+                if actions.get(r.request_id) == "skip"
+                else self._spec_lines(r)
+            )
+        )
+        decoding = [r for r in decoding if r.status is RequestStatus.DECODING]
         if not decoding:
             return bool(self.pending)
+        skipped = [
+            r for r in decoding if actions.get(r.request_id) == "skip"
+        ]
+        if skipped:
+            self._decode_skipped(skipped)
         groups: Dict[Tuple[int, int], List[Request]] = {}
         for req in decoding:
+            if actions.get(req.request_id) == "skip":
+                continue
             groups.setdefault(self._bucket(req), []).append(req)
         for bucket in sorted(groups):
             reqs = [
@@ -917,6 +1176,9 @@ class SpecInferManager(RequestManager):
             ]
             if not reqs:
                 continue  # an earlier bucket's round completed them
+            # a re-probing request's SSM mirrors missed every skipped
+            # round — replay those lines before the draft reads them
+            self._sync_ssm_caches(reqs)
             trees = self._grow_trees(reqs, *bucket)
             self._verify_and_commit(reqs, trees, *bucket)
         self._step_counter += 1

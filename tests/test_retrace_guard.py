@@ -364,6 +364,91 @@ def test_adaptive_spec_one_program_per_bucket(tiny):
     assert outs_off == first
 
 
+@pytest.mark.slow  # ~30s; premerge gate 3/7 runs this file unfiltered
+def test_verify_skip_flapping_bounded_step_keys(tiny):
+    """Verify-skip churn: a dead-cold draft flaps between skipped
+    rounds, cadenced re-probes and (1,1) spec rounds. The whole regime
+    must compile a BOUNDED step-key set — the ladder's speculate
+    programs, the decode/verify chunks, and one prefill-shaped SSM
+    replay program for the lag repayment — with zero retraces, nothing
+    new on a repeat of the identical workload, and sanitizers-on ==
+    sanitizers-off == plain incremental greedy bitwise."""
+    from flexflow_tpu.serve import SpecConfig, SpecInferManager
+
+    cfg, params = tiny
+    # UNRELATED random init: nothing it drafts survives verification,
+    # so every request bottoms out on the skip arm
+    dcfg = llama.LLaMAConfig.tiny(dtype=jnp.float32, num_hidden_layers=1)
+    dparams = llama.init_params(jax.random.PRNGKey(7), dcfg)
+    prompts = [[3, 17, 91, 42, 7], [9, 8, 7], [42] * 9, [5, 9, 2, 11]]
+
+    def sc(sans):
+        return ServingConfig(
+            max_requests_per_batch=4, max_sequence_length=96,
+            prefill_chunk=8, max_spec_tree_tokens=16,
+            cache_dtype=jnp.float32, kv_layout="paged", page_size=16,
+            sanitizers=sans,
+        )
+
+    def build(sans):
+        return SpecInferManager(
+            InferenceEngine(llama, cfg, params, sc(sans)),
+            InferenceEngine(llama, dcfg, dparams, sc(sans)),
+            SpecConfig(2, 3, adaptive=True, verify_skip=True,
+                       skip_threshold=0.1, reprobe_every=3),
+        )
+
+    ref = [
+        o.output_tokens
+        for o in RequestManager(
+            InferenceEngine(llama, cfg, params, sc(()))
+        ).generate(prompts, max_new_tokens=24)
+    ]
+
+    mgr = build(("retrace", "donation"))
+    first = [
+        o.output_tokens for o in mgr.generate(prompts, max_new_tokens=24)
+    ]
+    assert first == ref
+    assert mgr.stats.verify_skipped_rounds > 0, "skip arm never taken"
+    assert mgr.stats.spec_reprobes > 0, "re-probe cadence never came due"
+    assert mgr._ssm_lag == {}, "SSM cache debt left unpaid"
+
+    ladder = set(mgr.spec.bucket_ladder)
+    llm_g, ssm_g = mgr.engine.retrace_guard, mgr.ssm.retrace_guard
+    # draft engine: speculate programs stay on the ladder, and the only
+    # other shape is the bounded lag-replay step (prefill-chunk sized)
+    spec_counts = {
+        k: v for k, v in ssm_g.compile_counts().items()
+        if isinstance(k, tuple) and k and k[0] == "speculate"
+    }
+    visited = {(k[1], k[2]) for k in spec_counts}
+    assert visited <= ladder, (visited, ladder)
+    assert all(v == 1 for v in spec_counts.values()), spec_counts
+    assert all(
+        v == 1 for v in ssm_g.compile_counts().values()
+    ), ssm_g.compile_counts()
+    assert all(
+        v == 1 for v in llm_g.compile_counts().values()
+    ), llm_g.compile_counts()
+    assert llm_g.retraces == 0 and ssm_g.retraces == 0
+
+    # steady state: the identical workload flaps identically and may
+    # compile NOTHING new
+    total = llm_g.total_compiles + ssm_g.total_compiles
+    again = [
+        o.output_tokens for o in mgr.generate(prompts, max_new_tokens=24)
+    ]
+    assert again == first
+    assert llm_g.total_compiles + ssm_g.total_compiles == total
+
+    outs_off = [
+        o.output_tokens
+        for o in build(()).generate(prompts, max_new_tokens=24)
+    ]
+    assert outs_off == first
+
+
 # ---------------------------------------------------------------------------
 # RetraceGuard unit behavior
 
